@@ -1,0 +1,28 @@
+"""nomad_tpu — a TPU-native cluster scheduler framework.
+
+A brand-new implementation of the capabilities of HashiCorp Nomad v0.4.0
+(declarative jobs -> evaluations -> plans -> allocations over a replicated
+server cluster, with pluggable task drivers on client nodes), re-architected
+for TPU hardware: the scheduling hot path — feasibility masking, bin-pack
+scoring, and plan verification over the node table — runs as vectorized,
+`jit`/`pjit`-sharded XLA programs with the node axis laid out over the device
+mesh, while the control plane (state store, eval broker, plan applier, RPC,
+client runtime) runs host-side.
+
+Package layout:
+  structs/    data model + wire structs      (reference: nomad/structs/)
+  state/      MVCC state store + watches     (reference: nomad/state/)
+  tensor/     node-table tensorization       (new: TPU-first design)
+  scheduler/  schedulers + XLA kernels       (reference: scheduler/)
+  server/     broker, plan applier, worker   (reference: nomad/*.go)
+  client/     node agent + drivers           (reference: client/)
+  agent/      HTTP API + composite agent     (reference: command/agent/)
+  api/        client library                 (reference: api/)
+  cli/        command line                   (reference: command/)
+  jobspec/    HCL job spec parser            (reference: jobspec/)
+"""
+
+__version__ = "0.1.0"
+
+API_MAJOR_VERSION = 1
+API_MINOR_VERSION = 0

@@ -1,0 +1,225 @@
+"""Canonical test fixtures (reference: nomad/mock/mock.go).
+
+Same shapes and resource numbers as the reference fixtures so scenario tests
+and benchmarks are comparable.
+"""
+
+from __future__ import annotations
+
+from nomad_tpu.structs import (
+    Allocation,
+    Constraint,
+    Evaluation,
+    Job,
+    LogConfig,
+    NetworkResource,
+    Node,
+    PeriodicConfig,
+    Plan,
+    PlanResult,
+    Port,
+    Resources,
+    RestartPolicy,
+    Service,
+    ServiceCheck,
+    Task,
+    TaskGroup,
+    compute_node_class,
+    generate_uuid,
+)
+from nomad_tpu.structs.structs import (
+    MINUTE,
+    SECOND,
+    AllocClientStatusPending,
+    AllocDesiredStatusRun,
+    EvalStatusPending,
+    JobStatusPending,
+    JobTypeBatch,
+    JobTypeService,
+    JobTypeSystem,
+    NodeStatusReady,
+    PeriodicSpecCron,
+    RestartPolicyModeDelay,
+    ServiceCheckScript,
+)
+
+
+def node() -> Node:
+    n = Node(
+        ID=generate_uuid(),
+        Datacenter="dc1",
+        Name="foobar",
+        Attributes={
+            "kernel.name": "linux",
+            "arch": "x86",
+            "version": "0.1.0",
+            "driver.exec": "1",
+        },
+        Resources=Resources(
+            CPU=4000, MemoryMB=8192, DiskMB=100 * 1024, IOPS=150,
+            Networks=[NetworkResource(Device="eth0", CIDR="192.168.0.100/32", MBits=1000)],
+        ),
+        Reserved=Resources(
+            CPU=100, MemoryMB=256, DiskMB=4 * 1024,
+            Networks=[NetworkResource(Device="eth0", IP="192.168.0.100",
+                                      ReservedPorts=[Port("main", 22)], MBits=1)],
+        ),
+        Links={"consul": "foobar.dc1"},
+        Meta={"pci-dss": "true", "database": "mysql", "version": "5.6"},
+        NodeClass="linux-medium-pci",
+        Status=NodeStatusReady,
+    )
+    compute_node_class(n)
+    return n
+
+
+def job() -> Job:
+    j = Job(
+        Region="global",
+        ID=generate_uuid(),
+        Name="my-job",
+        Type=JobTypeService,
+        Priority=50,
+        AllAtOnce=False,
+        Datacenters=["dc1"],
+        Constraints=[Constraint(LTarget="${attr.kernel.name}", RTarget="linux", Operand="=")],
+        TaskGroups=[
+            TaskGroup(
+                Name="web",
+                Count=10,
+                RestartPolicy=RestartPolicy(Attempts=3, Interval=10 * MINUTE,
+                                            Delay=1 * MINUTE, Mode=RestartPolicyModeDelay),
+                Tasks=[
+                    Task(
+                        Name="web",
+                        Driver="exec",
+                        Config={"command": "/bin/date"},
+                        Env={"FOO": "bar"},
+                        Services=[
+                            Service(
+                                Name="${TASK}-frontend",
+                                PortLabel="http",
+                                Tags=["pci:${meta.pci-dss}", "datacenter:${node.datacenter}"],
+                                Checks=[ServiceCheck(
+                                    Name="check-table",
+                                    Type=ServiceCheckScript,
+                                    Command="/usr/local/check-table-${meta.database}",
+                                    Args=["${meta.version}"],
+                                    Interval=30 * SECOND,
+                                    Timeout=5 * SECOND,
+                                )],
+                            ),
+                            Service(Name="${TASK}-admin", PortLabel="admin"),
+                        ],
+                        LogConfig=LogConfig(),
+                        Resources=Resources(
+                            CPU=500, MemoryMB=256, DiskMB=150,
+                            Networks=[NetworkResource(
+                                MBits=50,
+                                DynamicPorts=[Port("http", 0), Port("admin", 0)],
+                            )],
+                        ),
+                        Meta={"foo": "bar"},
+                    )
+                ],
+                Meta={"elb_check_type": "http", "elb_check_interval": "30s",
+                      "elb_check_min": "3"},
+            )
+        ],
+        Meta={"owner": "armon"},
+        Status=JobStatusPending,
+        CreateIndex=42,
+        ModifyIndex=99,
+        JobModifyIndex=99,
+    )
+    j.init_fields()
+    return j
+
+
+def system_job() -> Job:
+    return Job(
+        Region="global",
+        ID=generate_uuid(),
+        Name="my-job",
+        Type=JobTypeSystem,
+        Priority=100,
+        AllAtOnce=False,
+        Datacenters=["dc1"],
+        Constraints=[Constraint(LTarget="${attr.kernel.name}", RTarget="linux", Operand="=")],
+        TaskGroups=[
+            TaskGroup(
+                Name="web",
+                Count=1,
+                RestartPolicy=RestartPolicy(Attempts=3, Interval=10 * MINUTE,
+                                            Delay=1 * MINUTE, Mode=RestartPolicyModeDelay),
+                Tasks=[
+                    Task(
+                        Name="web",
+                        Driver="exec",
+                        Config={"command": "/bin/date"},
+                        Resources=Resources(
+                            CPU=500, MemoryMB=256,
+                            Networks=[NetworkResource(MBits=50,
+                                                      DynamicPorts=[Port("http", 0)])],
+                        ),
+                        LogConfig=LogConfig(),
+                    )
+                ],
+            )
+        ],
+        Meta={"owner": "armon"},
+        Status=JobStatusPending,
+        CreateIndex=42,
+        ModifyIndex=99,
+    )
+
+
+def periodic_job() -> Job:
+    j = job()
+    j.Type = JobTypeBatch
+    j.Periodic = PeriodicConfig(Enabled=True, SpecType=PeriodicSpecCron,
+                                Spec="*/30 * * * *")
+    return j
+
+
+def eval() -> Evaluation:  # noqa: A001 - mirrors the reference fixture name
+    return Evaluation(
+        ID=generate_uuid(),
+        Priority=50,
+        Type=JobTypeService,
+        JobID=generate_uuid(),
+        Status=EvalStatusPending,
+    )
+
+
+def alloc() -> Allocation:
+    j = job()
+    res = Resources(
+        CPU=500, MemoryMB=256, DiskMB=10,
+        Networks=[NetworkResource(
+            Device="eth0", IP="192.168.0.100",
+            ReservedPorts=[Port("main", 5000)], MBits=50,
+            DynamicPorts=[Port("http", 0)],
+        )],
+    )
+    a = Allocation(
+        ID=generate_uuid(),
+        EvalID=generate_uuid(),
+        NodeID="12345678-abcd-efab-cdef-123456789abc",
+        TaskGroup="web",
+        Resources=res,
+        TaskResources={"web": res.copy()},
+        Job=j,
+        JobID=j.ID,
+        DesiredStatus=AllocDesiredStatusRun,
+        ClientStatus=AllocClientStatusPending,
+    )
+    return a
+
+
+def plan() -> Plan:
+    return Plan(Priority=50)
+
+
+def plan_result() -> PlanResult:
+    return PlanResult()

@@ -1,0 +1,43 @@
+"""Dense bitmaps for port-collision tracking (reference: nomad/structs/bitmap.go).
+
+Backed by numpy uint32 words so the same buffer can be shipped to the TPU
+port-collision kernel (nomad_tpu/scheduler/kernels.py) without conversion.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+class Bitmap:
+    """Fixed-size bitmap over [0, size)."""
+
+    __slots__ = ("size", "words")
+
+    def __init__(self, size: int):
+        if size <= 0:
+            raise ValueError("bitmap must be positive size")
+        self.size = size
+        self.words = np.zeros((size + 31) // 32, dtype=np.uint32)
+
+    def set(self, idx: int) -> None:
+        self.words[idx >> 5] |= np.uint32(1 << (idx & 31))
+
+    def check(self, idx: int) -> bool:
+        return bool((self.words[idx >> 5] >> np.uint32(idx & 31)) & np.uint32(1))
+
+    def clear(self) -> None:
+        self.words.fill(0)
+
+    def copy(self) -> "Bitmap":
+        b = Bitmap(self.size)
+        b.words = self.words.copy()
+        return b
+
+    def indexes_in_range(self, set_bits: bool, start: int, end: int) -> list[int]:
+        """Indexes in [start, end] whose bit equals set_bits."""
+        out = []
+        for i in range(start, min(end + 1, self.size)):
+            if self.check(i) == set_bits:
+                out.append(i)
+        return out

@@ -1,0 +1,87 @@
+"""Generic dataclass <-> dict <-> msgpack codec for the wire structs.
+
+The reference serializes all RPC structs with a msgpack codec generated per
+struct (reference: nomad/structs/structs.go:3007-3018, structs_codegen.go).
+Here a single reflective codec covers every dataclass: field names are the
+wire names (the data model uses the reference's CamelCase field naming so the
+HTTP API and client library are drop-in compatible).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import typing
+from typing import Any, Optional, Union, get_args, get_origin, get_type_hints
+
+import msgpack
+
+_HINTS_CACHE: dict[type, dict[str, Any]] = {}
+
+
+def to_dict(obj: Any) -> Any:
+    """Recursively convert a dataclass (or container of them) to plain data."""
+    if dataclasses.is_dataclass(obj) and not isinstance(obj, type):
+        out = {}
+        for f in dataclasses.fields(obj):
+            out[f.name] = to_dict(getattr(obj, f.name))
+        return out
+    if isinstance(obj, dict):
+        return {k: to_dict(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [to_dict(v) for v in obj]
+    return obj
+
+
+def _resolve_hints(cls: type) -> dict[str, Any]:
+    hints = _HINTS_CACHE.get(cls)
+    if hints is None:
+        hints = get_type_hints(cls)
+        _HINTS_CACHE[cls] = hints
+    return hints
+
+
+def _build(tp: Any, value: Any) -> Any:
+    if value is None:
+        return None
+    origin = get_origin(tp)
+    if origin is Union:  # Optional[T] and friends
+        args = [a for a in get_args(tp) if a is not type(None)]
+        if len(args) == 1:
+            return _build(args[0], value)
+        return value
+    if origin in (list, tuple):
+        (item_tp,) = get_args(tp) or (Any,)
+        return [_build(item_tp, v) for v in value]
+    if origin is dict:
+        args = get_args(tp)
+        val_tp = args[1] if len(args) == 2 else Any
+        return {k: _build(val_tp, v) for k, v in value.items()}
+    if isinstance(tp, type) and dataclasses.is_dataclass(tp):
+        return from_dict(tp, value)
+    return value
+
+
+def from_dict(cls: type, data: Any) -> Any:
+    """Build a dataclass instance from plain data, using type hints."""
+    if data is None:
+        return None
+    if not dataclasses.is_dataclass(cls):
+        return data
+    hints = _resolve_hints(cls)
+    kwargs = {}
+    field_names = {f.name for f in dataclasses.fields(cls)}
+    for key, value in data.items():
+        if key not in field_names:
+            continue  # forward compatibility: ignore unknown fields
+        kwargs[key] = _build(hints.get(key, Any), value)
+    return cls(**kwargs)
+
+
+def encode(obj: Any) -> bytes:
+    """Encode a dataclass to msgpack bytes (reference: structs.go:3007)."""
+    return msgpack.packb(to_dict(obj), use_bin_type=True)
+
+
+def decode(cls: type, buf: bytes) -> Any:
+    """Decode msgpack bytes into a dataclass (reference: structs.go:3013)."""
+    return from_dict(cls, msgpack.unpackb(buf, raw=False, strict_map_key=False))

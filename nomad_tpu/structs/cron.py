@@ -1,0 +1,126 @@
+"""Minimal cron-expression evaluator for periodic jobs.
+
+The reference uses gorhill/cronexpr (reference: nomad/structs/structs.go:1243,
+nomad/periodic.go). Supports the standard 5-field form `min hour dom month dow`
+plus an optional leading seconds field, with `*`, lists, ranges, and steps.
+"""
+
+from __future__ import annotations
+
+import calendar
+import time
+from dataclasses import dataclass
+from typing import FrozenSet, Tuple
+
+_FIELD_RANGES = [(0, 59), (0, 59), (0, 23), (1, 31), (1, 12), (0, 6)]
+_MONTH_NAMES = {name.lower(): i for i, name in enumerate(calendar.month_abbr) if name}
+_DAY_NAMES = {name.lower(): (i + 1) % 7 for i, name in enumerate(calendar.day_abbr)}
+
+
+def _parse_field(spec: str, lo: int, hi: int, names: dict | None = None) -> FrozenSet[int]:
+    values: set[int] = set()
+    spec = spec.lower()
+    for part in spec.split(","):
+        step = 1
+        if "/" in part:
+            part, step_s = part.split("/", 1)
+            step = int(step_s)
+            if step <= 0:
+                raise ValueError(f"invalid step {step}")
+        if part in ("*", "?"):
+            start, end = lo, hi
+        elif "-" in part:
+            a, b = part.split("-", 1)
+            start = _parse_value(a, names)
+            end = _parse_value(b, names)
+        else:
+            start = _parse_value(part, names)
+            end = start if step == 1 else hi
+        if start < lo or end > hi or start > end:
+            raise ValueError(f"field value out of range [{lo},{hi}]: {spec!r}")
+        values.update(range(start, end + 1, step))
+    return frozenset(values)
+
+
+def _parse_value(s: str, names: dict | None) -> int:
+    s = s.strip().lower()
+    if names and s in names:
+        return names[s]
+    return int(s)
+
+
+@dataclass(frozen=True)
+class CronExpr:
+    seconds: FrozenSet[int]
+    minutes: FrozenSet[int]
+    hours: FrozenSet[int]
+    dom: FrozenSet[int]
+    months: FrozenSet[int]
+    dow: FrozenSet[int]
+    dom_star: bool
+    dow_star: bool
+
+    @staticmethod
+    def parse(spec: str) -> "CronExpr":
+        spec = spec.strip()
+        if spec.startswith("@"):
+            spec = {
+                "@yearly": "0 0 1 1 *", "@annually": "0 0 1 1 *",
+                "@monthly": "0 0 1 * *", "@weekly": "0 0 * * 0",
+                "@daily": "0 0 * * *", "@midnight": "0 0 * * *",
+                "@hourly": "0 * * * *",
+            }.get(spec, None) or _raise(ValueError(f"unknown alias {spec!r}"))
+        fields = spec.split()
+        if len(fields) == 5:
+            fields = ["0"] + fields
+        if len(fields) != 6:
+            raise ValueError(f"expected 5 or 6 fields, got {len(fields)}")
+        sec = _parse_field(fields[0], 0, 59)
+        minute = _parse_field(fields[1], 0, 59)
+        hour = _parse_field(fields[2], 0, 23)
+        dom = _parse_field(fields[3], 1, 31)
+        month = _parse_field(fields[4], 1, 12, _MONTH_NAMES)
+        dow = _parse_field(fields[5], 0, 7, _DAY_NAMES)
+        if 7 in dow:  # both 0 and 7 mean Sunday
+            dow = (dow - {7}) | {0}
+        return CronExpr(sec, minute, hour, dom, month, dow,
+                        dom_star=fields[3] in ("*", "?"),
+                        dow_star=fields[5] in ("*", "?"))
+
+    def _day_match(self, tm: time.struct_time) -> bool:
+        dom_ok = tm.tm_mday in self.dom
+        dow_ok = (tm.tm_wday + 1) % 7 in self.dow
+        if self.dom_star and self.dow_star:
+            return True
+        if self.dom_star:
+            return dow_ok
+        if self.dow_star:
+            return dom_ok
+        return dom_ok or dow_ok  # standard cron OR semantics
+
+    def next(self, from_time: float) -> float:
+        """Next matching time (unix seconds) strictly after from_time; 0.0 if none in 5y."""
+        t = int(from_time) + 1
+        limit = t + 5 * 366 * 24 * 3600
+        while t < limit:
+            tm = time.localtime(t)
+            if (tm.tm_mon in self.months and self._day_match(tm)
+                    and tm.tm_hour in self.hours and tm.tm_min in self.minutes
+                    and tm.tm_sec in self.seconds):
+                return float(t)
+            # Skip forward coarsely to keep this fast.
+            if tm.tm_mon not in self.months or not self._day_match(tm):
+                t = int(time.mktime((tm.tm_year, tm.tm_mon, tm.tm_mday, 23, 59, 59,
+                                     0, 0, -1))) + 1
+            elif tm.tm_hour not in self.hours:
+                t = int(time.mktime((tm.tm_year, tm.tm_mon, tm.tm_mday, tm.tm_hour,
+                                     59, 59, 0, 0, -1))) + 1
+            elif tm.tm_min not in self.minutes:
+                t += 60 - tm.tm_sec
+            else:
+                t += 1
+        return 0.0
+
+
+def _raise(e: Exception):
+    raise e

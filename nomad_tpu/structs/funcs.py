@@ -1,0 +1,83 @@
+"""Shared pure functions over the data model (reference: nomad/structs/funcs.go)."""
+
+from __future__ import annotations
+
+import math
+from typing import Dict, List, Optional, Tuple
+
+from .network import NetworkIndex
+from .structs import Allocation, Node, Resources
+
+
+def remove_allocs(allocs: List[Allocation], remove: List[Allocation]) -> List[Allocation]:
+    """Remove the given allocations (by ID) from the list (reference: funcs.go:12-31)."""
+    remove_ids = {a.ID for a in remove}
+    return [a for a in allocs if a.ID not in remove_ids]
+
+
+def filter_terminal_allocs(allocs: List[Allocation]) -> List[Allocation]:
+    """Drop terminal allocations (reference: funcs.go:33-42)."""
+    return [a for a in allocs if not a.terminal_status()]
+
+
+def allocs_fit(node: Node, allocs: List[Allocation],
+               net_idx: Optional[NetworkIndex] = None) -> Tuple[bool, str, Resources]:
+    """Check whether the allocations fit on the node; returns (fit, exhausted
+    dimension, used resources) (reference: funcs.go:44-100)."""
+    used = Resources()
+
+    # Reserved resources count as used.
+    if node.Reserved is not None:
+        used.add(node.Reserved)
+
+    for alloc in allocs:
+        if alloc.Resources is not None:
+            used.add(alloc.Resources)
+            continue
+        for task_res in alloc.TaskResources.values():
+            used.add(task_res)
+
+    assert node.Resources is not None, "node has no resources"
+    fit, dim = node.Resources.superset(used)
+    if not fit:
+        return False, dim, used
+
+    # Network checks: build (or reuse) the index and look for overcommit.
+    if net_idx is None:
+        net_idx = NetworkIndex()
+        net_idx.set_node(node)
+        net_idx.add_allocs(allocs)
+    if net_idx.overcommitted():
+        return False, "bandwidth exhausted", used
+
+    return True, "", used
+
+
+def score_fit(node: Node, util: Resources) -> float:
+    """BestFit-v3 bin-pack score in [0, 18]; higher is better
+    (reference: funcs.go:102-137, citing Google's datacenter scheduling deck)."""
+    assert node.Resources is not None
+    node_cpu = float(node.Resources.CPU)
+    node_mem = float(node.Resources.MemoryMB)
+    if node.Reserved is not None:
+        node_cpu -= float(node.Reserved.CPU)
+        node_mem -= float(node.Reserved.MemoryMB)
+
+    # Degrade like Go float division: x/0 -> ±Inf, 0/0 -> NaN (no exception).
+    def _div(a: float, b: float) -> float:
+        if b != 0.0:
+            return a / b
+        if a == 0.0:
+            return math.nan
+        return math.copysign(math.inf, a)
+
+    free_pct_cpu = 1.0 - _div(float(util.CPU), node_cpu)
+    free_pct_ram = 1.0 - _div(float(util.MemoryMB), node_mem)
+
+    # At 100% utilization total=2 (score 18); at 0% total=20 (score 0).
+    total = math.pow(10, free_pct_cpu) + math.pow(10, free_pct_ram) \
+        if not (math.isnan(free_pct_cpu) or math.isnan(free_pct_ram)) else math.nan
+    score = 20.0 - total
+    if math.isnan(score):
+        return 0.0
+    return max(0.0, min(18.0, score))

@@ -1,0 +1,151 @@
+"""Network resource indexing: port collision + bandwidth accounting.
+
+Mirrors the reference's NetworkIndex semantics (reference:
+nomad/structs/network.go): per-IP 65536-bit port bitmaps, per-device bandwidth
+totals, dynamic port picking in [20000, 60000). The bitmaps are numpy uint32
+words (see bitmap.py) so the scheduler can batch surviving candidates' port
+checks on device.
+"""
+
+from __future__ import annotations
+
+import ipaddress
+import random
+from typing import Dict, List, Optional
+
+from .bitmap import Bitmap
+from .structs import (
+    Allocation,
+    MaxDynamicPort,
+    MaxValidPort,
+    MinDynamicPort,
+    NetworkResource,
+    Node,
+    Port,
+)
+
+_MAX_RAND_PORT_ATTEMPTS = 20
+
+
+class NetworkIndex:
+    """Indexes available and used network resources on one machine."""
+
+    def __init__(self) -> None:
+        self.avail_networks: List[NetworkResource] = []
+        self.avail_bandwidth: Dict[str, int] = {}
+        self.used_ports: Dict[str, Bitmap] = {}
+        self.used_bandwidth: Dict[str, int] = {}
+
+    def overcommitted(self) -> bool:
+        for device, used in self.used_bandwidth.items():
+            if used > self.avail_bandwidth.get(device, 0):
+                return True
+        return False
+
+    def set_node(self, node: Node) -> bool:
+        """Register the node's networks; True if reserved ports collide."""
+        collide = False
+        if node.Resources is not None:
+            for n in node.Resources.Networks:
+                if n.Device:
+                    self.avail_networks.append(n)
+                    self.avail_bandwidth[n.Device] = n.MBits
+        if node.Reserved is not None:
+            for n in node.Reserved.Networks:
+                if self.add_reserved(n):
+                    collide = True
+        return collide
+
+    def add_allocs(self, allocs: List[Allocation]) -> bool:
+        collide = False
+        for alloc in allocs:
+            for task_res in alloc.TaskResources.values():
+                if not task_res.Networks:
+                    continue
+                if self.add_reserved(task_res.Networks[0]):
+                    collide = True
+        return collide
+
+    def add_reserved(self, n: NetworkResource) -> bool:
+        collide = False
+        used = self.used_ports.get(n.IP)
+        if used is None:
+            used = Bitmap(MaxValidPort)
+            self.used_ports[n.IP] = used
+        for ports in (n.ReservedPorts, n.DynamicPorts):
+            for port in ports:
+                if port.Value < 0 or port.Value >= MaxValidPort:
+                    return True
+                if used.check(port.Value):
+                    collide = True
+                else:
+                    used.set(port.Value)
+        self.used_bandwidth[n.Device] = self.used_bandwidth.get(n.Device, 0) + n.MBits
+        return collide
+
+    def _yield_ips(self):
+        for n in self.avail_networks:
+            try:
+                net = ipaddress.ip_network(n.CIDR, strict=False)
+            except ValueError:
+                continue
+            for ip in net:
+                yield n, str(ip)
+
+    def assign_network(self, ask: NetworkResource,
+                       rng: Optional[random.Random] = None) -> NetworkResource:
+        """Assign network resources for an ask; raises ValueError when unsatisfiable."""
+        rng = rng or random
+        err = "no networks available"
+        for n, ip_str in self._yield_ips():
+            avail = self.avail_bandwidth.get(n.Device, 0)
+            used = self.used_bandwidth.get(n.Device, 0)
+            if used + ask.MBits > avail:
+                err = "bandwidth exceeded"
+                continue
+
+            used_ports = self.used_ports.get(ip_str)
+            port_collision = False
+            for port in ask.ReservedPorts:
+                if port.Value < 0 or port.Value >= MaxValidPort:
+                    raise ValueError(f"invalid port {port.Value} (out of range)")
+                if used_ports is not None and used_ports.check(port.Value):
+                    err = "reserved port collision"
+                    port_collision = True
+                    break
+            if port_collision:
+                continue
+
+            offer = NetworkResource(
+                Device=n.Device,
+                IP=ip_str,
+                MBits=ask.MBits,
+                ReservedPorts=[Port(p.Label, p.Value) for p in ask.ReservedPorts],
+                DynamicPorts=[Port(p.Label, p.Value) for p in ask.DynamicPorts],
+            )
+
+            ok = True
+            for i in range(len(offer.DynamicPorts)):
+                picked = self._pick_dynamic_port(used_ports, offer, rng)
+                if picked is None:
+                    err = "dynamic port selection failed"
+                    ok = False
+                    break
+                offer.DynamicPorts[i].Value = picked
+            if not ok:
+                continue
+            return offer
+        raise ValueError(err)
+
+    @staticmethod
+    def _pick_dynamic_port(used: Optional[Bitmap], offer: NetworkResource,
+                           rng) -> Optional[int]:
+        taken = {p.Value for p in offer.ReservedPorts} | {p.Value for p in offer.DynamicPorts}
+        for _ in range(_MAX_RAND_PORT_ATTEMPTS):
+            cand = MinDynamicPort + rng.randrange(MaxDynamicPort - MinDynamicPort)
+            if used is not None and used.check(cand):
+                continue
+            if cand in taken:
+                continue
+            return cand
+        return None

@@ -1,0 +1,63 @@
+"""Computed node class: the memoization key for feasibility checking.
+
+Nodes with identical (Datacenter, non-unique Attributes, non-unique Meta,
+NodeClass) share a computed class, so constraint feasibility is evaluated once
+per class instead of per node (reference: nomad/structs/node_class.go). In the
+TPU design this is also the compression axis: per-class host evaluation
+produces small lookup tables that are gathered back over the node axis on
+device (nomad_tpu/tensor/).
+"""
+
+from __future__ import annotations
+
+import hashlib
+from typing import List
+
+from .structs import Constraint, Node
+
+NODE_UNIQUE_NAMESPACE = "unique."
+
+
+def unique_namespace(key: str) -> str:
+    return f"{NODE_UNIQUE_NAMESPACE}{key}"
+
+
+def is_unique_namespace(key: str) -> bool:
+    return key.startswith(NODE_UNIQUE_NAMESPACE)
+
+
+def compute_class(node: Node) -> str:
+    """Stable hash of the node's non-unique scheduling-relevant fields."""
+    h = hashlib.blake2b(digest_size=8)
+
+    def feed(label: str, items):
+        h.update(label.encode())
+        for k, v in sorted(items):
+            h.update(b"\x00")
+            h.update(str(k).encode())
+            h.update(b"\x01")
+            h.update(str(v).encode())
+        h.update(b"\x02")
+
+    feed("dc", [("", node.Datacenter)])
+    feed("class", [("", node.NodeClass)])
+    feed("attrs", [(k, v) for k, v in node.Attributes.items() if not is_unique_namespace(k)])
+    feed("meta", [(k, v) for k, v in node.Meta.items() if not is_unique_namespace(k)])
+    return f"v1:{int.from_bytes(h.digest(), 'big')}"
+
+
+def compute_node_class(node: Node) -> None:
+    node.ComputedClass = compute_class(node)
+
+
+def escaped_constraints(constraints: List[Constraint]) -> List[Constraint]:
+    """Constraints that reference unique.* targets and therefore cannot be
+    memoized by computed class (reference: node_class.go:69-94)."""
+    return [c for c in constraints
+            if _target_escapes(c.LTarget) or _target_escapes(c.RTarget)]
+
+
+def _target_escapes(target: str) -> bool:
+    return (target.startswith("${node.unique.")
+            or target.startswith("${attr.unique.")
+            or target.startswith("${meta.unique."))

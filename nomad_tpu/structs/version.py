@@ -1,0 +1,126 @@
+"""Semantic-version constraint parsing and matching.
+
+The reference relies on hashicorp/go-version for `version` constraint operands
+(reference: scheduler/feasible.go:407-427). This is a small standalone
+implementation of the same constraint grammar: comma-separated clauses of
+`[op] version` where op ∈ {=, !=, >, <, >=, <=, ~>} (default `=`).
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass
+from typing import List, Optional, Tuple
+
+_VERSION_RE = re.compile(
+    r"^v?(\d+(?:\.\d+)*)([-.]?(?:[0-9A-Za-z\-~]+(?:\.[0-9A-Za-z\-~]+)*))?$"
+)
+_CONSTRAINT_RE = re.compile(r"^\s*(=|!=|>=|<=|>|<|~>)?\s*(.+?)\s*$")
+
+
+@dataclass(frozen=True)
+class Version:
+    segments: Tuple[int, ...]
+    prerelease: str = ""
+
+    @staticmethod
+    def parse(s: str) -> "Version":
+        m = _VERSION_RE.match(s.strip())
+        if not m:
+            raise ValueError(f"malformed version: {s!r}")
+        segs = tuple(int(p) for p in m.group(1).split("."))
+        pre = (m.group(2) or "").lstrip("-.")
+        # Pad to 3 segments for comparison stability.
+        while len(segs) < 3:
+            segs = segs + (0,)
+        return Version(segs, pre)
+
+    def _cmp(self, other: "Version") -> int:
+        n = max(len(self.segments), len(other.segments))
+        a = self.segments + (0,) * (n - len(self.segments))
+        b = other.segments + (0,) * (n - len(other.segments))
+        if a != b:
+            return -1 if a < b else 1
+        # A prerelease sorts before the release it precedes.
+        if self.prerelease == other.prerelease:
+            return 0
+        if not self.prerelease:
+            return 1
+        if not other.prerelease:
+            return -1
+        return -1 if self.prerelease < other.prerelease else 1
+
+    def __lt__(self, other):  # type: ignore[override]
+        return self._cmp(other) < 0
+
+    def __le__(self, other):  # type: ignore[override]
+        return self._cmp(other) <= 0
+
+    def __gt__(self, other):  # type: ignore[override]
+        return self._cmp(other) > 0
+
+    def __ge__(self, other):  # type: ignore[override]
+        return self._cmp(other) >= 0
+
+
+@dataclass(frozen=True)
+class _Clause:
+    op: str
+    version: Version
+    raw: str
+
+    def check(self, v: Version) -> bool:
+        c = v._cmp(self.version)
+        if self.op == "=":
+            return c == 0
+        if self.op == "!=":
+            return c != 0
+        if self.op == ">":
+            return c > 0
+        if self.op == "<":
+            return c < 0
+        if self.op == ">=":
+            return c >= 0
+        if self.op == "<=":
+            return c <= 0
+        if self.op == "~>":
+            # Pessimistic: >= version, and the leading segments (all but the
+            # last specified one) must match.
+            if c < 0:
+                return False
+            raw_segs = self.raw.split(".")
+            # "~> 1.2.3" locks 1.2; "~> 1.2" locks 1; "~> 1" still locks 1
+            # (>=1, <2), matching go-version's pessimistic operator.
+            lock = max(1, len(raw_segs) - 1)
+            return v.segments[:lock] == self.version.segments[:lock]
+        raise ValueError(f"unknown operator {self.op!r}")
+
+
+class VersionConstraint:
+    def __init__(self, clauses: List[_Clause]):
+        self.clauses = clauses
+
+    def check(self, v: Version) -> bool:
+        return all(c.check(v) for c in self.clauses)
+
+
+def parse_version_constraint(spec: str) -> VersionConstraint:
+    clauses = []
+    for part in spec.split(","):
+        m = _CONSTRAINT_RE.match(part)
+        if not m or not m.group(2):
+            raise ValueError(f"malformed constraint: {part!r}")
+        op = m.group(1) or "="
+        raw = m.group(2).lstrip("v")
+        clauses.append(_Clause(op, Version.parse(raw), raw.split("-")[0]))
+    return VersionConstraint(clauses)
+
+
+def check_version_constraint(lhs_version: str, constraint: str) -> bool:
+    """True when lhs_version satisfies the constraint string."""
+    try:
+        v = Version.parse(lhs_version)
+        c = parse_version_constraint(constraint)
+    except ValueError:
+        return False
+    return c.check(v)

@@ -1,0 +1,375 @@
+"""Data model tests (shaped after reference nomad/structs/*_test.go)."""
+
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.structs import (
+    Allocation,
+    Bitmap,
+    Constraint,
+    Job,
+    NetworkIndex,
+    NetworkResource,
+    Node,
+    PeriodicConfig,
+    Port,
+    Resources,
+    RestartPolicy,
+    Task,
+    TaskGroup,
+    allocs_fit,
+    compute_class,
+    decode,
+    encode,
+    escaped_constraints,
+    filter_terminal_allocs,
+    remove_allocs,
+    score_fit,
+)
+from nomad_tpu.structs.structs import (
+    MINUTE,
+    SECOND,
+    AllocClientStatusComplete,
+    AllocDesiredStatusEvict,
+    AllocDesiredStatusRun,
+    AllocDesiredStatusStop,
+    JobTypeBatch,
+    JobTypeService,
+    PeriodicSpecTest,
+    RestartPolicyModeDelay,
+    RestartPolicyModeFail,
+)
+from nomad_tpu.structs.version import check_version_constraint
+
+
+class TestJobValidate:
+    def test_empty_job_has_errors(self):
+        errs = Job().validate()
+        text = "\n".join(errs)
+        assert "job region" in text
+        assert "job ID" in text
+        assert "job name" in text
+        assert "job type" in text
+        assert "priority" in text
+        assert "datacenters" in text
+        assert "task groups" in text
+
+    def test_mock_job_valid(self):
+        assert mock.job().validate() == []
+
+    def test_duplicate_task_group(self):
+        j = mock.job()
+        j.TaskGroups.append(j.TaskGroups[0])
+        assert any("defined multiple times" in e for e in j.validate())
+
+    def test_system_job_count(self):
+        j = mock.system_job()
+        j.TaskGroups[0].Count = 5
+        j.init_fields()
+        assert any("should have a count of 1" in e for e in j.validate())
+
+    def test_periodic_only_batch(self):
+        j = mock.job()
+        j.Periodic = PeriodicConfig(Enabled=True, Spec="* * * * *")
+        assert any("batch" in e for e in j.validate())
+        j.Type = JobTypeBatch
+        assert j.validate() == []
+
+
+class TestTaskGroupValidate:
+    def test_empty(self):
+        errs = TaskGroup(Count=0).validate()
+        text = "\n".join(errs)
+        assert "task group name" in text
+        assert "count must be positive" in text
+        assert "Missing tasks" in text
+
+    def test_duplicate_tasks(self):
+        tg = mock.job().TaskGroups[0]
+        tg.Tasks.append(tg.Tasks[0])
+        assert any("defined multiple times" in e for e in tg.validate())
+
+
+class TestTaskValidate:
+    def test_empty(self):
+        errs = Task().validate()
+        text = "\n".join(errs)
+        assert "task name" in text
+        assert "task driver" in text
+        assert "task resources" in text
+
+    def test_log_storage_vs_disk(self):
+        t = mock.job().TaskGroups[0].Tasks[0]
+        t.Resources.DiskMB = 10  # below 10 files x 10MB log budget
+        assert any("log storage" in e for e in t.validate())
+
+
+class TestRestartPolicy:
+    def test_modes(self):
+        ok = RestartPolicy(Attempts=3, Interval=10 * MINUTE, Delay=1 * MINUTE,
+                           Mode=RestartPolicyModeDelay)
+        assert ok.validate() == []
+        bad = RestartPolicy(Mode="bogus")
+        assert any("Unsupported restart mode" in e for e in bad.validate())
+
+    def test_ambiguous(self):
+        p = RestartPolicy(Attempts=0, Mode=RestartPolicyModeDelay)
+        assert any("ambiguous" in e for e in p.validate())
+        p2 = RestartPolicy(Attempts=0, Mode=RestartPolicyModeFail)
+        assert p2.validate() == []
+
+    def test_too_many_restarts_in_interval(self):
+        p = RestartPolicy(Attempts=10, Interval=5 * SECOND, Delay=1 * SECOND,
+                          Mode=RestartPolicyModeDelay)
+        assert any("can't restart" in e for e in p.validate())
+
+
+class TestResources:
+    def test_superset(self):
+        big = Resources(CPU=2000, MemoryMB=2048, DiskMB=10000, IOPS=100)
+        small = Resources(CPU=2000, MemoryMB=2048, DiskMB=10000, IOPS=100)
+        assert big.superset(small) == (True, "")
+        small.CPU = 2001
+        assert big.superset(small) == (False, "cpu exhausted")
+        small.CPU = 100
+        small.MemoryMB = 4096
+        assert big.superset(small) == (False, "memory exhausted")
+
+    def test_add(self):
+        r = Resources(CPU=100, MemoryMB=100)
+        r.add(Resources(CPU=50, MemoryMB=25, DiskMB=100, IOPS=5))
+        assert (r.CPU, r.MemoryMB, r.DiskMB, r.IOPS) == (150, 125, 100, 5)
+
+    def test_min_resources(self):
+        assert Resources(CPU=10, MemoryMB=5, DiskMB=5, IOPS=-1).meets_min_resources()
+        assert Resources.default().meets_min_resources() == []
+
+
+class TestScoreFit:
+    def _node(self):
+        return Node(Resources=Resources(CPU=4096, MemoryMB=8192),
+                    Reserved=Resources(CPU=2048, MemoryMB=4096))
+
+    def test_perfect_fit_scores_18(self):
+        # Node has 2048 CPU / 4096 MB free after reservation; full usage => 18.
+        util = Resources(CPU=2048, MemoryMB=4096)
+        assert score_fit(self._node(), util) == pytest.approx(18.0)
+
+    def test_empty_util_scores_0(self):
+        assert score_fit(self._node(), Resources()) == pytest.approx(0.0)
+
+    def test_half_util_middling(self):
+        s = score_fit(self._node(), Resources(CPU=1024, MemoryMB=2048))
+        assert 0 < s < 18
+        # 20 - 2*10^0.5
+        assert s == pytest.approx(20.0 - 2 * 10 ** 0.5)
+
+    def test_fully_reserved_node_no_crash(self):
+        n = Node(Resources=Resources(CPU=4096, MemoryMB=8192),
+                 Reserved=Resources(CPU=4096, MemoryMB=8192))
+        # Overfit (util on a zero-headroom node) clamps to the 18.0 overfit
+        # ceiling, mirroring Go's Inf arithmetic; 0/0 (NaN) sanitizes to 0.
+        assert score_fit(n, Resources(CPU=100, MemoryMB=100)) == 18.0
+        assert score_fit(n, Resources()) == 0.0
+
+
+class TestAllocsFit:
+    def test_fit_and_overcommit(self):
+        n = mock.node()
+        a = mock.alloc()
+        a.Resources = Resources(
+            CPU=2000, MemoryMB=2048, DiskMB=5000,
+            Networks=[NetworkResource(Device="eth0", IP="192.168.0.100",
+                                      MBits=50, ReservedPorts=[Port("main", 8000)])],
+        )
+        a.TaskResources = {}
+        fit, dim, used = allocs_fit(n, [a])
+        assert fit, dim
+        assert used.CPU == 2000 + 100  # alloc + reserved
+        fit, dim, _ = allocs_fit(n, [a, a])
+        assert not fit
+        assert dim  # some dimension exhausted
+
+    def test_filter_terminal(self):
+        run = mock.alloc()
+        stopped = mock.alloc()
+        stopped.DesiredStatus = AllocDesiredStatusStop
+        complete = mock.alloc()
+        complete.ClientStatus = AllocClientStatusComplete
+        evicted = mock.alloc()
+        evicted.DesiredStatus = AllocDesiredStatusEvict
+        out = filter_terminal_allocs([run, stopped, complete, evicted])
+        assert out == [run]
+
+    def test_remove_allocs(self):
+        a, b, c = mock.alloc(), mock.alloc(), mock.alloc()
+        assert remove_allocs([a, b, c], [b]) == [a, c]
+
+
+class TestNetworkIndex:
+    def test_set_node_and_collision(self):
+        idx = NetworkIndex()
+        n = mock.node()
+        assert idx.set_node(n) is False
+        assert idx.avail_bandwidth["eth0"] == 1000
+        assert idx.used_ports["192.168.0.100"].check(22)
+
+    def test_assign_network_static_and_dynamic(self):
+        idx = NetworkIndex()
+        idx.set_node(mock.node())
+        ask = NetworkResource(MBits=50, ReservedPorts=[Port("main", 8000)],
+                              DynamicPorts=[Port("http", 0)])
+        offer = idx.assign_network(ask)
+        assert offer.IP == "192.168.0.100"
+        assert offer.ReservedPorts[0].Value == 8000
+        assert 20000 <= offer.DynamicPorts[0].Value < 60000
+
+    def test_assign_network_reserved_collision(self):
+        idx = NetworkIndex()
+        idx.set_node(mock.node())
+        ask = NetworkResource(MBits=10, ReservedPorts=[Port("ssh", 22)])
+        with pytest.raises(ValueError, match="reserved port collision"):
+            idx.assign_network(ask)
+
+    def test_assign_network_bandwidth_exceeded(self):
+        idx = NetworkIndex()
+        idx.set_node(mock.node())
+        with pytest.raises(ValueError, match="bandwidth exceeded"):
+            idx.assign_network(NetworkResource(MBits=2000))
+
+    def test_overcommitted(self):
+        idx = NetworkIndex()
+        idx.set_node(mock.node())
+        idx.add_reserved(NetworkResource(Device="eth0", IP="192.168.0.100", MBits=2000))
+        assert idx.overcommitted()
+
+
+class TestBitmap:
+    def test_basics(self):
+        b = Bitmap(65536)
+        assert not b.check(42)
+        b.set(42)
+        b.set(65535)
+        assert b.check(42) and b.check(65535)
+        b.clear()
+        assert not b.check(42)
+
+
+class TestComputedClass:
+    def test_same_attrs_same_class(self):
+        n1, n2 = mock.node(), mock.node()
+        assert compute_class(n1) == compute_class(n2)
+
+    def test_unique_keys_excluded(self):
+        n1, n2 = mock.node(), mock.node()
+        n2.Attributes["unique.hostname"] = "xyz"
+        assert compute_class(n1) == compute_class(n2)
+
+    def test_differs_on_meta(self):
+        n1, n2 = mock.node(), mock.node()
+        n2.Meta["database"] = "postgres"
+        assert compute_class(n1) != compute_class(n2)
+
+    def test_escaped_constraints(self):
+        cs = [
+            Constraint(LTarget="${attr.kernel.name}", RTarget="linux", Operand="="),
+            Constraint(LTarget="${attr.unique.network.ip-address}", RTarget="x", Operand="="),
+            Constraint(LTarget="${node.unique.id}", RTarget="y", Operand="="),
+        ]
+        esc = escaped_constraints(cs)
+        assert len(esc) == 2
+
+
+class TestVersionConstraint:
+    def test_basic(self):
+        assert check_version_constraint("1.2.3", ">= 1.0, < 2.0")
+        assert not check_version_constraint("2.1.0", ">= 1.0, < 2.0")
+        assert check_version_constraint("0.4.0", "~> 0.4")
+        assert check_version_constraint("1.2.4", "> 1.2.3")
+        assert not check_version_constraint("banana", "> 1.0")
+
+    def test_pessimistic_single_segment(self):
+        # "~> 1" means >=1, <2 (go-version semantics).
+        assert check_version_constraint("1.9.9", "~> 1")
+        assert not check_version_constraint("2.0.0", "~> 1")
+        assert check_version_constraint("1.2.9", "~> 1.2.3")
+        assert not check_version_constraint("1.3.0", "~> 1.2.3")
+
+
+class TestPeriodic:
+    def test_cron_next(self):
+        p = PeriodicConfig(Enabled=True, Spec="*/30 * * * *")
+        assert p.validate() == []
+        import time
+        nxt = p.next(time.time())
+        assert nxt > time.time()
+        lt = time.localtime(nxt)
+        assert lt.tm_min in (0, 30) and lt.tm_sec == 0
+
+    def test_test_spec(self):
+        p = PeriodicConfig(Enabled=True, SpecType=PeriodicSpecTest, Spec="100,200,300")
+        assert p.next(150) == 200.0
+        assert p.next(500) == 0.0
+
+    def test_invalid_cron(self):
+        p = PeriodicConfig(Enabled=True, Spec="this is not cron")
+        assert p.validate()
+
+    def test_cron_dow_seven_is_sunday(self):
+        # 5-7 (Fri-Sun) must parse; 7 is an alias for Sunday.
+        assert PeriodicConfig(Enabled=True, Spec="0 0 * * 5-7").validate() == []
+        from nomad_tpu.structs.cron import CronExpr
+        e = CronExpr.parse("0 0 * * 7")
+        assert 0 in e.dow and 7 not in e.dow
+
+
+class TestEvalAndPlan:
+    def test_should_enqueue_and_block(self):
+        e = mock.eval()
+        assert e.should_enqueue()
+        assert not e.should_block()
+        e.Status = "blocked"
+        assert e.should_block()
+        assert not e.should_enqueue()
+
+    def test_make_plan(self):
+        e = mock.eval()
+        j = mock.job()
+        p = e.make_plan(j)
+        assert p.EvalID == e.ID
+        assert p.Job.ID == j.ID
+
+    def test_plan_append_pop(self):
+        p = mock.plan()
+        a = mock.alloc()
+        assert p.is_no_op()
+        p.append_update(a, AllocDesiredStatusStop, "test")
+        assert not p.is_no_op()
+        assert p.NodeUpdate[a.NodeID][0].Job is None
+        assert p.NodeUpdate[a.NodeID][0].DesiredStatus == AllocDesiredStatusStop
+        p.pop_update(a)
+        assert p.is_no_op()
+
+    def test_create_blocked_eval(self):
+        e = mock.eval()
+        b = e.create_blocked_eval({"v1:123": True}, False)
+        assert b.Status == "blocked"
+        assert b.PreviousEval == e.ID
+        assert b.ClassEligibility == {"v1:123": True}
+
+
+class TestCodec:
+    def test_roundtrip_job(self):
+        j = mock.job()
+        buf = encode(j)
+        j2 = decode(Job, buf)
+        assert j2.ID == j.ID
+        assert j2.TaskGroups[0].Tasks[0].Resources.CPU == 500
+        assert j2.TaskGroups[0].Tasks[0].Services[0].Checks[0].Interval == 30 * SECOND
+        assert encode(j2) == buf
+
+    def test_roundtrip_alloc(self):
+        a = mock.alloc()
+        a2 = decode(Allocation, encode(a))
+        assert a2.TaskResources["web"].Networks[0].ReservedPorts[0].Value == 5000
+        assert a2.Job.Type == JobTypeService

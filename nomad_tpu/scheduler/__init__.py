@@ -1,0 +1,16 @@
+"""Schedulers: the TPU hot path (reference: scheduler/).
+
+The reference's per-node iterator chain becomes batched XLA programs
+(kernels.py); reconciliation (diffing required vs existing allocations) stays
+host-side Python — it is O(allocations of one job), not hot.
+"""
+
+from .scheduler import (  # noqa: F401
+    BUILTIN_SCHEDULERS, Planner, Scheduler, SetStatusError, State,
+    new_scheduler,
+)
+from .generic_sched import GenericScheduler  # noqa: F401
+from .system_sched import SystemScheduler  # noqa: F401
+from .context import EvalContext  # noqa: F401
+from .stack import GenericStack, SystemStack  # noqa: F401
+from .testing import Harness  # noqa: F401

@@ -1,0 +1,39 @@
+"""EvalContext: per-evaluation working state (reference: scheduler/context.go).
+
+Carries the state snapshot, the plan under construction, the metrics
+accumulator, and the proposed-allocation view: existing non-terminal
+allocations minus planned evictions plus planned placements (reference:
+context.go:109-140) — the invariant that placement k+1 must observe
+placement k.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Dict, List, Optional
+
+from nomad_tpu.structs import Allocation, AllocMetric, Plan, remove_allocs
+
+from .scheduler import State
+
+
+class EvalContext:
+    def __init__(self, state: State, plan: Plan,
+                 logger: Optional[logging.Logger] = None):
+        self.state = state
+        self.plan = plan
+        self.logger = logger or logging.getLogger("sched")
+        self.metrics = AllocMetric()
+
+    def reset(self) -> None:
+        self.metrics = AllocMetric()
+
+    def proposed_allocs(self, node_id: str) -> List[Allocation]:
+        """Proposed allocations on a node: existing non-terminal, minus plan
+        evictions, plus plan placements (reference: context.go:109-140)."""
+        existing = self.state.allocs_by_node_terminal(node_id, False)
+        if node_id in self.plan.NodeUpdate:
+            existing = remove_allocs(list(existing), self.plan.NodeUpdate[node_id])
+        proposed = list(existing)
+        proposed.extend(self.plan.NodeAllocation.get(node_id, ()))
+        return proposed

@@ -1,0 +1,142 @@
+"""XLA placement kernels: the scheduling hot path as tensor programs.
+
+This replaces the reference's per-node iterator chain (reference:
+scheduler/stack.go Select -> select.go MaxScoreIterator -> rank.go
+BinPackIterator -> feasible.go checkers) with batched device programs:
+
+  place_batch   lax.scan over the placements of one evaluation; each step is
+                a fused feasibility-mask + BestFit-v3 score + argmax over the
+                whole node axis, with in-register usage/anti-affinity updates
+                so placement k+1 sees placement k's proposed allocation
+                (reference semantics: scheduler/context.go:109-140).
+  system_feasible  one-shot mask for the system scheduler (one alloc per
+                eligible node, reference: scheduler/system_sched.go).
+  verify_plans  batched per-node fit re-check for the plan applier
+                (reference: nomad/plan_apply.go:318-361).
+
+Scoring matches reference funcs.go:102-137 (including its Inf/NaN division
+edges) with the job anti-affinity penalty applied after clamping (reference:
+rank.go:242-304). Selection is a global argmax rather than the reference's
+max-over-log2(n)-random-candidates (reference: stack.go:120-133), which can
+only improve placement quality; host-supplied per-node noise reproduces the
+load-spreading effect of the reference's node shuffle on ties.
+
+All shapes are static per (N_pad, P_pad) bucket: the node axis is padded to a
+power of two by NodeTensor and the placement axis by the stack, so jit caches
+stay warm. The node axis is the sharding axis for multi-chip meshes
+(nomad_tpu/parallel/).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+_LOG2_10 = float(np.log2(10.0))
+
+
+class PlacementResult(NamedTuple):
+    chosen: jax.Array      # [P] int32 row index, -1 when infeasible/padding
+    scores: jax.Array      # [P] f32 score of the chosen node
+    n_feasible: jax.Array  # [P] int32 feasible node count per step
+    usage_after: jax.Array  # [N, R] usage including the new placements
+
+
+def _score(usage2: jax.Array, score_cap: jax.Array) -> jax.Array:
+    """BestFit-v3: 20 - 10^freeCpuPct - 10^freeMemPct, clamped to [0, 18].
+
+    usage2 [N, 2] is proposed (cpu, mem) utilization including reserved;
+    score_cap [N, 2] is capacity minus reserved. Division by zero follows
+    IEEE (Inf/NaN) exactly like the Go reference; NaN sanitizes to 0.
+    """
+    free_pct = 1.0 - usage2 / score_cap
+    # 10^x on the MXU-friendly path: exp2(x * log2 10).
+    total = jnp.exp2(free_pct[:, 0] * _LOG2_10) + jnp.exp2(free_pct[:, 1] * _LOG2_10)
+    score = jnp.clip(20.0 - total, 0.0, 18.0)
+    return jnp.nan_to_num(score, nan=0.0, posinf=18.0, neginf=0.0)
+
+
+@functools.partial(jax.jit, donate_argnums=())
+def place_batch(
+    capacity: jax.Array,    # [N, R] total resources (fit bound)
+    score_cap: jax.Array,   # [N, 2] cpu/mem minus reserved (score denominator)
+    usage: jax.Array,       # [N, R] reserved + committed allocs (+/- plan deltas)
+    tg_masks: jax.Array,    # [T, N] bool per task group: ready & dc & class & escaped
+    job_counts: jax.Array,  # [N] int32 proposed allocs of this job per node
+    demands: jax.Array,     # [P, R] per-placement resource ask
+    tg_ids: jax.Array,      # [P] int32 task-group index into tg_masks
+    valid: jax.Array,       # [P] bool: real placement vs padding
+    noise: jax.Array,       # [N] f32 tie-break jitter in [0, 1e-3)
+    penalty: jax.Array,     # f32 job anti-affinity penalty (10 service / 5 batch)
+    distinct_hosts: jax.Array,  # bool: job has a distinct_hosts constraint
+    banned0: jax.Array,     # [N] bool: nodes already holding this job's allocs
+) -> PlacementResult:
+    def step(carry, inputs):
+        usage, job_counts, banned = carry
+        demand, tg_id, is_valid = inputs
+        eligible = tg_masks[tg_id]
+
+        fits = jnp.all(capacity - usage >= demand[None, :], axis=1)
+        ok = fits & eligible & ~(distinct_hosts & banned)
+
+        util2 = usage[:, :2] + demand[None, :2]
+        score = _score(util2, score_cap)
+        score = score - job_counts.astype(jnp.float32) * penalty + noise
+        masked = jnp.where(ok, score, -jnp.inf)
+
+        idx = jnp.argmax(masked)
+        found = ok[idx] & is_valid
+
+        one = found.astype(usage.dtype)
+        usage = usage.at[idx].add(demand * one)
+        job_counts = job_counts.at[idx].add(found.astype(job_counts.dtype))
+        banned = banned.at[idx].set(banned[idx] | found)
+
+        out = (jnp.where(found, idx, -1).astype(jnp.int32),
+               jnp.where(found, masked[idx], -jnp.inf),
+               jnp.sum(ok).astype(jnp.int32))
+        return (usage, job_counts, banned), out
+
+    (usage, _, _), (chosen, scores, n_feasible) = jax.lax.scan(
+        step, (usage, job_counts, banned0), (demands, tg_ids, valid))
+    return PlacementResult(chosen, scores, n_feasible, usage)
+
+
+@jax.jit
+def system_feasible(
+    capacity: jax.Array,   # [N, R]
+    usage: jax.Array,      # [N, R]
+    eligible: jax.Array,   # [N]
+    demand: jax.Array,     # [R]
+) -> tuple[jax.Array, jax.Array]:
+    """Mask + score for one-alloc-per-node system placement."""
+    fits = jnp.all(capacity - usage >= demand[None, :], axis=1) & eligible
+    return fits, fits.sum().astype(jnp.int32)
+
+
+@jax.jit
+def exhaustion_dims(
+    capacity: jax.Array,   # [N, R]
+    usage: jax.Array,      # [N, R]
+    eligible: jax.Array,   # [N]
+    demand: jax.Array,     # [R]
+) -> jax.Array:
+    """For failed placements: count of eligible nodes exhausted per dimension
+    (feeds AllocMetric.DimensionExhausted, reference: structs.go:2552-2584)."""
+    lacking = (capacity - usage) < demand[None, :]  # [N, R]
+    return jnp.sum(lacking & eligible[:, None], axis=0).astype(jnp.int32)
+
+
+@jax.jit
+def verify_plans(
+    capacity: jax.Array,   # [N, R] rows for the plan's nodes
+    usage: jax.Array,      # [N, R] committed usage minus plan evictions
+    proposed: jax.Array,   # [N, R] summed proposed-alloc demand per node
+) -> jax.Array:
+    """Plan applier: per-node fit re-check, batched (reference:
+    plan_apply.go:318-361 evaluateNodePlan)."""
+    return jnp.all(capacity - usage >= proposed, axis=1)

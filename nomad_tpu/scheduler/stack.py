@@ -1,0 +1,384 @@
+"""Placement stacks backed by the XLA kernels (reference: scheduler/stack.go).
+
+The reference wires per-node iterator chains; here a stack assembles device
+inputs (eligibility masks from the class-constraint compiler, usage deltas
+from the plan under construction, anti-affinity counts) and runs ONE
+place_batch program for all of an evaluation's placements. Network/port
+assignment — inherently sequential, string/random heavy — happens host-side
+for the chosen winners only, mirroring the reference's behavior of only
+network-checking nodes that survive ranking (reference: rank.go:150-240).
+"""
+
+from __future__ import annotations
+
+import random
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from nomad_tpu.structs import (
+    Allocation,
+    Job,
+    NetworkIndex,
+    Node,
+    Resources,
+    TaskGroup,
+)
+from nomad_tpu.structs.structs import ConstraintDistinctHosts, JobTypeBatch
+from nomad_tpu.tensor import ClassEligibility, TensorIndex, alloc_vec, resources_vec
+from nomad_tpu.tensor.node_table import DIM_NAMES, RES_DIMS
+
+from . import kernels
+from .context import EvalContext
+from .util import TGConstraints, task_group_constraints
+
+# Anti-affinity penalties (reference: stack.go:10-19)
+SERVICE_JOB_ANTI_AFFINITY_PENALTY = 10.0
+BATCH_JOB_ANTI_AFFINITY_PENALTY = 5.0
+
+_NOISE_SCALE = 1e-3
+
+
+@dataclass
+class SelectedOption:
+    """A chosen placement (the reference's RankedNode, rank.go:12-45)."""
+
+    node: Node
+    score: float
+    task_resources: Dict[str, Resources] = field(default_factory=dict)
+
+
+def _pad_pow2(n: int, floor: int = 8) -> int:
+    p = floor
+    while p < n:
+        p *= 2
+    return p
+
+
+class GenericStack:
+    """Stack for service/batch jobs (reference: stack.go:35-173)."""
+
+    def __init__(self, ctx: EvalContext, tindex: TensorIndex, batch: bool,
+                 rng: Optional[random.Random] = None):
+        self.ctx = ctx
+        self.tindex = tindex
+        self.batch = batch
+        self.rng = rng or random.Random()
+        self.job: Optional[Job] = None
+        self.elig: Optional[ClassEligibility] = None
+        self._cand_mask: Optional[np.ndarray] = None
+        self._nodes_by_id: Dict[str, Node] = {}
+        self._netidx_cache: Dict[str, NetworkIndex] = {}
+
+    # ------------------------------------------------------------- wiring
+    def set_job(self, job: Job) -> None:
+        self.job = job
+        self.elig = ClassEligibility(self.tindex.nt,
+                                     list(self._nodes_by_id.values()) or [])
+
+    def set_nodes(self, nodes: Sequence[Node]) -> None:
+        nt = self.tindex.nt
+        self._nodes_by_id = {n.ID: n for n in nodes}
+        mask = np.zeros(nt.n_rows, dtype=bool)
+        for n in nodes:
+            row = nt.row_of.get(n.ID)
+            if row is not None:
+                mask[row] = True
+        self._cand_mask = mask
+        # Rebuild the eligibility cache against the new node set.
+        if self.job is not None:
+            self.elig = ClassEligibility(nt, nodes)
+
+    # ---------------------------------------------------------- selection
+    def select(self, tg: TaskGroup) -> Tuple[Optional[SelectedOption], Resources]:
+        opts = self.select_batch([tg])
+        size = task_group_constraints(tg).size
+        return opts[0], size
+
+    def select_batch(self, tgs: Sequence[TaskGroup]
+                     ) -> List[Optional[SelectedOption]]:
+        """Place a sequence of task-group instances in order, each seeing the
+        previous placements' usage (reference sequencing: context.go:109-140),
+        as one lax.scan on device."""
+        assert self.job is not None and self.elig is not None
+        if self._cand_mask is None or not self._nodes_by_id:
+            self.ctx.metrics.NodesEvaluated = 0
+            return [None] * len(tgs)
+
+        t0 = time.monotonic()
+        nt = self.tindex.nt
+        job = self.job
+
+        # Per-unique-TG eligibility masks and demand vectors.
+        unique_tgs: List[TaskGroup] = []
+        tg_index: Dict[str, int] = {}
+        for tg in tgs:
+            if tg.Name not in tg_index:
+                tg_index[tg.Name] = len(unique_tgs)
+                unique_tgs.append(tg)
+
+        job_mask, _, _ = self.elig.job_mask(job.ID, job.Constraints)
+        tg_masks = np.zeros((len(unique_tgs), nt.n_rows), dtype=bool)
+        tg_demands = np.zeros((len(unique_tgs), RES_DIMS), dtype=np.float32)
+        tg_cons: List[TGConstraints] = []
+        for i, tg in enumerate(unique_tgs):
+            cons = task_group_constraints(tg)
+            tg_cons.append(cons)
+            m, _, _ = self.elig.tg_mask(job.ID, tg.Name, cons.constraints,
+                                        cons.drivers)
+            tg_masks[i] = self._cand_mask & job_mask & m
+            tg_demands[i] = resources_vec(cons.size)
+
+        # Plan deltas: usage scatter for in-plan evictions; anti-affinity and
+        # distinct-hosts state from proposed allocs of this job.
+        evict_rows, evict_vecs = self._eviction_deltas()
+        job_counts = self._job_alloc_counts()
+        distinct = any(c.Operand == ConstraintDistinctHosts
+                       for c in job.Constraints)
+        penalty = (BATCH_JOB_ANTI_AFFINITY_PENALTY if self.batch
+                   else SERVICE_JOB_ANTI_AFFINITY_PENALTY)
+
+        p_pad = _pad_pow2(len(tgs))
+        demands = np.zeros((p_pad, RES_DIMS), dtype=np.float32)
+        tg_ids = np.zeros(p_pad, dtype=np.int32)
+        valid = np.zeros(p_pad, dtype=bool)
+        for p, tg in enumerate(tgs):
+            ti = tg_index[tg.Name]
+            demands[p] = tg_demands[ti]
+            tg_ids[p] = ti
+            valid[p] = True
+
+        noise = self.rng.random()  # seed scalar; vector below
+        noise_vec = np.asarray(
+            np.random.default_rng(int(noise * 2**31)).random(nt.n_rows),
+            dtype=np.float32) * _NOISE_SCALE
+
+        banned_extra = np.zeros(nt.n_rows, dtype=bool)
+        results: List[Optional[SelectedOption]] = [None] * len(tgs)
+        remaining = list(range(len(tgs)))
+
+        # The port-collision retry loop runs at most a handful of times: a
+        # winner failing host-side network assignment is masked and the
+        # remaining placements re-run.
+        for _attempt in range(8):
+            if not remaining:
+                break
+            d = nt.device_arrays()
+            import jax.numpy as jnp
+
+            usage = d["usage"]
+            if len(evict_rows):
+                usage = usage.at[evict_rows].add(-evict_vecs)
+            masks = jnp.asarray(tg_masks & ~banned_extra[None, :])
+            sel_demands = demands.copy()
+            sel_valid = valid.copy()
+            sel_tgids = tg_ids.copy()
+            keep = np.zeros(p_pad, dtype=bool)
+            keep[remaining] = True
+            sel_valid &= keep
+
+            res = kernels.place_batch(
+                d["capacity"], d["score_cap"], usage, masks,
+                jnp.asarray(job_counts), jnp.asarray(sel_demands),
+                jnp.asarray(sel_tgids), jnp.asarray(sel_valid),
+                jnp.asarray(noise_vec), jnp.float32(penalty),
+                jnp.asarray(distinct), jnp.asarray(
+                    (job_counts > 0) if distinct else np.zeros(nt.n_rows, dtype=bool)),
+            )
+            chosen = np.asarray(res.chosen)
+            scores = np.asarray(res.scores)
+            n_feasible = np.asarray(res.n_feasible)
+
+            failed_rows: set = set()
+            next_remaining = []
+            for p in list(remaining):
+                row = int(chosen[p])
+                self._fill_metrics(tgs[p], tg_masks[tg_index[tgs[p].Name]],
+                                   int(n_feasible[p]))
+                if row < 0:
+                    self._note_exhaustion(tgs[p],
+                                          tg_masks[tg_index[tgs[p].Name]],
+                                          tg_demands[tg_index[tgs[p].Name]])
+                    continue  # infeasible: stays None
+                node_id = nt.node_of[row]
+                node = self._nodes_by_id.get(node_id)
+                if node is None:
+                    failed_rows.add(row)
+                    next_remaining.append(p)
+                    continue
+                option = self._assign_networks(node, tgs[p],
+                                               float(scores[p]))
+                if option is None:
+                    failed_rows.add(row)
+                    next_remaining.append(p)
+                    continue
+                results[p] = option
+                self.ctx.metrics.score_node(node, "binpack", float(scores[p]))
+
+            if not failed_rows:
+                break
+            for row in failed_rows:
+                banned_extra[row] = True
+            remaining = next_remaining
+
+        self.ctx.metrics.AllocationTime = int((time.monotonic() - t0) * 1e9)
+        return results
+
+    # ------------------------------------------------------------- helpers
+    def _eviction_deltas(self) -> Tuple[np.ndarray, np.ndarray]:
+        nt = self.tindex.nt
+        rows, vecs = [], []
+        for node_id, updates in self.ctx.plan.NodeUpdate.items():
+            row = nt.row_of.get(node_id)
+            if row is None:
+                continue
+            for alloc in updates:
+                # Look up the full alloc for resource accounting.
+                full = self.ctx.state.alloc_by_id(alloc.ID) or alloc
+                rows.append(row)
+                vecs.append(alloc_vec(full))
+        if not rows:
+            return np.zeros(0, dtype=np.int32), np.zeros((0, RES_DIMS),
+                                                         dtype=np.float32)
+        return (np.asarray(rows, dtype=np.int32),
+                np.asarray(vecs, dtype=np.float32))
+
+    def _job_alloc_counts(self) -> np.ndarray:
+        """Proposed allocs of this job per node row (anti-affinity base)."""
+        nt = self.tindex.nt
+        counts = np.zeros(nt.n_rows, dtype=np.int32)
+        assert self.job is not None
+        evicted = {a.ID
+                   for updates in self.ctx.plan.NodeUpdate.values()
+                   for a in updates}
+        for alloc in self.ctx.state.allocs_by_job(self.job.ID):
+            if alloc.terminal_status() or alloc.ID in evicted:
+                continue
+            row = nt.row_of.get(alloc.NodeID)
+            if row is not None:
+                counts[row] += 1
+        for node_id, placed in self.ctx.plan.NodeAllocation.items():
+            row = nt.row_of.get(node_id)
+            if row is not None:
+                counts[row] += sum(1 for a in placed if a.JobID == self.job.ID)
+        return counts
+
+    def _assign_networks(self, node: Node, tg: TaskGroup,
+                         score: float) -> Optional[SelectedOption]:
+        """Host-side port/bandwidth assignment for a chosen node."""
+        netidx = self._netidx_cache.get(node.ID)
+        if netidx is None:
+            netidx = NetworkIndex()
+            netidx.set_node(node)
+            netidx.add_allocs(self.ctx.proposed_allocs(node.ID))
+            self._netidx_cache[node.ID] = netidx
+        option = SelectedOption(node=node, score=score)
+        staged = []
+        for task in tg.Tasks:
+            resources = (task.Resources.copy() if task.Resources is not None
+                         else Resources())
+            if task.Resources is not None and task.Resources.Networks:
+                ask = task.Resources.Networks[0]
+                try:
+                    offer = netidx.assign_network(ask, self.rng)
+                except ValueError:
+                    # Staged reservations from this partial TG poison the
+                    # cached index; drop it so the next user rebuilds clean.
+                    self._netidx_cache.pop(node.ID, None)
+                    return None
+                netidx.add_reserved(offer)
+                staged.append(offer)
+                resources.Networks = [offer]
+            option.task_resources[task.Name] = resources
+        return option
+
+    def _fill_metrics(self, tg: TaskGroup, mask: np.ndarray,
+                      n_feasible: int) -> None:
+        m = self.ctx.metrics
+        n_eligible = int(mask.sum())
+        m.NodesEvaluated = n_eligible
+        m.NodesFiltered = int(self._cand_mask.sum()) - n_eligible
+        m.NodesExhausted = max(0, n_eligible - n_feasible)
+
+    def _note_exhaustion(self, tg: TaskGroup, mask: np.ndarray,
+                         demand: np.ndarray) -> None:
+        """Failed placement: record which dimensions were exhausted."""
+        nt = self.tindex.nt
+        free = nt.capacity - nt.usage
+        lacking = (free < demand[None, :]) & mask[:, None]
+        per_dim = lacking.sum(axis=0)
+        for d, count in enumerate(per_dim):
+            if count > 0:
+                name = DIM_NAMES[d]
+                m = self.ctx.metrics
+                m.DimensionExhausted[name] = (
+                    m.DimensionExhausted.get(name, 0) + int(count))
+
+    # -------------------------------------------- single-node host fast path
+    def select_on_node(self, tg: TaskGroup, node: Node
+                       ) -> Optional[SelectedOption]:
+        """Feasibility + fit on one specific node, host-side (used by
+        in-place updates, reference: util.go:393-426)."""
+        from nomad_tpu.tensor.constraints import (
+            node_has_drivers,
+            node_meets_constraints,
+        )
+
+        assert self.job is not None
+        nt = self.tindex.nt
+        row = nt.row_of.get(node.ID)
+        if row is None:
+            return None
+        cons = task_group_constraints(tg)
+        if not nt.ready[row]:
+            return None
+        if not node_meets_constraints(node, self.job.Constraints):
+            return None
+        if not (node_meets_constraints(node, cons.constraints)
+                and node_has_drivers(node, cons.drivers)):
+            return None
+        # Usage: committed minus in-plan evictions on this node.
+        usage = nt.usage[row].copy()
+        for alloc in self.ctx.plan.NodeUpdate.get(node.ID, ()):
+            full = self.ctx.state.alloc_by_id(alloc.ID) or alloc
+            usage -= alloc_vec(full)
+        for alloc in self.ctx.plan.NodeAllocation.get(node.ID, ()):
+            usage += alloc_vec(alloc)
+        demand = resources_vec(cons.size)
+        if np.any(nt.capacity[row] - usage < demand):
+            return None
+        util2 = usage[:2] + demand[:2]
+        with np.errstate(divide="ignore", invalid="ignore"):
+            free_pct = 1.0 - util2 / nt.score_cap[row]
+            total = np.power(10.0, free_pct[0]) + np.power(10.0, free_pct[1])
+        score = float(np.clip(20.0 - total, 0.0, 18.0))
+        if np.isnan(score):
+            score = 0.0
+        option = SelectedOption(node=node, score=score)
+        for task in tg.Tasks:
+            option.task_resources[task.Name] = (
+                task.Resources.copy() if task.Resources is not None
+                else Resources())
+        return option
+
+
+class SystemStack:
+    """Stack for the system scheduler: evaluates one specific node at a time
+    (reference: stack.go:176-261)."""
+
+    def __init__(self, ctx: EvalContext, tindex: TensorIndex):
+        self.inner = GenericStack(ctx, tindex, batch=False)
+
+    def set_nodes(self, nodes: Sequence[Node]) -> None:
+        self.inner.set_nodes(nodes)
+
+    def set_job(self, job: Job) -> None:
+        self.inner.set_job(job)
+
+    def select(self, tg: TaskGroup, node: Node) -> Optional[SelectedOption]:
+        option = self.inner.select_on_node(tg, node)
+        if option is None:
+            return None
+        return self.inner._assign_networks(node, tg, option.score) or None

@@ -1,0 +1,185 @@
+"""SystemScheduler: one allocation per eligible node (reference:
+scheduler/system_sched.go).
+
+The feasibility sweep over the node set runs as one device mask program
+(kernels.system_feasible semantics, folded into the class-eligibility masks);
+per-node network assignment stays host-side.
+"""
+
+from __future__ import annotations
+
+import logging
+import random
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from nomad_tpu.structs import (
+    Allocation,
+    AllocMetric,
+    Evaluation,
+    Job,
+    Plan,
+    PlanResult,
+    generate_uuid,
+)
+from nomad_tpu.structs.structs import (
+    AllocClientStatusPending,
+    AllocDesiredStatusRun,
+    AllocDesiredStatusStop,
+    EvalStatusComplete,
+    EvalStatusFailed,
+    EvalTriggerJobDeregister,
+    EvalTriggerJobRegister,
+    EvalTriggerNodeUpdate,
+)
+from nomad_tpu.tensor import TensorIndex
+
+from .context import EvalContext
+from .scheduler import Planner, SetStatusError, State
+from .stack import SystemStack
+from .util import (
+    ALLOC_NODE_TAINTED,
+    ALLOC_NOT_NEEDED,
+    ALLOC_UPDATING,
+    diff_system_allocs,
+    progress_made,
+    ready_nodes_in_dcs,
+    retry_max,
+    set_status,
+    tainted_nodes,
+)
+
+MAX_SYSTEM_SCHEDULE_ATTEMPTS = 5
+
+_HANDLED = (EvalTriggerJobRegister, EvalTriggerNodeUpdate,
+            EvalTriggerJobDeregister)
+
+
+class SystemScheduler:
+    def __init__(self, state: State, planner: Planner,
+                 tindex: Optional[TensorIndex], logger: logging.Logger,
+                 rng: Optional[random.Random] = None):
+        self.state = state
+        self.planner = planner
+        self.tindex = tindex
+        self.logger = logger
+        self.rng = rng or random.Random()
+
+        self.eval: Optional[Evaluation] = None
+        self.job: Optional[Job] = None
+        self.plan: Optional[Plan] = None
+        self.plan_result: Optional[PlanResult] = None
+        self.ctx: Optional[EvalContext] = None
+        self.stack: Optional[SystemStack] = None
+        self.failed_tg_allocs: Dict[str, AllocMetric] = {}
+        self.nodes = []
+        self.node_by_dc: Dict[str, int] = {}
+
+    def process(self, eval: Evaluation) -> None:
+        """(reference: system_sched.go:54-102)"""
+        self.eval = eval
+        if eval.TriggeredBy not in _HANDLED:
+            set_status(self.planner, eval, None, None, self.failed_tg_allocs,
+                       EvalStatusFailed,
+                       f"scheduler cannot handle '{eval.TriggeredBy}' evaluation reason")
+            return
+        try:
+            retry_max(MAX_SYSTEM_SCHEDULE_ATTEMPTS, self._process,
+                      lambda: progress_made(self.plan_result))
+        except SetStatusError as e:
+            set_status(self.planner, eval, None, None, self.failed_tg_allocs,
+                       e.eval_status, str(e))
+            return
+        set_status(self.planner, eval, None, None, self.failed_tg_allocs,
+                   EvalStatusComplete, "")
+
+    def _process(self) -> bool:
+        """(reference: system_sched.go:105-162)"""
+        self.job = self.state.job_by_id(self.eval.JobID)
+        self.plan = self.eval.make_plan(self.job)
+        self.failed_tg_allocs = {}
+        self.ctx = EvalContext(self.state, self.plan, self.logger)
+        if self.tindex is None:
+            self.tindex = TensorIndex.from_state(self.state)
+        self.stack = SystemStack(self.ctx, self.tindex)
+        if self.job is not None:
+            self.nodes, self.node_by_dc = ready_nodes_in_dcs(
+                self.state, self.job.Datacenters)
+            self.stack.set_nodes(self.nodes)
+            self.stack.set_job(self.job)
+
+        self._compute_job_allocs()
+
+        if self.plan.is_no_op():
+            return True
+
+        result, new_state = self.planner.submit_plan(self.plan)
+        self.plan_result = result
+        if new_state is not None:
+            self.state = new_state
+            self.tindex = None
+            return False
+        full_commit, expected, actual = result.full_commit(self.plan)
+        if not full_commit:
+            self.logger.debug("eval %s: attempted %d placements, %d placed",
+                              self.eval.ID, expected, actual)
+            return False
+        return True
+
+    def _compute_job_allocs(self) -> None:
+        """(reference: system_sched.go:165-216)"""
+        allocs = self.state.allocs_by_job(self.eval.JobID)
+        allocs = [a for a in allocs if not a.terminal_status()]
+        tainted = tainted_nodes(self.state, allocs)
+        diff = diff_system_allocs(self.job, self.nodes, tainted, allocs) \
+            if self.job is not None else None
+        if diff is None:
+            for a in allocs:
+                self.plan.append_update(a, AllocDesiredStatusStop,
+                                        ALLOC_NOT_NEEDED)
+            return
+
+        for tup in diff.stop:
+            desc = ALLOC_NODE_TAINTED if tainted.get(tup.Alloc.NodeID) \
+                else ALLOC_NOT_NEEDED
+            self.plan.append_update(tup.Alloc, AllocDesiredStatusStop, desc)
+        for tup in diff.update:
+            # System jobs update destructively: stop + replace on same node.
+            self.plan.append_update(tup.Alloc, AllocDesiredStatusStop,
+                                    ALLOC_UPDATING)
+            diff.place.append(tup)
+
+        if not diff.place:
+            return
+        self._compute_placements(diff.place)
+
+    def _compute_placements(self, place) -> None:
+        """(reference: system_sched.go:219-281)"""
+        node_by_id = {n.ID: n for n in self.nodes}
+        self.ctx.metrics.NodesAvailable = self.node_by_dc
+        for tup in place:
+            node = node_by_id.get(tup.Alloc.NodeID if tup.Alloc else "")
+            if node is None:
+                continue
+            option = self.stack.select(tup.TaskGroup, node)
+            if option is None:
+                metric = self.failed_tg_allocs.get(tup.TaskGroup.Name)
+                if metric is not None:
+                    metric.CoalescedFailures += 1
+                else:
+                    self.failed_tg_allocs[tup.TaskGroup.Name] = self.ctx.metrics.copy()
+                continue
+            alloc = Allocation(
+                ID=generate_uuid(),
+                EvalID=self.eval.ID,
+                Name=tup.Name,
+                JobID=self.job.ID,
+                TaskGroup=tup.TaskGroup.Name,
+                Metrics=self.ctx.metrics.copy(),
+                NodeID=node.ID,
+                TaskResources=option.task_resources,
+                DesiredStatus=AllocDesiredStatusRun,
+                ClientStatus=AllocClientStatusPending,
+            )
+            self.plan.append_alloc(alloc)

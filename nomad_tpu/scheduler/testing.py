@@ -1,0 +1,105 @@
+"""Scheduler test harness (reference: scheduler/testing.go).
+
+Runs real schedulers against a real StateStore with a fake Planner that
+applies plans directly and records everything — the backbone of the scenario
+test suite (reference: generic_sched_test.go, system_sched_test.go).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import List, Optional, Tuple
+
+from nomad_tpu.state.state_store import StateStore
+from nomad_tpu.structs import Allocation, Evaluation, Plan, PlanResult
+from nomad_tpu.tensor import TensorIndex
+
+from .scheduler import new_scheduler
+
+logger = logging.getLogger("sched.harness")
+
+
+class Harness:
+    """In-process State + Planner capture (reference: testing.go:36-207)."""
+
+    def __init__(self, state: Optional[StateStore] = None):
+        self.state = state or StateStore()
+        self.tindex = TensorIndex.attach(self.state)
+        self._lock = threading.Lock()
+        self.next_index = 1
+
+        self.plans: List[Plan] = []
+        self.evals: List[Evaluation] = []
+        self.creates: List[Evaluation] = []
+        self.reblocks: List[Evaluation] = []
+        self.reject_plan = False
+
+    # ----------------------------------------------------------- planner API
+    def submit_plan(self, plan: Plan) -> Tuple[Optional[PlanResult], Optional[object]]:
+        """Apply the plan directly to the store (reference: testing.go:68-125)."""
+        with self._lock:
+            self.plans.append(plan)
+            if self.reject_plan:
+                # Refresh requested: hand back the current state snapshot.
+                return PlanResult(RefreshIndex=self.state.latest_index()), self.state.snapshot()
+
+            index = self._next_index()
+            result = PlanResult(
+                NodeUpdate=plan.NodeUpdate,
+                NodeAllocation=plan.NodeAllocation,
+                AllocIndex=index,
+            )
+
+            # Flatten updates + placements into one alloc upsert, attaching
+            # the plan's job to placements (reference: testing.go:96-118).
+            allocs: List[Allocation] = []
+            for updates in plan.NodeUpdate.values():
+                allocs.extend(updates)
+            for placed in plan.NodeAllocation.values():
+                for alloc in placed:
+                    if alloc.Job is None:
+                        alloc.Job = plan.Job
+                    allocs.append(alloc)
+            self.state.upsert_allocs(index, allocs)
+            return result, None
+
+    def update_eval(self, eval: Evaluation) -> None:
+        with self._lock:
+            self.evals.append(eval)
+
+    def create_eval(self, eval: Evaluation) -> None:
+        with self._lock:
+            self.creates.append(eval)
+
+    def reblock_eval(self, eval: Evaluation) -> None:
+        with self._lock:
+            self.reblocks.append(eval)
+
+    # -------------------------------------------------------------- helpers
+    def _next_index(self) -> int:
+        idx = max(self.next_index, self.state.latest_index() + 1)
+        self.next_index = idx + 1
+        return idx
+
+    def upsert(self, obj_kind: str, obj) -> int:
+        """Convenience store writer with auto index."""
+        idx = self._next_index()
+        if obj_kind == "node":
+            self.state.upsert_node(idx, obj)
+        elif obj_kind == "job":
+            self.state.upsert_job(idx, obj)
+        elif obj_kind == "evals":
+            self.state.upsert_evals(idx, obj)
+        elif obj_kind == "allocs":
+            self.state.upsert_allocs(idx, obj)
+        else:
+            raise ValueError(obj_kind)
+        return idx
+
+    def process(self, scheduler_name: str, eval: Evaluation) -> None:
+        """Run a scheduler end to end against a state snapshot
+        (reference: testing.go:183-196)."""
+        snap = self.state.snapshot()
+        sched = new_scheduler(scheduler_name, snap, self, self.tindex, logger)
+        sched.process(eval)

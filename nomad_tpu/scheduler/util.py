@@ -1,0 +1,294 @@
+"""Reconciler utilities (reference: scheduler/util.go).
+
+Pure host-side logic: O(allocations of one job), not the hot path.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Tuple
+
+from nomad_tpu.structs import (
+    Allocation,
+    Constraint,
+    DesiredUpdates,
+    Job,
+    Node,
+    Resources,
+    TaskGroup,
+)
+from nomad_tpu.structs.structs import (
+    AllocDesiredStatusStop,
+    EvalStatusFailed,
+    JobTypeBatch,
+    NodeStatusReady,
+    should_drain_node,
+)
+
+from .scheduler import SetStatusError, State
+
+# Descriptions used on plan updates (reference: generic_sched.go:20-39)
+ALLOC_NOT_NEEDED = "alloc not needed due to job update"
+ALLOC_MIGRATING = "alloc is being migrated"
+ALLOC_UPDATING = "alloc is being updated due to job update"
+ALLOC_IN_PLACE = "alloc updating in-place"
+ALLOC_NODE_TAINTED = "alloc not needed as node is tainted"
+BLOCKED_EVAL_MAX_PLAN = "created due to placement conflicts"
+BLOCKED_EVAL_FAILED_PLACEMENTS = "created to place remaining allocations"
+
+
+@dataclass
+class AllocTuple:
+    """(name, task group, existing alloc) (reference: util.go:12-17)."""
+
+    Name: str
+    TaskGroup: Optional[TaskGroup]
+    Alloc: Optional[Allocation] = None
+
+
+@dataclass
+class DiffResult:
+    place: List[AllocTuple] = field(default_factory=list)
+    update: List[AllocTuple] = field(default_factory=list)
+    migrate: List[AllocTuple] = field(default_factory=list)
+    stop: List[AllocTuple] = field(default_factory=list)
+    ignore: List[AllocTuple] = field(default_factory=list)
+
+    def append(self, other: "DiffResult") -> None:
+        self.place.extend(other.place)
+        self.update.extend(other.update)
+        self.migrate.extend(other.migrate)
+        self.stop.extend(other.stop)
+        self.ignore.extend(other.ignore)
+
+
+def materialize_task_groups(job: Optional[Job]) -> Dict[str, TaskGroup]:
+    """Count expansion: name -> TG, names `job.tg[i]` (reference: util.go:21-34)."""
+    out: Dict[str, TaskGroup] = {}
+    if job is None:
+        return out
+    for tg in job.TaskGroups:
+        for i in range(tg.Count):
+            out[f"{job.Name}.{tg.Name}[{i}]"] = tg
+    return out
+
+
+def diff_allocs(job: Optional[Job], tainted: Dict[str, bool],
+                required: Dict[str, TaskGroup],
+                allocs: List[Allocation]) -> DiffResult:
+    """Set difference of required vs existing (reference: util.go:60-138)."""
+    result = DiffResult()
+    existing = set()
+    for exist in allocs:
+        name = exist.Name
+        existing.add(name)
+        tg = required.get(name)
+        if tg is None:
+            result.stop.append(AllocTuple(name, tg, exist))
+            continue
+        if tainted.get(exist.NodeID, False):
+            # Finished batch work stays finished even on a tainted node.
+            if (exist.Job is not None and exist.Job.Type == JobTypeBatch
+                    and exist.ran_successfully()):
+                result.ignore.append(AllocTuple(name, tg, exist))
+            else:
+                result.migrate.append(AllocTuple(name, tg, exist))
+            continue
+        if (job is not None and exist.Job is not None
+                and job.JobModifyIndex != exist.Job.JobModifyIndex):
+            result.update.append(AllocTuple(name, tg, exist))
+            continue
+        result.ignore.append(AllocTuple(name, tg, exist))
+    for name, tg in required.items():
+        if name not in existing:
+            result.place.append(AllocTuple(name, tg))
+    return result
+
+
+def diff_system_allocs(job: Job, nodes: List[Node], tainted: Dict[str, bool],
+                       allocs: List[Allocation]) -> DiffResult:
+    """Per-node diff for system jobs; placements carry their target node
+    (reference: util.go:142-181)."""
+    node_allocs: Dict[str, List[Allocation]] = {}
+    for alloc in allocs:
+        node_allocs.setdefault(alloc.NodeID, []).append(alloc)
+    for node in nodes:
+        node_allocs.setdefault(node.ID, [])
+
+    required = materialize_task_groups(job)
+    result = DiffResult()
+    for node_id, nallocs in node_allocs.items():
+        diff = diff_allocs(job, tainted, required, nallocs)
+        for tup in diff.place:
+            tup.Alloc = Allocation(NodeID=node_id)
+        # Migrations don't apply to system jobs: tainted node => stop.
+        diff.stop.extend(diff.migrate)
+        diff.migrate = []
+        result.append(diff)
+    return result
+
+
+def ready_nodes_in_dcs(state: State, dcs: List[str]) -> Tuple[List[Node], Dict[str, int]]:
+    """(reference: util.go:184-221)"""
+    dc_map = {dc: 0 for dc in dcs}
+    out = []
+    for node in state.nodes():
+        if node.Status != NodeStatusReady or node.Drain:
+            continue
+        if node.Datacenter not in dc_map:
+            continue
+        out.append(node)
+        dc_map[node.Datacenter] += 1
+    return out, dc_map
+
+
+def retry_max(max_attempts: int, cb: Callable[[], bool],
+              reset: Optional[Callable[[], bool]] = None) -> None:
+    """Retry until success with optional progress-based reset
+    (reference: util.go:224-248)."""
+    attempts = 0
+    while attempts < max_attempts:
+        if cb():
+            return
+        if reset is not None and reset():
+            attempts = 0
+        else:
+            attempts += 1
+    raise SetStatusError(f"maximum attempts reached ({max_attempts})",
+                         EvalStatusFailed)
+
+
+def progress_made(result) -> bool:
+    """(reference: util.go:252-255)"""
+    return result is not None and (bool(result.NodeUpdate)
+                                   or bool(result.NodeAllocation))
+
+
+def tainted_nodes(state: State, allocs: List[Allocation]) -> Dict[str, bool]:
+    """Nodes whose allocs must migrate (reference: util.go:259-278)."""
+    out: Dict[str, bool] = {}
+    for alloc in allocs:
+        if alloc.NodeID in out:
+            continue
+        node = state.node_by_id(alloc.NodeID)
+        if node is None:
+            out[alloc.NodeID] = True
+            continue
+        out[alloc.NodeID] = should_drain_node(node.Status) or node.Drain
+    return out
+
+
+def tasks_updated(a: TaskGroup, b: TaskGroup) -> bool:
+    """Field-sensitive update classifier: does the TG change require a
+    destructive update? (reference: util.go:291-352)"""
+    if len(a.Tasks) != len(b.Tasks):
+        return True
+    for at in a.Tasks:
+        bt = b.lookup_task(at.Name)
+        if bt is None:
+            return True
+        if (at.Driver != bt.Driver or at.User != bt.User
+                or at.Config != bt.Config or at.Env != bt.Env
+                or at.Meta != bt.Meta or at.Artifacts != bt.Artifacts):
+            return True
+        ar, br = at.Resources, bt.Resources
+        if ar is None or br is None:
+            if ar is not br:
+                return True
+            continue
+        if len(ar.Networks) != len(br.Networks):
+            return True
+        for an, bn in zip(ar.Networks, br.Networks):
+            if an.MBits != bn.MBits:
+                return True
+            if _network_port_map(an) != _network_port_map(bn):
+                return True
+        if (ar.CPU != br.CPU or ar.MemoryMB != br.MemoryMB
+                or ar.DiskMB != br.DiskMB or ar.IOPS != br.IOPS):
+            return True
+    return False
+
+
+def _network_port_map(n) -> Dict[str, int]:
+    """Dynamic port values are ignored for comparison (reference: util.go:356-366)."""
+    out = {p.Label: p.Value for p in n.ReservedPorts}
+    out.update({p.Label: -1 for p in n.DynamicPorts})
+    return out
+
+
+def evict_and_place(ctx, diff: DiffResult, allocs: List[AllocTuple],
+                    desc: str, limit: List[int]) -> bool:
+    """Evict up to limit[0] and queue replacements; True if limit reached
+    (reference: util.go:471-485). limit is a 1-element mutable cell."""
+    n = len(allocs)
+    for i in range(min(n, limit[0])):
+        a = allocs[i]
+        ctx.plan.append_update(a.Alloc, AllocDesiredStatusStop, desc)
+        diff.place.append(a)
+    if n <= limit[0]:
+        limit[0] -= n
+        return False
+    limit[0] = 0
+    return True
+
+
+@dataclass
+class TGConstraints:
+    """Aggregated TG constraints/drivers/size (reference: util.go:488-510)."""
+
+    constraints: List[Constraint]
+    drivers: List[str]
+    size: Resources
+
+
+def task_group_constraints(tg: TaskGroup) -> TGConstraints:
+    constraints = list(tg.Constraints)
+    drivers = []
+    size = Resources()
+    for task in tg.Tasks:
+        if task.Driver not in drivers:
+            drivers.append(task.Driver)
+        constraints.extend(task.Constraints)
+        size.add(task.Resources)
+    return TGConstraints(constraints, drivers, size)
+
+
+def desired_updates(diff: DiffResult, inplace: List[AllocTuple],
+                    destructive: List[AllocTuple]) -> Dict[str, DesiredUpdates]:
+    """Per-TG desired-change counts for plan annotations
+    (reference: util.go:513-595)."""
+    out: Dict[str, DesiredUpdates] = {}
+
+    def get(name: str) -> DesiredUpdates:
+        if name not in out:
+            out[name] = DesiredUpdates()
+        return out[name]
+
+    for tup in diff.place:
+        get(tup.TaskGroup.Name).Place += 1
+    for tup in diff.stop:
+        get(tup.Alloc.TaskGroup).Stop += 1
+    for tup in diff.ignore:
+        get(tup.TaskGroup.Name).Ignore += 1
+    for tup in diff.migrate:
+        get(tup.TaskGroup.Name).Migrate += 1
+    for tup in inplace:
+        get(tup.TaskGroup.Name).InPlaceUpdate += 1
+    for tup in destructive:
+        get(tup.TaskGroup.Name).DestructiveUpdate += 1
+    return out
+
+
+def set_status(planner, eval, next_eval, spawned_blocked, tg_metrics,
+               status: str, desc: str) -> None:
+    """Write the eval's terminal status through the planner
+    (reference: util.go:369-386)."""
+    new_eval = eval.copy()
+    new_eval.Status = status
+    new_eval.StatusDescription = desc
+    new_eval.FailedTGAllocs = tg_metrics or {}
+    if next_eval is not None:
+        new_eval.NextEval = next_eval.ID
+    if spawned_blocked is not None:
+        new_eval.BlockedEval = spawned_blocked.ID
+    planner.update_eval(new_eval)

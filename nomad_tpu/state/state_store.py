@@ -1,0 +1,621 @@
+"""MVCC in-memory state store with secondary indexes, snapshots, and watches.
+
+Equivalent to the reference's go-memdb-backed StateStore (reference:
+nomad/state/state_store.go, nomad/state/schema.go) but designed around
+per-key version chains instead of immutable radix trees:
+
+  * every write appends (index, value) to the key's version chain and updates
+    a live dict; `snapshot()` is O(1) — it just pins the current index as a
+    watermark and resolves reads through the chains;
+  * secondary indexes (allocs by node/job/eval, evals by job, periodic jobs)
+    are ever-membership sets — valid because the relation keys (NodeID, JobID,
+    EvalID) are immutable for the life of an object — resolved through the
+    primary chains at the snapshot watermark and pruned on compaction;
+  * mutations collect watch Items which are notified after commit, powering
+    blocking queries (reference: nomad/rpc.go:294-349).
+
+Writes take an externally supplied monotonically increasing `index` (the Raft
+log index in a replicated deployment, a local counter in dev mode).
+"""
+
+from __future__ import annotations
+
+import threading
+import weakref
+from bisect import bisect_right
+from typing import Any, Callable, Dict, Iterable, List, Optional, Set, Tuple
+
+from nomad_tpu.structs import (
+    Allocation,
+    Evaluation,
+    Job,
+    Node,
+    PeriodicLaunch,
+)
+from nomad_tpu.structs.structs import (
+    AllocClientStatusFailed,
+    AllocClientStatusRunning,
+    EvalStatusBlocked,
+    JobStatusDead,
+    JobStatusPending,
+    JobStatusRunning,
+    NodeStatusDown,
+    NodeStatusReady,
+)
+
+from .watch import Item, Items, NotifyGroup
+
+
+class _Chain:
+    """Version chain for one key: parallel arrays of indexes and values."""
+
+    __slots__ = ("indexes", "values")
+
+    def __init__(self) -> None:
+        self.indexes: List[int] = []
+        self.values: List[Any] = []
+
+    def append(self, index: int, value: Any) -> None:
+        self.indexes.append(index)
+        self.values.append(value)
+
+    def at(self, watermark: int) -> Any:
+        """Latest value with index <= watermark (None if absent/tombstone)."""
+        i = bisect_right(self.indexes, watermark)
+        if i == 0:
+            return None
+        return self.values[i - 1]
+
+    def compact(self, min_watermark: int) -> bool:
+        """Drop versions superseded before min_watermark; True if chain empty."""
+        i = bisect_right(self.indexes, min_watermark)
+        if i > 1:
+            del self.indexes[: i - 1]
+            del self.values[: i - 1]
+        return len(self.values) == 1 and self.values[0] is None
+
+
+class _Table:
+    __slots__ = ("chains", "current")
+
+    def __init__(self) -> None:
+        self.chains: Dict[str, _Chain] = {}
+        self.current: Dict[str, Any] = {}
+
+    def write(self, index: int, key: str, value: Any) -> None:
+        chain = self.chains.get(key)
+        if chain is None:
+            chain = _Chain()
+            self.chains[key] = chain
+        chain.append(index, value)
+        if value is None:
+            self.current.pop(key, None)
+        else:
+            self.current[key] = value
+
+
+class _ReadAPI:
+    """Read operations shared by StateStore (live view) and StateSnapshot."""
+
+    # Subclasses define _get(table, key) and _iter(table) and _members(...)
+
+    # -- nodes --
+    def node_by_id(self, node_id: str) -> Optional[Node]:
+        return self._get("nodes", node_id)
+
+    def nodes(self) -> List[Node]:
+        return self._iter("nodes")
+
+    # -- jobs --
+    def job_by_id(self, job_id: str) -> Optional[Job]:
+        return self._get("jobs", job_id)
+
+    def jobs(self) -> List[Job]:
+        return self._iter("jobs")
+
+    def jobs_by_id_prefix(self, prefix: str) -> List[Job]:
+        return [j for j in self._iter("jobs") if j.ID.startswith(prefix)]
+
+    def jobs_by_periodic(self, periodic: bool = True) -> List[Job]:
+        return [j for j in self._iter("jobs") if j.is_periodic() == periodic]
+
+    def jobs_by_scheduler(self, scheduler_type: str) -> List[Job]:
+        return [j for j in self._iter("jobs") if j.Type == scheduler_type]
+
+    def jobs_by_gc(self, gc: bool = True) -> List[Job]:
+        # A job is GC-able when it is batch-type (reference: schema.go jobIsGCable)
+        from nomad_tpu.structs.structs import JobTypeBatch
+
+        return [j for j in self._iter("jobs") if (j.Type == JobTypeBatch) == gc]
+
+    # -- evals --
+    def eval_by_id(self, eval_id: str) -> Optional[Evaluation]:
+        return self._get("evals", eval_id)
+
+    def evals(self) -> List[Evaluation]:
+        return self._iter("evals")
+
+    def evals_by_job(self, job_id: str) -> List[Evaluation]:
+        return self._members("eval_job", job_id, "evals")
+
+    # -- allocs --
+    def alloc_by_id(self, alloc_id: str) -> Optional[Allocation]:
+        return self._get("allocs", alloc_id)
+
+    def allocs(self) -> List[Allocation]:
+        return self._iter("allocs")
+
+    def allocs_by_node(self, node_id: str) -> List[Allocation]:
+        return self._members("alloc_node", node_id, "allocs")
+
+    def allocs_by_node_terminal(self, node_id: str, terminal: bool) -> List[Allocation]:
+        return [a for a in self.allocs_by_node(node_id)
+                if a.terminal_status() == terminal]
+
+    def allocs_by_job(self, job_id: str) -> List[Allocation]:
+        return self._members("alloc_job", job_id, "allocs")
+
+    def allocs_by_eval(self, eval_id: str) -> List[Allocation]:
+        return self._members("alloc_eval", eval_id, "allocs")
+
+    # -- periodic launches --
+    def periodic_launch_by_id(self, job_id: str) -> Optional[PeriodicLaunch]:
+        return self._get("periodic_launch", job_id)
+
+    def periodic_launches(self) -> List[PeriodicLaunch]:
+        return self._iter("periodic_launch")
+
+
+TABLES = ("nodes", "jobs", "evals", "allocs", "periodic_launch")
+_MEMBER_INDEXES = {
+    "eval_job": ("evals", lambda e: e.JobID),
+    "alloc_node": ("allocs", lambda a: a.NodeID),
+    "alloc_job": ("allocs", lambda a: a.JobID),
+    "alloc_eval": ("allocs", lambda a: a.EvalID),
+}
+
+
+class StateStore(_ReadAPI):
+    """The authoritative in-memory store behind the FSM."""
+
+    def __init__(self) -> None:
+        self._lock = threading.RLock()
+        self._tables: Dict[str, _Table] = {t: _Table() for t in TABLES}
+        self._member_sets: Dict[str, Dict[str, Set[str]]] = {
+            name: {} for name in _MEMBER_INDEXES
+        }
+        self._table_index: Dict[str, int] = {}
+        self._latest_index = 0
+        self._notify = NotifyGroup()
+        self._watermarks: Dict[int, int] = {}  # snapshot token -> watermark
+        self._next_token = 0
+        # Change listeners: cb(kind, old, new) fired post-commit. Used to keep
+        # the device-resident node tensor in sync (nomad_tpu/tensor/).
+        self._listeners: List[Callable[[str, Any, Any], None]] = []
+
+    def add_change_listener(self, cb: Callable[[str, Any, Any], None]) -> None:
+        self._listeners.append(cb)
+
+    def _emit(self, events: List[Tuple[str, Any, Any]]) -> None:
+        for cb in self._listeners:
+            for kind, old, new in events:
+                cb(kind, old, new)
+
+    # ------------------------------------------------------------------ reads
+    def _get(self, table: str, key: str):
+        return self._tables[table].current.get(key)
+
+    def _iter(self, table: str):
+        with self._lock:
+            return list(self._tables[table].current.values())
+
+    def _members(self, index_name: str, key: str, table: str):  # type: ignore[override]
+        with self._lock:
+            ids = self._members_sets(index_name).get(key, ())
+            cur = self._tables[table].current
+            return [cur[i] for i in ids if i in cur]
+
+    def _members_sets(self, index_name: str) -> Dict[str, Set[str]]:
+        return self._member_sets[index_name]
+
+    def get_index(self, table: str) -> int:
+        return self._table_index.get(table, 0)
+
+    def latest_index(self) -> int:
+        return self._latest_index
+
+    # ------------------------------------------------------------------ watch
+    def watch(self, items: Iterable[Item], event: threading.Event) -> None:
+        self._notify.watch(items, event)
+
+    def stop_watch(self, items: Iterable[Item], event: threading.Event) -> None:
+        self._notify.stop_watch(items, event)
+
+    # ----------------------------------------------------------------- writes
+    def _commit(self, index: int, tables: Iterable[str], watch_items: Items) -> None:
+        for t in set(tables):
+            self._table_index[t] = index
+            watch_items.add(Item(table=t))
+        if index > self._latest_index:
+            self._latest_index = index
+        self._notify.notify(watch_items)
+
+    def _member_add(self, index_name: str, key: str, obj_id: str) -> None:
+        self._members_sets(index_name).setdefault(key, set()).add(obj_id)
+
+    def upsert_node(self, index: int, node: Node) -> None:
+        """(reference: state_store.go:91 UpsertNode) Preserves CreateIndex and
+        keeps drain/status transitions consistent."""
+        with self._lock:
+            existing = self._get("nodes", node.ID)
+            if existing is not None:
+                node.CreateIndex = existing.CreateIndex
+            else:
+                node.CreateIndex = index
+            node.ModifyIndex = index
+            self._tables["nodes"].write(index, node.ID, node)
+            self._commit(index, ["nodes"], Items([Item(node=node.ID)]))
+            self._emit([("node", existing, node)])
+
+    def delete_node(self, index: int, node_id: str) -> None:
+        with self._lock:
+            existing = self._get("nodes", node_id)
+            if existing is None:
+                raise KeyError(f"node not found: {node_id}")
+            self._tables["nodes"].write(index, node_id, None)
+            self._commit(index, ["nodes"], Items([Item(node=node_id)]))
+            self._emit([("node", existing, None)])
+
+    def update_node_status(self, index: int, node_id: str, status: str) -> None:
+        with self._lock:
+            existing = self._get("nodes", node_id)
+            if existing is None:
+                raise KeyError(f"node not found: {node_id}")
+            node = existing.copy()
+            node.Status = status
+            node.ModifyIndex = index
+            self._tables["nodes"].write(index, node_id, node)
+            self._commit(index, ["nodes"], Items([Item(node=node_id)]))
+            self._emit([("node", existing, node)])
+
+    def update_node_drain(self, index: int, node_id: str, drain: bool) -> None:
+        with self._lock:
+            existing = self._get("nodes", node_id)
+            if existing is None:
+                raise KeyError(f"node not found: {node_id}")
+            node = existing.copy()
+            node.Drain = drain
+            node.ModifyIndex = index
+            self._tables["nodes"].write(index, node_id, node)
+            self._commit(index, ["nodes"], Items([Item(node=node_id)]))
+            self._emit([("node", existing, node)])
+
+    def upsert_job(self, index: int, job: Job) -> None:
+        """(reference: state_store.go:280 UpsertJob) Derives initial status."""
+        with self._lock:
+            watch_items = Items([Item(job=job.ID)])
+            existing = self._get("jobs", job.ID)
+            if existing is not None:
+                job.CreateIndex = existing.CreateIndex
+                job.JobModifyIndex = index
+            else:
+                job.CreateIndex = index
+                job.JobModifyIndex = index
+            job.ModifyIndex = index
+            job.Status = self._derive_job_status(job, eval_delete=False)
+            self._tables["jobs"].write(index, job.ID, job)
+            self._commit(index, ["jobs"], watch_items)
+
+    def delete_job(self, index: int, job_id: str) -> None:
+        with self._lock:
+            if self._get("jobs", job_id) is None:
+                raise KeyError(f"job not found: {job_id}")
+            self._tables["jobs"].write(index, job_id, None)
+            # Also clean the periodic launch entry if any.
+            tables = ["jobs"]
+            if self._get("periodic_launch", job_id) is not None:
+                self._tables["periodic_launch"].write(index, job_id, None)
+                tables.append("periodic_launch")
+            self._commit(index, tables, Items([Item(job=job_id)]))
+
+    def upsert_evals(self, index: int, evals: List[Evaluation]) -> None:
+        """(reference: state_store.go:476 UpsertEvals) Also refreshes the
+        status of every touched job."""
+        with self._lock:
+            watch_items = Items()
+            jobs: Dict[str, str] = {}
+            for ev in evals:
+                existing = self._get("evals", ev.ID)
+                if existing is not None:
+                    ev.CreateIndex = existing.CreateIndex
+                else:
+                    ev.CreateIndex = index
+                ev.ModifyIndex = index
+                self._tables["evals"].write(index, ev.ID, ev)
+                self._member_add("eval_job", ev.JobID, ev.ID)
+                watch_items.add(Item(eval=ev.ID))
+                jobs.setdefault(ev.JobID, "")
+            touched = self._set_job_statuses(index, watch_items, jobs,
+                                             eval_delete=False)
+            self._commit(index, ["evals"] + touched, watch_items)
+
+    def delete_eval(self, index: int, eval_ids: List[str],
+                    alloc_ids: List[str]) -> None:
+        """GC path: remove evals and allocs together (reference:
+        state_store.go DeleteEval)."""
+        with self._lock:
+            watch_items = Items()
+            jobs: Dict[str, str] = {}
+            events = []
+            for eid in eval_ids:
+                existing = self._get("evals", eid)
+                if existing is None:
+                    continue
+                self._tables["evals"].write(index, eid, None)
+                watch_items.add(Item(eval=eid))
+                jobs.setdefault(existing.JobID, "")
+            for aid in alloc_ids:
+                existing = self._get("allocs", aid)
+                if existing is None:
+                    continue
+                self._tables["allocs"].write(index, aid, None)
+                watch_items.add(Item(alloc=aid))
+                watch_items.add(Item(alloc_eval=existing.EvalID))
+                watch_items.add(Item(alloc_job=existing.JobID))
+                watch_items.add(Item(alloc_node=existing.NodeID))
+                events.append(("alloc", existing, None))
+            touched = self._set_job_statuses(index, watch_items, jobs,
+                                             eval_delete=True)
+            self._commit(index, ["evals", "allocs"] + touched, watch_items)
+            self._emit(events)
+
+    def upsert_allocs(self, index: int, allocs: List[Allocation]) -> None:
+        """(reference: state_store.go:792 UpsertAllocs) Used by the plan
+        applier; refreshes job statuses."""
+        with self._lock:
+            watch_items = Items()
+            jobs: Dict[str, str] = {}
+            events = []
+            for alloc in allocs:
+                existing = self._get("allocs", alloc.ID)
+                if existing is None:
+                    alloc.CreateIndex = index
+                    alloc.ModifyIndex = index
+                    alloc.AllocModifyIndex = index
+                else:
+                    alloc.CreateIndex = existing.CreateIndex
+                    alloc.ModifyIndex = index
+                    alloc.AllocModifyIndex = index
+                    # Keep client-reported state (server-side upsert must not
+                    # clobber what the client said).
+                    alloc.ClientStatus = existing.ClientStatus
+                    alloc.ClientDescription = existing.ClientDescription
+                    alloc.TaskStates = existing.TaskStates
+                self._tables["allocs"].write(index, alloc.ID, alloc)
+                self._member_add("alloc_node", alloc.NodeID, alloc.ID)
+                self._member_add("alloc_job", alloc.JobID, alloc.ID)
+                self._member_add("alloc_eval", alloc.EvalID, alloc.ID)
+                watch_items.add(Item(alloc=alloc.ID))
+                watch_items.add(Item(alloc_eval=alloc.EvalID))
+                watch_items.add(Item(alloc_job=alloc.JobID))
+                watch_items.add(Item(alloc_node=alloc.NodeID))
+                jobs.setdefault(alloc.JobID, "")
+                events.append(("alloc", existing, alloc))
+            touched = self._set_job_statuses(index, watch_items, jobs,
+                                             eval_delete=False)
+            self._commit(index, ["allocs"] + touched, watch_items)
+            self._emit(events)
+
+    def update_alloc_from_client(self, index: int, alloc: Allocation) -> None:
+        """Client status sync (reference: state_store.go UpdateAllocFromClient):
+        merges the client-reported fields into the server's copy."""
+        with self._lock:
+            existing = self._get("allocs", alloc.ID)
+            if existing is None:
+                raise KeyError(f"alloc not found: {alloc.ID}")
+            copy_alloc = existing.copy()
+            copy_alloc.ClientStatus = alloc.ClientStatus
+            copy_alloc.ClientDescription = alloc.ClientDescription
+            copy_alloc.TaskStates = alloc.TaskStates
+            copy_alloc.ModifyIndex = index
+            self._tables["allocs"].write(index, alloc.ID, copy_alloc)
+            watch_items = Items([
+                Item(alloc=alloc.ID),
+                Item(alloc_eval=copy_alloc.EvalID),
+                Item(alloc_job=copy_alloc.JobID),
+                Item(alloc_node=copy_alloc.NodeID),
+            ])
+            touched = self._set_job_statuses(index, watch_items,
+                                             {copy_alloc.JobID: ""},
+                                             eval_delete=False)
+            self._commit(index, ["allocs"] + touched, watch_items)
+            self._emit([("alloc", existing, copy_alloc)])
+
+    def upsert_periodic_launch(self, index: int, launch: PeriodicLaunch) -> None:
+        with self._lock:
+            existing = self._get("periodic_launch", launch.ID)
+            if existing is not None:
+                launch.CreateIndex = existing.CreateIndex
+            else:
+                launch.CreateIndex = index
+            launch.ModifyIndex = index
+            self._tables["periodic_launch"].write(index, launch.ID, launch)
+            self._commit(index, ["periodic_launch"], Items())
+
+    def delete_periodic_launch(self, index: int, job_id: str) -> None:
+        with self._lock:
+            if self._get("periodic_launch", job_id) is None:
+                raise KeyError(f"periodic launch not found: {job_id}")
+            self._tables["periodic_launch"].write(index, job_id, None)
+            self._commit(index, ["periodic_launch"], Items())
+
+    # --------------------------------------------------- derived job statuses
+    def _set_job_statuses(self, index: int, watch_items: Items,
+                          jobs: Dict[str, str], eval_delete: bool) -> List[str]:
+        """Recompute status for touched jobs (reference: state_store.go:1029).
+        Returns the list of extra tables touched."""
+        touched: List[str] = []
+        for job_id, force in jobs.items():
+            job = self._get("jobs", job_id)
+            if job is None:
+                continue
+            new_status = force or self._derive_job_status(job, eval_delete)
+            if job.Status == new_status:
+                continue
+            updated = job.copy()
+            updated.Status = new_status
+            updated.ModifyIndex = index
+            self._tables["jobs"].write(index, job_id, updated)
+            watch_items.add(Item(job=job_id))
+            touched.append("jobs")
+        return touched
+
+    def _derive_job_status(self, job: Job, eval_delete: bool) -> str:
+        """(reference: state_store.go:1097 getJobStatus)"""
+        has_alloc = False
+        for alloc in self._members("alloc_job", job.ID, "allocs"):
+            has_alloc = True
+            if not alloc.terminal_status():
+                return JobStatusRunning
+        has_eval = False
+        for ev in self._members("eval_job", job.ID, "evals"):
+            has_eval = True
+            if not ev.terminal_status():
+                return JobStatusPending
+        if eval_delete or has_eval or has_alloc:
+            return JobStatusDead
+        if job.is_periodic():
+            return JobStatusRunning
+        return JobStatusPending
+
+    # -------------------------------------------------------------- snapshots
+    def snapshot(self) -> "StateSnapshot":
+        """O(1) point-in-time snapshot pinned at the current index."""
+        with self._lock:
+            token = self._next_token
+            self._next_token += 1
+            watermark = self._latest_index
+            self._watermarks[token] = watermark
+            snap = StateSnapshot(self, watermark, token)
+            weakref.finalize(snap, self._release_snapshot, token)
+            return snap
+
+    def _release_snapshot(self, token: int) -> None:
+        with self._lock:
+            self._watermarks.pop(token, None)
+
+    def compact(self) -> None:
+        """Drop version history older than the oldest live snapshot."""
+        with self._lock:
+            min_mark = min(self._watermarks.values(), default=self._latest_index)
+            for name, table in self._tables.items():
+                dead = [k for k, chain in table.chains.items()
+                        if chain.compact(min_mark)]
+                for k in dead:
+                    del table.chains[k]
+            # Prune member sets whose objects are fully gone.
+            for index_name, (table_name, _) in _MEMBER_INDEXES.items():
+                chains = self._tables[table_name].chains
+                sets = self._members_sets(index_name)
+                for key in list(sets):
+                    sets[key] = {i for i in sets[key] if i in chains}
+                    if not sets[key]:
+                        del sets[key]
+
+    # ---------------------------------------------------------------- restore
+    def restore(self) -> "Restore":
+        return Restore(self)
+
+
+class StateSnapshot(_ReadAPI):
+    """Point-in-time read view resolved through the version chains."""
+
+    def __init__(self, store: StateStore, watermark: int, token: int):
+        self._store = store
+        self.watermark = watermark
+        self._token = token
+
+    def _get(self, table: str, key: str):
+        chain = self._store._tables[table].chains.get(key)
+        if chain is None:
+            return None
+        return chain.at(self.watermark)
+
+    def _iter(self, table: str):
+        with self._store._lock:
+            out = []
+            for chain in self._store._tables[table].chains.values():
+                v = chain.at(self.watermark)
+                if v is not None:
+                    out.append(v)
+            return out
+
+    def _members(self, index_name: str, key: str, table: str):
+        with self._store._lock:
+            ids = self._store._members_sets(index_name).get(key, ())
+            chains = self._store._tables[table].chains
+            out = []
+            for i in ids:
+                chain = chains.get(i)
+                if chain is None:
+                    continue
+                v = chain.at(self.watermark)
+                if v is not None:
+                    out.append(v)
+            return out
+
+    def get_index(self, table: str) -> int:
+        # Table indexes are monotone; clamp to the watermark.
+        return min(self._store.get_index(table), self.watermark)
+
+    def latest_index(self) -> int:
+        return self.watermark
+
+
+class Restore:
+    """Bulk loader used by FSM snapshot restore (reference: state_store.go
+    Restore/NodeRestore/JobRestore/...)."""
+
+    def __init__(self, store: StateStore):
+        self._store = store
+        self._max_index = 0
+
+    def _bump(self, index: int) -> None:
+        self._max_index = max(self._max_index, index)
+
+    def node_restore(self, node: Node) -> None:
+        self._store._tables["nodes"].write(node.ModifyIndex, node.ID, node)
+        self._bump(node.ModifyIndex)
+
+    def job_restore(self, job: Job) -> None:
+        self._store._tables["jobs"].write(job.ModifyIndex, job.ID, job)
+        self._bump(job.ModifyIndex)
+
+    def eval_restore(self, ev: Evaluation) -> None:
+        self._store._tables["evals"].write(ev.ModifyIndex, ev.ID, ev)
+        self._store._member_add("eval_job", ev.JobID, ev.ID)
+        self._bump(ev.ModifyIndex)
+
+    def alloc_restore(self, alloc: Allocation) -> None:
+        self._store._tables["allocs"].write(alloc.ModifyIndex, alloc.ID, alloc)
+        self._store._member_add("alloc_node", alloc.NodeID, alloc.ID)
+        self._store._member_add("alloc_job", alloc.JobID, alloc.ID)
+        self._store._member_add("alloc_eval", alloc.EvalID, alloc.ID)
+        self._bump(alloc.ModifyIndex)
+
+    def periodic_launch_restore(self, launch: PeriodicLaunch) -> None:
+        self._store._tables["periodic_launch"].write(launch.ModifyIndex,
+                                                     launch.ID, launch)
+        self._bump(launch.ModifyIndex)
+
+    def index_restore(self, table: str, index: int) -> None:
+        self._store._table_index[table] = index
+        self._bump(index)
+
+    def commit(self) -> None:
+        store = self._store
+        with store._lock:
+            if self._max_index > store._latest_index:
+                store._latest_index = self._max_index
+            for t in TABLES:
+                store._table_index.setdefault(t, 0)

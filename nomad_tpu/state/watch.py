@@ -1,0 +1,67 @@
+"""Watch items: fine-grained notification keys for blocking queries.
+
+(reference: nomad/watch/watch.go, nomad/state/notify.py analog)
+A watch Item identifies one thing to watch: a table, a specific object, or an
+object scoped to a relation (allocs of a node, evals of a job, ...).
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, Set
+
+
+@dataclass(frozen=True)
+class Item:
+    """One watchable key. Set exactly one field (or one scoped pair)."""
+
+    alloc: str = ""
+    alloc_eval: str = ""
+    alloc_job: str = ""
+    alloc_node: str = ""
+    eval: str = ""
+    job: str = ""
+    node: str = ""
+    table: str = ""
+
+
+class Items(set):
+    """A set of watch Items (reference: watch.Items)."""
+
+    def __init__(self, items: Iterable[Item] = ()):  # noqa: D401
+        super().__init__(items)
+
+    def add_item(self, item: Item) -> None:
+        self.add(item)
+
+
+class NotifyGroup:
+    """Fan-out notifications to registered waiters (reference: state/notify.go)."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._waiters: Dict[Item, Set[threading.Event]] = {}
+
+    def watch(self, items: Iterable[Item], event: threading.Event) -> None:
+        with self._lock:
+            for item in items:
+                self._waiters.setdefault(item, set()).add(event)
+
+    def stop_watch(self, items: Iterable[Item], event: threading.Event) -> None:
+        with self._lock:
+            for item in items:
+                waiters = self._waiters.get(item)
+                if waiters is not None:
+                    waiters.discard(event)
+                    if not waiters:
+                        self._waiters.pop(item, None)
+
+    def notify(self, items: Iterable[Item]) -> None:
+        with self._lock:
+            fired: Set[threading.Event] = set()
+            for item in items:
+                for ev in self._waiters.get(item, ()):
+                    fired.add(ev)
+        for ev in fired:
+            ev.set()

@@ -34,6 +34,8 @@ def allocs_fit(node: Node, allocs: List[Allocation],
         if alloc.Resources is not None:
             used.add(alloc.Resources)
             continue
+        if not alloc.TaskResources:
+            raise ValueError(f"allocation {alloc.ID} has no resources set")
         for task_res in alloc.TaskResources.values():
             used.add(task_res)
 
@@ -42,11 +44,14 @@ def allocs_fit(node: Node, allocs: List[Allocation],
     if not fit:
         return False, dim, used
 
-    # Network checks: build (or reuse) the index and look for overcommit.
+    # Network checks: build (or reuse) the index and look for port collisions
+    # and bandwidth overcommit.
     if net_idx is None:
         net_idx = NetworkIndex()
-        net_idx.set_node(node)
-        net_idx.add_allocs(allocs)
+        if net_idx.set_node(node):
+            return False, "reserved port collision", used
+        if net_idx.add_allocs(allocs):
+            return False, "reserved port collision", used
     if net_idx.overcommitted():
         return False, "bandwidth exhausted", used
 

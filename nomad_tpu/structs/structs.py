@@ -1074,9 +1074,15 @@ class Plan:
     Annotations: Optional["PlanAnnotations"] = None
 
     def append_update(self, alloc: Allocation, status: str, desc: str) -> None:
-        new_alloc = alloc.copy()
-        # Normalize the job on the allocation (strip to save plan size).
-        new_alloc.Job = None
+        # Strip the embedded job BEFORE copying: the plan carries the job
+        # once, and deep-copying it per evicted alloc would dominate plan
+        # construction cost on large jobs.
+        saved_job = alloc.Job
+        alloc.Job = None
+        try:
+            new_alloc = alloc.copy()
+        finally:
+            alloc.Job = saved_job
         new_alloc.DesiredStatus = status
         new_alloc.DesiredDescription = desc
         self.NodeUpdate.setdefault(alloc.NodeID, []).append(new_alloc)

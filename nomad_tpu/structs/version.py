@@ -48,7 +48,7 @@ class Version:
             return 1
         if not other.prerelease:
             return -1
-        return -1 if self.prerelease < other.prerelease else 1
+        return _cmp_prerelease(self.prerelease, other.prerelease)
 
     def __lt__(self, other):  # type: ignore[override]
         return self._cmp(other) < 0
@@ -61,6 +61,31 @@ class Version:
 
     def __ge__(self, other):  # type: ignore[override]
         return self._cmp(other) >= 0
+
+
+def _cmp_prerelease(a: str, b: str) -> int:
+    """Semver-style dot-segment comparison: numeric segments compare as
+    integers (rc.9 < rc.10), numeric < alphanumeric, shorter < longer."""
+    for sa, sb in zip(a.split("."), b.split(".")):
+        na, nb = sa.isdigit(), sb.isdigit()
+        if na and nb:
+            ia, ib = int(sa), int(sb)
+            if ia != ib:
+                return -1 if ia < ib else 1
+        elif na != nb:
+            return -1 if na else 1
+        elif sa != sb:
+            # Compare embedded trailing numbers numerically (rc10 vs rc9).
+            ma = re.match(r"^(\D*)(\d*)$", sa)
+            mb = re.match(r"^(\D*)(\d*)$", sb)
+            if (ma and mb and ma.group(1) == mb.group(1)
+                    and ma.group(2) and mb.group(2)):
+                return -1 if int(ma.group(2)) < int(mb.group(2)) else 1
+            return -1 if sa < sb else 1
+    la, lb = len(a.split(".")), len(b.split("."))
+    if la != lb:
+        return -1 if la < lb else 1
+    return 0
 
 
 @dataclass(frozen=True)

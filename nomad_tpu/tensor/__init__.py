@@ -1,0 +1,16 @@
+"""Node-table tensorization: the bridge from the state store to XLA.
+
+This layer is the TPU-first core of the design. The reference walks per-node
+Go iterators (reference: scheduler/feasible.go, scheduler/rank.go); here the
+node table lives as device-resident arrays ([N, R] capacity/usage, [N] class
+and datacenter ids) updated incrementally as the FSM applies writes, and
+feasibility/scoring run as one vectorized XLA program over the whole node
+axis (nomad_tpu/scheduler/kernels.py). String-typed constraint work (regex,
+versions) happens host-side once per computed node class — classes are few —
+and is gathered across the node axis (reference optimization:
+scheduler/feasible.go:454-568 re-expressed as tensor compression).
+"""
+
+from .node_table import NodeTensor, RES_DIMS, alloc_vec, resources_vec  # noqa: F401
+from .constraints import ClassEligibility, check_constraint, resolve_target  # noqa: F401
+from .index import TensorIndex  # noqa: F401

@@ -1,0 +1,72 @@
+"""TensorIndex: keeps the device-resident NodeTensor in sync with the store.
+
+Subscribes to StateStore change events and applies delta updates (node
+upserts, alloc usage transitions) to the NodeTensor — the tensor analogue of
+go-memdb's indexing, and the mechanism that keeps scheduling from ever
+re-shipping the full node table to the device (SURVEY §7.3).
+
+An alloc contributes usage while non-terminal; transitions are derived from
+(old, new) pairs so the accounting is exact.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from nomad_tpu.state.state_store import StateStore
+from nomad_tpu.structs import Allocation, Node
+
+from .node_table import NodeTensor
+
+
+class TensorIndex:
+    def __init__(self, nt: Optional[NodeTensor] = None):
+        self.nt = nt or NodeTensor()
+
+    @staticmethod
+    def attach(store: StateStore) -> "TensorIndex":
+        """Production mode: subscribe to store changes and stay in sync."""
+        idx = TensorIndex()
+        for node in store.nodes():
+            idx.nt.upsert_node(node)
+        for alloc in store.allocs():
+            if not alloc.terminal_status():
+                idx.nt.add_alloc_usage(alloc)
+        store.add_change_listener(idx._on_change)
+        return idx
+
+    @staticmethod
+    def from_state(state) -> "TensorIndex":
+        """One-shot build from any read API (snapshot) — test/simple mode."""
+        idx = TensorIndex()
+        for node in state.nodes():
+            idx.nt.upsert_node(node)
+        for alloc in state.allocs():
+            if not alloc.terminal_status():
+                idx.nt.add_alloc_usage(alloc)
+        return idx
+
+    def _on_change(self, kind: str, old, new) -> None:
+        if kind == "node":
+            self._on_node(old, new)
+        elif kind == "alloc":
+            self._on_alloc(old, new)
+
+    def _on_node(self, old: Optional[Node], new: Optional[Node]) -> None:
+        if new is None:
+            if old is not None:
+                self.nt.remove_node(old.ID)
+            return
+        self.nt.upsert_node(new)
+
+    def _on_alloc(self, old: Optional[Allocation], new: Optional[Allocation]) -> None:
+        was_counted = old is not None and not old.terminal_status()
+        now_counted = new is not None and not new.terminal_status()
+        if was_counted and not now_counted:
+            self.nt.remove_alloc_usage(old)
+        elif not was_counted and now_counted:
+            self.nt.add_alloc_usage(new)
+        elif was_counted and now_counted:
+            # Resources may have changed (in-place update): re-account.
+            self.nt.remove_alloc_usage(old)
+            self.nt.add_alloc_usage(new)

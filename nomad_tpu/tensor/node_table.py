@@ -1,0 +1,240 @@
+"""Device-resident node table with incremental updates.
+
+Columns (float32, resource dims R=5): cpu MHz, memory MB, disk MB, iops,
+network mbits. Three persistent arrays:
+
+  capacity  [N, R]  total node resources (the fit bound — reserved counts as
+                    usage, matching reference AllocsFit, funcs.go:44-100)
+  score_cap [N, 2]  (cpu, mem) minus reserved — the ScoreFit denominator
+                    (funcs.go:105-117)
+  usage     [N, R]  reserved + sum of non-terminal committed allocs
+
+Rows are stable per node for the node's lifetime (free-list reuse), the array
+is padded to power-of-two buckets so jit caches stay warm, and host numpy
+mirrors are authoritative: device copies are refreshed by row-scatter of dirty
+rows just before a scheduling kernel runs (SURVEY §7.3: keep the node tensor
+resident, delta-scatter updates, never re-ship the table).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+import numpy as np
+
+from nomad_tpu.structs import Allocation, Node, Resources
+from nomad_tpu.structs.structs import NodeStatusReady
+
+RES_DIMS = 5  # cpu, mem, disk, iops, mbits
+DIM_NAMES = ("cpu", "memory", "disk", "iops", "bandwidth")
+_MIN_CAP = 64
+
+
+def resources_vec(r: Optional[Resources]) -> np.ndarray:
+    out = np.zeros(RES_DIMS, dtype=np.float32)
+    if r is None:
+        return out
+    out[0] = r.CPU
+    out[1] = r.MemoryMB
+    out[2] = r.DiskMB
+    out[3] = r.IOPS
+    out[4] = sum(n.MBits for n in r.Networks)
+    return out
+
+
+def alloc_vec(alloc: Allocation) -> np.ndarray:
+    if alloc.Resources is not None:
+        return resources_vec(alloc.Resources)
+    out = np.zeros(RES_DIMS, dtype=np.float32)
+    for r in alloc.TaskResources.values():
+        out += resources_vec(r)
+    return out
+
+
+class NodeTensor:
+    """Mutable host mirror + lazily synced device arrays of the node table."""
+
+    def __init__(self, capacity_hint: int = _MIN_CAP):
+        n = max(_MIN_CAP, _next_pow2(capacity_hint))
+        self._lock = threading.RLock()
+        self.n_rows = n
+        self.capacity = np.zeros((n, RES_DIMS), dtype=np.float32)
+        self.score_cap = np.ones((n, 2), dtype=np.float32)  # avoid div-by-0
+        self.usage = np.zeros((n, RES_DIMS), dtype=np.float32)
+        self.ready = np.zeros(n, dtype=bool)
+        self.class_ids = np.zeros(n, dtype=np.int32)
+        self.dc_ids = np.full(n, -1, dtype=np.int32)
+
+        self.row_of: Dict[str, int] = {}
+        self.node_of: List[Optional[str]] = [None] * n
+        self._free: List[int] = list(range(n - 1, -1, -1))
+        self._reserved_cache: Dict[str, np.ndarray] = {}
+
+        # Vocabularies
+        self.class_vocab: Dict[str, int] = {}
+        self.class_names: List[str] = []
+        self.dc_vocab: Dict[str, int] = {}
+        self.dc_names: List[str] = []
+
+        # Device sync state
+        self._dirty_rows: Set[int] = set()
+        self._resized = True
+        self._device: Optional[dict] = None
+
+    # ------------------------------------------------------------- vocab
+    def class_id(self, computed_class: str) -> int:
+        cid = self.class_vocab.get(computed_class)
+        if cid is None:
+            cid = len(self.class_names)
+            self.class_vocab[computed_class] = cid
+            self.class_names.append(computed_class)
+        return cid
+
+    def dc_id(self, dc: str) -> int:
+        did = self.dc_vocab.get(dc)
+        if did is None:
+            did = len(self.dc_names)
+            self.dc_vocab[dc] = did
+            self.dc_names.append(dc)
+        return did
+
+    # ------------------------------------------------------------ updates
+    def upsert_node(self, node: Node) -> None:
+        with self._lock:
+            row = self.row_of.get(node.ID)
+            if row is None:
+                row = self._alloc_row()
+                self.row_of[node.ID] = row
+                self.node_of[row] = node.ID
+                self.usage[row] = 0.0
+            cap = resources_vec(node.Resources)
+            reserved = resources_vec(node.Reserved)
+            self.capacity[row] = cap
+            # ScoreFit denominator: total minus reserved for cpu/mem. May be
+            # zero; the kernel reproduces Go's Inf/NaN division semantics.
+            self.score_cap[row] = cap[:2] - reserved[:2]
+            # Reserved is baseline usage; preserve the alloc-usage component.
+            self.usage[row] = self.usage[row] - self._reserved_of(node.ID) + reserved
+            self._reserved_cache[node.ID] = reserved
+            self.ready[row] = (node.Status == NodeStatusReady) and not node.Drain
+            self.class_ids[row] = self.class_id(node.ComputedClass)
+            self.dc_ids[row] = self.dc_id(node.Datacenter)
+            self._dirty_rows.add(row)
+
+    def _reserved_of(self, node_id: str) -> np.ndarray:
+        return self._reserved_cache.get(node_id, np.zeros(RES_DIMS, dtype=np.float32))
+
+    def set_node_readiness(self, node_id: str, ready: bool) -> None:
+        with self._lock:
+            row = self.row_of.get(node_id)
+            if row is None:
+                return
+            self.ready[row] = ready
+            self._dirty_rows.add(row)
+
+    def remove_node(self, node_id: str) -> None:
+        with self._lock:
+            row = self.row_of.pop(node_id, None)
+            if row is None:
+                return
+            self.node_of[row] = None
+            self.capacity[row] = 0.0
+            self.score_cap[row] = 1.0
+            self.usage[row] = 0.0
+            self.ready[row] = False
+            self.dc_ids[row] = -1
+            self._free.append(row)
+            self._dirty_rows.add(row)
+            self._reserved_cache.pop(node_id, None)
+
+    def add_alloc_usage(self, alloc: Allocation) -> None:
+        self._apply_usage(alloc, +1.0)
+
+    def remove_alloc_usage(self, alloc: Allocation) -> None:
+        self._apply_usage(alloc, -1.0)
+
+    def _apply_usage(self, alloc: Allocation, sign: float) -> None:
+        with self._lock:
+            row = self.row_of.get(alloc.NodeID)
+            if row is None:
+                return
+            self.usage[row] += sign * alloc_vec(alloc)
+            self._dirty_rows.add(row)
+
+    # ------------------------------------------------------------ row mgmt
+    def _alloc_row(self) -> int:
+        if not self._free:
+            self._grow()
+        return self._free.pop()
+
+    def _grow(self) -> None:
+        old = self.n_rows
+        new = old * 2
+        self.capacity = _grow2(self.capacity, new)
+        self.score_cap = _grow2(self.score_cap, new, fill=1.0)
+        self.usage = _grow2(self.usage, new)
+        self.ready = _grow1(self.ready, new, fill=False)
+        self.class_ids = _grow1(self.class_ids, new, fill=0)
+        self.dc_ids = _grow1(self.dc_ids, new, fill=-1)
+        self.node_of.extend([None] * (new - old))
+        self._free.extend(range(new - 1, old - 1, -1))
+        self.n_rows = new
+        self._resized = True
+
+    # --------------------------------------------------------- device sync
+    def device_arrays(self) -> dict:
+        """Return jax device arrays, refreshing dirty rows via scatter."""
+        import jax.numpy as jnp
+
+        with self._lock:
+            if self._device is None or self._resized:
+                self._device = {
+                    "capacity": jnp.asarray(self.capacity),
+                    "score_cap": jnp.asarray(self.score_cap),
+                    "usage": jnp.asarray(self.usage),
+                }
+                self._resized = False
+                self._dirty_rows.clear()
+            elif self._dirty_rows:
+                rows = np.fromiter(self._dirty_rows, dtype=np.int32)
+                d = self._device
+                d["capacity"] = d["capacity"].at[rows].set(self.capacity[rows])
+                d["score_cap"] = d["score_cap"].at[rows].set(self.score_cap[rows])
+                d["usage"] = d["usage"].at[rows].set(self.usage[rows])
+                self._dirty_rows.clear()
+            return dict(self._device)
+
+    # ------------------------------------------------------------- queries
+    def rows_for(self, node_ids: Sequence[str]) -> np.ndarray:
+        return np.array([self.row_of[i] for i in node_ids], dtype=np.int32)
+
+    def eligibility_mask(self, dc_ids: Sequence[int],
+                        class_ok: Optional[np.ndarray]) -> np.ndarray:
+        """ready & datacenter-membership & per-class eligibility, as [N] bool."""
+        with self._lock:
+            mask = self.ready.copy()
+            if dc_ids is not None:
+                mask &= np.isin(self.dc_ids, np.asarray(list(dc_ids), dtype=np.int32))
+            if class_ok is not None:
+                mask &= class_ok[self.class_ids]
+            return mask
+
+
+def _next_pow2(n: int) -> int:
+    p = 1
+    while p < n:
+        p *= 2
+    return p
+
+
+def _grow2(a: np.ndarray, n: int, fill: float = 0.0) -> np.ndarray:
+    out = np.full((n, a.shape[1]), fill, dtype=a.dtype)
+    out[: a.shape[0]] = a
+    return out
+
+
+def _grow1(a: np.ndarray, n: int, fill) -> np.ndarray:
+    out = np.full(n, fill, dtype=a.dtype)
+    out[: a.shape[0]] = a
+    return out

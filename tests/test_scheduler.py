@@ -1,0 +1,399 @@
+"""Scheduler scenario tests (shaped after reference
+scheduler/generic_sched_test.go and system_sched_test.go)."""
+
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.scheduler import Harness
+from nomad_tpu.structs import Constraint, Resources
+from nomad_tpu.structs.structs import (
+    AllocDesiredStatusRun,
+    AllocDesiredStatusStop,
+    EvalStatusBlocked,
+    EvalStatusComplete,
+    EvalStatusPending,
+    EvalTriggerJobRegister,
+    EvalTriggerNodeUpdate,
+    EvalTriggerRollingUpdate,
+    JobTypeBatch,
+    NodeStatusDown,
+)
+
+
+def make_eval(job, trigger=EvalTriggerJobRegister, status=EvalStatusPending):
+    ev = mock.eval()
+    ev.JobID = job.ID
+    ev.Type = job.Type
+    ev.TriggeredBy = trigger
+    ev.Status = status
+    return ev
+
+
+class TestServiceSched:
+    def test_job_register(self):
+        """10 nodes, count-10 job: all placed, spread 1/node by anti-affinity
+        (reference: TestServiceSched_JobRegister)."""
+        h = Harness()
+        for _ in range(10):
+            h.upsert("node", mock.node())
+        job = mock.job()
+        h.upsert("job", job)
+        ev = make_eval(job)
+        h.process("service", ev)
+
+        assert len(h.plans) == 1
+        plan = h.plans[0]
+        placed = [a for allocs in plan.NodeAllocation.values() for a in allocs]
+        assert len(placed) == 10
+        # Anti-affinity spreads across all 10 nodes.
+        assert len(plan.NodeAllocation) == 10
+        assert h.evals[-1].Status == EvalStatusComplete
+        # Names follow job.tg[i] materialization.
+        names = {a.Name for a in placed}
+        assert names == {f"{job.Name}.web[{i}]" for i in range(10)}
+        # Allocs landed in the store.
+        assert len(h.state.allocs_by_job(job.ID)) == 10
+
+    def test_no_nodes_blocked_eval(self):
+        """No nodes: failed placement creates a blocked eval
+        (reference: TestServiceSched_JobRegister_BlockedEval)."""
+        h = Harness()
+        job = mock.job()
+        h.upsert("job", job)
+        ev = make_eval(job)
+        h.process("service", ev)
+        assert len(h.creates) == 1
+        blocked = h.creates[0]
+        assert blocked.Status == EvalStatusBlocked
+        assert blocked.PreviousEval == ev.ID
+        final = h.evals[-1]
+        assert final.Status == EvalStatusComplete
+        assert final.BlockedEval == blocked.ID
+        assert "web" in final.FailedTGAllocs
+        # No plan submitted (no-op).
+        assert h.plans == []
+
+    def test_exhausted_resources_partial(self):
+        """Nodes can hold some but not all instances: partial placement +
+        blocked eval with CoalescedFailures."""
+        h = Harness()
+        node = mock.node()  # 4000 CPU, 8192 MB; reserved 100/256
+        h.upsert("node", node)
+        job = mock.job()
+        # Each instance wants 1500 CPU: only 2 fit ((4000-100) // 1500).
+        job.TaskGroups[0].Tasks[0].Resources.CPU = 1500
+        job.TaskGroups[0].Count = 5
+        h.upsert("job", job)
+        ev = make_eval(job)
+        h.process("service", ev)
+        placed = [a for p in h.plans for allocs in p.NodeAllocation.values()
+                  for a in allocs]
+        assert len(placed) == 2
+        final = h.evals[-1]
+        assert final.FailedTGAllocs["web"].CoalescedFailures == 2  # 3 failed: 1 + 2 coalesced
+        assert len(h.creates) == 1
+
+    def test_constraint_filters_nodes(self):
+        h = Harness()
+        good = mock.node()
+        h.upsert("node", good)
+        bad = mock.node()
+        bad.Attributes["kernel.name"] = "windows"
+        from nomad_tpu.structs import compute_node_class
+        compute_node_class(bad)
+        h.upsert("node", bad)
+        job = mock.job()  # constraint kernel.name = linux
+        job.TaskGroups[0].Count = 2
+        h.upsert("job", job)
+        h.process("service", make_eval(job))
+        placed = [a for p in h.plans for allocs in p.NodeAllocation.values()
+                  for a in allocs]
+        assert len(placed) == 2
+        assert all(a.NodeID == good.ID for a in placed)
+
+    def test_distinct_hosts(self):
+        h = Harness()
+        for _ in range(3):
+            h.upsert("node", mock.node())
+        job = mock.job()
+        job.Constraints.append(Constraint(Operand="distinct_hosts"))
+        job.TaskGroups[0].Count = 5
+        h.upsert("job", job)
+        h.process("service", make_eval(job))
+        placed = [a for p in h.plans for allocs in p.NodeAllocation.values()
+                  for a in allocs]
+        # Only 3 hosts: 3 placed on distinct nodes, 2 fail.
+        assert len(placed) == 3
+        assert len({a.NodeID for a in placed}) == 3
+        assert h.evals[-1].FailedTGAllocs["web"].CoalescedFailures == 1
+
+    def test_drain_migrates(self):
+        """Draining node migrates its allocs (reference:
+        TestServiceSched_NodeDrain)."""
+        h = Harness()
+        draining = mock.node()
+        draining.Drain = True
+        h.upsert("node", draining)
+        target = mock.node()
+        h.upsert("node", target)
+        job = mock.job()
+        job.TaskGroups[0].Count = 2
+        h.upsert("job", job)
+        allocs = []
+        for i in range(2):
+            a = mock.alloc()
+            a.Job = h.state.job_by_id(job.ID)
+            a.JobID = job.ID
+            a.NodeID = draining.ID
+            a.Name = f"{job.Name}.web[{i}]"
+            allocs.append(a)
+        h.upsert("allocs", allocs)
+        ev = make_eval(job, trigger=EvalTriggerNodeUpdate)
+        h.process("service", ev)
+        plan = h.plans[0]
+        stops = [a for allocs in plan.NodeUpdate.values() for a in allocs]
+        assert len(stops) == 2
+        assert all(a.DesiredStatus == AllocDesiredStatusStop for a in stops)
+        placed = [a for allocs in plan.NodeAllocation.values() for a in allocs]
+        assert len(placed) == 2
+        assert all(a.NodeID == target.ID for a in placed)
+
+    def test_job_deregister_stops_allocs(self):
+        """Job removed: all allocs stopped (reference:
+        TestServiceSched_JobDeregister)."""
+        h = Harness()
+        node = mock.node()
+        h.upsert("node", node)
+        job = mock.job()
+        allocs = []
+        for i in range(5):
+            a = mock.alloc()
+            a.Job = job
+            a.JobID = job.ID
+            a.NodeID = node.ID
+            a.Name = f"{job.Name}.web[{i}]"
+            allocs.append(a)
+        h.upsert("allocs", allocs)
+        from nomad_tpu.structs.structs import EvalTriggerJobDeregister
+        ev = make_eval(job, trigger=EvalTriggerJobDeregister)
+        h.process("service", ev)
+        plan = h.plans[0]
+        stops = [a for allocs in plan.NodeUpdate.values() for a in allocs]
+        assert len(stops) == 5
+        assert h.evals[-1].Status == EvalStatusComplete
+
+    def test_inplace_update(self):
+        """Job tweak that doesn't change tasks updates in place
+        (reference: TestServiceSched_JobModify_InPlace)."""
+        h = Harness()
+        node = mock.node()
+        h.upsert("node", node)
+        job = mock.job()
+        job.TaskGroups[0].Count = 2
+        h.upsert("job", job)
+        stored_job = h.state.job_by_id(job.ID)
+        allocs = []
+        for i in range(2):
+            a = mock.alloc()
+            a.Job = stored_job
+            a.JobID = job.ID
+            a.NodeID = node.ID
+            a.Name = f"{job.Name}.web[{i}]"
+            allocs.append(a)
+        h.upsert("allocs", allocs)
+        # Re-register with a non-task change (priority): JobModifyIndex bumps.
+        job2 = stored_job.copy()
+        job2.Priority = 60
+        h.upsert("job", job2)
+        ev = make_eval(job2)
+        h.process("service", ev)
+        plan = h.plans[0]
+        placed = [a for allocs in plan.NodeAllocation.values() for a in allocs]
+        assert len(placed) == 2
+        # In-place: no evictions in the final plan, same alloc IDs kept.
+        stops = [a for allocs in plan.NodeUpdate.values() for a in allocs]
+        assert stops == []
+        assert {a.ID for a in placed} == {a.ID for a in allocs}
+
+    def test_destructive_update(self):
+        """Task config change forces stop + replace
+        (reference: TestServiceSched_JobModify)."""
+        h = Harness()
+        node = mock.node()
+        h.upsert("node", node)
+        job = mock.job()
+        job.TaskGroups[0].Count = 2
+        h.upsert("job", job)
+        stored_job = h.state.job_by_id(job.ID)
+        allocs = []
+        for i in range(2):
+            a = mock.alloc()
+            a.Job = stored_job
+            a.JobID = job.ID
+            a.NodeID = node.ID
+            a.Name = f"{job.Name}.web[{i}]"
+            allocs.append(a)
+        h.upsert("allocs", allocs)
+        job2 = stored_job.copy()
+        job2.TaskGroups[0].Tasks[0].Config = {"command": "/bin/other"}
+        h.upsert("job", job2)
+        h.process("service", make_eval(job2))
+        plan = h.plans[0]
+        stops = [a for allocs in plan.NodeUpdate.values() for a in allocs]
+        placed = [a for allocs in plan.NodeAllocation.values() for a in allocs]
+        assert len(stops) == 2
+        assert len(placed) == 2
+        assert {a.ID for a in placed}.isdisjoint({a.ID for a in stops})
+
+    def test_rolling_update_limit(self):
+        """MaxParallel caps destructive updates per pass and spawns a
+        follow-up eval (reference: TestServiceSched_JobModify_Rolling)."""
+        h = Harness()
+        node = mock.node()
+        node.Resources = Resources(CPU=40000, MemoryMB=81920, DiskMB=1024*1024,
+                                   IOPS=5000,
+                                   Networks=node.Resources.Networks)
+        h.upsert("node", node)
+        job = mock.job()
+        job.TaskGroups[0].Count = 10
+        h.upsert("job", job)
+        stored_job = h.state.job_by_id(job.ID)
+        allocs = []
+        for i in range(10):
+            a = mock.alloc()
+            a.Job = stored_job
+            a.JobID = job.ID
+            a.NodeID = node.ID
+            a.Name = f"{job.Name}.web[{i}]"
+            allocs.append(a)
+        h.upsert("allocs", allocs)
+        job2 = stored_job.copy()
+        job2.TaskGroups[0].Tasks[0].Config = {"command": "/bin/other"}
+        job2.Update.Stagger = 30 * 10**9
+        job2.Update.MaxParallel = 3
+        h.upsert("job", job2)
+        h.process("service", make_eval(job2))
+        plan = h.plans[0]
+        stops = [a for allocs in plan.NodeUpdate.values() for a in allocs]
+        assert len(stops) == 3
+        # Follow-up rolling eval created.
+        rolling = [e for e in h.creates
+                   if e.TriggeredBy == EvalTriggerRollingUpdate]
+        assert len(rolling) == 1
+        assert rolling[0].Wait == 30 * 10**9
+
+    def test_batch_ignores_complete(self):
+        """Batch allocs that ran successfully are not replaced
+        (reference: TestGenericSched_FilterCompleteAllocs)."""
+        h = Harness()
+        node = mock.node()
+        h.upsert("node", node)
+        job = mock.job()
+        job.Type = JobTypeBatch
+        job.TaskGroups[0].Count = 1
+        h.upsert("job", job)
+        stored_job = h.state.job_by_id(job.ID)
+        from nomad_tpu.structs import TaskState, TaskEvent
+        from nomad_tpu.structs.structs import (
+            AllocClientStatusComplete, TaskStateDead, TaskTerminated)
+        a = mock.alloc()
+        a.Job = stored_job
+        a.JobID = job.ID
+        a.NodeID = node.ID
+        a.Name = f"{job.Name}.web[0]"
+        a.ClientStatus = AllocClientStatusComplete
+        a.TaskStates = {"web": TaskState(
+            State=TaskStateDead,
+            Events=[TaskEvent(Type=TaskTerminated, ExitCode=0)])}
+        h.upsert("allocs", [a])
+        h.process("batch", make_eval(job))
+        # Nothing to do: the work already finished.
+        placed = [x for p in h.plans for allocs in p.NodeAllocation.values()
+                  for x in allocs]
+        assert placed == []
+        assert h.evals[-1].Status == EvalStatusComplete
+
+    def test_annotate_plan(self):
+        h = Harness()
+        h.upsert("node", mock.node())
+        job = mock.job()
+        job.TaskGroups[0].Count = 3
+        h.upsert("job", job)
+        ev = make_eval(job)
+        ev.AnnotatePlan = True
+        h.process("service", ev)
+        plan = h.plans[0]
+        assert plan.Annotations is not None
+        des = plan.Annotations.DesiredTGUpdates["web"]
+        assert des.Place == 3
+
+    def test_plan_rejection_retries_then_blocks(self):
+        """Rejected plans exhaust attempts -> failed status + blocked eval
+        (reference: testing.go RejectPlan usage)."""
+        h = Harness()
+        h.upsert("node", mock.node())
+        job = mock.job()
+        job.TaskGroups[0].Count = 1
+        h.upsert("job", job)
+        h.reject_plan = True
+        h.process("service", make_eval(job))
+        final = h.evals[-1]
+        assert final.Status == "failed"
+        assert any(e.TriggeredBy == "max-plan-attempts" for e in h.creates)
+
+
+class TestSystemSched:
+    def test_register_places_on_all_nodes(self):
+        """(reference: TestSystemSched_JobRegister)"""
+        h = Harness()
+        nodes = [mock.node() for _ in range(10)]
+        for n in nodes:
+            h.upsert("node", n)
+        job = mock.system_job()
+        h.upsert("job", job)
+        ev = make_eval(job)
+        h.process("system", ev)
+        plan = h.plans[0]
+        placed = [a for allocs in plan.NodeAllocation.values() for a in allocs]
+        assert len(placed) == 10
+        assert {a.NodeID for a in placed} == {n.ID for n in nodes}
+        assert h.evals[-1].Status == EvalStatusComplete
+
+    def test_down_node_stops_alloc(self):
+        """(reference: TestSystemSched_NodeDown)"""
+        h = Harness()
+        node = mock.node()
+        h.upsert("node", node)
+        job = mock.system_job()
+        h.upsert("job", job)
+        stored_job = h.state.job_by_id(job.ID)
+        a = mock.alloc()
+        a.Job = stored_job
+        a.JobID = job.ID
+        a.NodeID = node.ID
+        a.Name = f"{job.Name}.web[0]"
+        h.upsert("allocs", [a])
+        h.state.update_node_status(h._next_index(), node.ID, NodeStatusDown)
+        ev = make_eval(job, trigger=EvalTriggerNodeUpdate)
+        h.process("system", ev)
+        plan = h.plans[0]
+        stops = [x for allocs in plan.NodeUpdate.values() for x in allocs]
+        assert len(stops) == 1
+        assert stops[0].ID == a.ID
+
+    def test_constraints_respected(self):
+        h = Harness()
+        good = mock.node()
+        h.upsert("node", good)
+        bad = mock.node()
+        bad.Attributes["kernel.name"] = "darwin"
+        from nomad_tpu.structs import compute_node_class
+        compute_node_class(bad)
+        h.upsert("node", bad)
+        job = mock.system_job()
+        h.upsert("job", job)
+        h.process("system", make_eval(job))
+        placed = [a for p in h.plans for allocs in p.NodeAllocation.values()
+                  for a in allocs]
+        assert len(placed) == 1
+        assert placed[0].NodeID == good.ID

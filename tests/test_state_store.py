@@ -1,0 +1,337 @@
+"""State store tests (shaped after reference nomad/state/state_store_test.go:
+every mutation asserts results AND watch firing)."""
+
+import threading
+
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.state.state_store import StateStore
+from nomad_tpu.state.watch import Item
+from nomad_tpu.structs.structs import (
+    AllocClientStatusComplete,
+    AllocClientStatusFailed,
+    AllocClientStatusRunning,
+    AllocDesiredStatusStop,
+    EvalStatusComplete,
+    JobStatusDead,
+    JobStatusPending,
+    JobStatusRunning,
+    NodeStatusDown,
+    NodeStatusReady,
+)
+from nomad_tpu.structs import PeriodicLaunch, TaskState
+
+
+class WatchAsserter:
+    """Registers on items and asserts which fired (reference: notifyTest)."""
+
+    def __init__(self, store, *items):
+        self.store = store
+        self.events = {item: threading.Event() for item in items}
+        for item, ev in self.events.items():
+            store.watch([item], ev)
+
+    def assert_fired(self, *items):
+        for item in items:
+            assert self.events[item].is_set(), f"watch did not fire: {item}"
+
+    def assert_not_fired(self, *items):
+        for item in items:
+            assert not self.events[item].is_set(), f"watch fired: {item}"
+
+
+class TestNodes:
+    def test_upsert_get_delete(self):
+        s = StateStore()
+        n = mock.node()
+        w = WatchAsserter(s, Item(table="nodes"), Item(node=n.ID))
+        s.upsert_node(1000, n)
+        w.assert_fired(Item(table="nodes"), Item(node=n.ID))
+        out = s.node_by_id(n.ID)
+        assert out.CreateIndex == 1000 and out.ModifyIndex == 1000
+        assert s.get_index("nodes") == 1000
+        s.delete_node(1001, n.ID)
+        assert s.node_by_id(n.ID) is None
+        assert s.get_index("nodes") == 1001
+
+    def test_update_status_and_drain(self):
+        s = StateStore()
+        n = mock.node()
+        s.upsert_node(1, n)
+        s.update_node_status(2, n.ID, NodeStatusDown)
+        assert s.node_by_id(n.ID).Status == NodeStatusDown
+        s.update_node_drain(3, n.ID, True)
+        out = s.node_by_id(n.ID)
+        assert out.Drain is True and out.ModifyIndex == 3
+
+    def test_missing_node_raises(self):
+        s = StateStore()
+        with pytest.raises(KeyError):
+            s.update_node_status(2, "nope", NodeStatusReady)
+
+    def test_upsert_preserves_create_index(self):
+        s = StateStore()
+        n = mock.node()
+        s.upsert_node(5, n)
+        n2 = n.copy()
+        s.upsert_node(9, n2)
+        assert s.node_by_id(n.ID).CreateIndex == 5
+        assert s.node_by_id(n.ID).ModifyIndex == 9
+
+
+class TestJobs:
+    def test_upsert_job_status_derivation(self):
+        s = StateStore()
+        j = mock.job()
+        w = WatchAsserter(s, Item(table="jobs"), Item(job=j.ID))
+        s.upsert_job(1, j)
+        w.assert_fired(Item(table="jobs"), Item(job=j.ID))
+        assert s.job_by_id(j.ID).Status == JobStatusPending
+
+    def test_periodic_job_running(self):
+        s = StateStore()
+        j = mock.periodic_job()
+        s.upsert_job(1, j)
+        assert s.job_by_id(j.ID).Status == JobStatusRunning
+
+    def test_job_running_with_alloc(self):
+        s = StateStore()
+        j = mock.job()
+        s.upsert_job(1, j)
+        a = mock.alloc()
+        a.JobID = j.ID
+        a.Job = j
+        s.upsert_allocs(2, [a])
+        assert s.job_by_id(j.ID).Status == JobStatusRunning
+
+    def test_job_dead_when_all_terminal(self):
+        s = StateStore()
+        j = mock.job()
+        s.upsert_job(1, j)
+        a = mock.alloc()
+        a.JobID = j.ID
+        s.upsert_allocs(2, [a])
+        done = s.alloc_by_id(a.ID).copy()
+        done.ClientStatus = AllocClientStatusComplete
+        s.update_alloc_from_client(3, done)
+        assert s.job_by_id(j.ID).Status == JobStatusDead
+
+    def test_delete_job(self):
+        s = StateStore()
+        j = mock.job()
+        s.upsert_job(1, j)
+        s.delete_job(2, j.ID)
+        assert s.job_by_id(j.ID) is None
+        with pytest.raises(KeyError):
+            s.delete_job(3, j.ID)
+
+    def test_jobs_by_scheduler_and_periodic(self):
+        s = StateStore()
+        j1, j2 = mock.job(), mock.system_job()
+        j3 = mock.periodic_job()
+        for i, j in enumerate([j1, j2, j3]):
+            s.upsert_job(i + 1, j)
+        assert {j.ID for j in s.jobs_by_scheduler("service")} == {j1.ID}
+        assert {j.ID for j in s.jobs_by_scheduler("system")} == {j2.ID}
+        assert {j.ID for j in s.jobs_by_periodic(True)} == {j3.ID}
+
+
+class TestEvals:
+    def test_upsert_and_by_job(self):
+        s = StateStore()
+        e = mock.eval()
+        w = WatchAsserter(s, Item(table="evals"), Item(eval=e.ID))
+        s.upsert_evals(100, [e])
+        w.assert_fired(Item(table="evals"), Item(eval=e.ID))
+        assert s.eval_by_id(e.ID).CreateIndex == 100
+        assert [x.ID for x in s.evals_by_job(e.JobID)] == [e.ID]
+
+    def test_eval_makes_job_pending(self):
+        s = StateStore()
+        j = mock.job()
+        s.upsert_job(1, j)
+        a = mock.alloc()
+        a.JobID = j.ID
+        s.upsert_allocs(2, [a])
+        assert s.job_by_id(j.ID).Status == JobStatusRunning
+        # Terminal alloc + fresh pending eval -> pending again
+        done = s.alloc_by_id(a.ID).copy()
+        done.ClientStatus = AllocClientStatusFailed
+        s.update_alloc_from_client(3, done)
+        e = mock.eval()
+        e.JobID = j.ID
+        s.upsert_evals(4, [e])
+        assert s.job_by_id(j.ID).Status == JobStatusPending
+
+    def test_delete_eval_with_allocs(self):
+        s = StateStore()
+        e = mock.eval()
+        a = mock.alloc()
+        a.EvalID = e.ID
+        s.upsert_evals(1, [e])
+        s.upsert_allocs(2, [a])
+        s.delete_eval(3, [e.ID], [a.ID])
+        assert s.eval_by_id(e.ID) is None
+        assert s.alloc_by_id(a.ID) is None
+        assert s.allocs_by_eval(e.ID) == []
+
+
+class TestAllocs:
+    def test_upsert_and_indexes(self):
+        s = StateStore()
+        a = mock.alloc()
+        w = WatchAsserter(s, Item(table="allocs"), Item(alloc=a.ID),
+                          Item(alloc_node=a.NodeID), Item(alloc_job=a.JobID),
+                          Item(alloc_eval=a.EvalID))
+        s.upsert_allocs(50, [a])
+        w.assert_fired(Item(table="allocs"), Item(alloc=a.ID),
+                       Item(alloc_node=a.NodeID), Item(alloc_job=a.JobID),
+                       Item(alloc_eval=a.EvalID))
+        assert [x.ID for x in s.allocs_by_node(a.NodeID)] == [a.ID]
+        assert [x.ID for x in s.allocs_by_job(a.JobID)] == [a.ID]
+        assert [x.ID for x in s.allocs_by_eval(a.EvalID)] == [a.ID]
+
+    def test_terminal_filter(self):
+        s = StateStore()
+        a1, a2 = mock.alloc(), mock.alloc()
+        a2.NodeID = a1.NodeID
+        a2.DesiredStatus = AllocDesiredStatusStop
+        s.upsert_allocs(1, [a1, a2])
+        assert {x.ID for x in s.allocs_by_node_terminal(a1.NodeID, False)} == {a1.ID}
+        assert {x.ID for x in s.allocs_by_node_terminal(a1.NodeID, True)} == {a2.ID}
+
+    def test_server_upsert_keeps_client_state(self):
+        s = StateStore()
+        a = mock.alloc()
+        s.upsert_allocs(1, [a])
+        client_view = s.alloc_by_id(a.ID).copy()
+        client_view.ClientStatus = AllocClientStatusRunning
+        client_view.TaskStates = {"web": TaskState(State="running")}
+        s.update_alloc_from_client(2, client_view)
+        # Server-side re-upsert (plan applier) must not clobber client status.
+        server_view = a.copy()
+        s.upsert_allocs(3, [server_view])
+        out = s.alloc_by_id(a.ID)
+        assert out.ClientStatus == AllocClientStatusRunning
+        assert out.TaskStates["web"].State == "running"
+        assert out.ModifyIndex == 3
+
+    def test_update_from_client_missing(self):
+        s = StateStore()
+        with pytest.raises(KeyError):
+            s.update_alloc_from_client(1, mock.alloc())
+
+
+class TestSnapshots:
+    def test_snapshot_isolation(self):
+        s = StateStore()
+        n = mock.node()
+        s.upsert_node(1, n)
+        snap = s.snapshot()
+        s.update_node_status(2, n.ID, NodeStatusDown)
+        assert s.node_by_id(n.ID).Status == NodeStatusDown
+        assert snap.node_by_id(n.ID).Status == NodeStatusReady
+        assert snap.latest_index() == 1
+
+    def test_snapshot_sees_deletes_correctly(self):
+        s = StateStore()
+        n = mock.node()
+        s.upsert_node(1, n)
+        snap = s.snapshot()
+        s.delete_node(2, n.ID)
+        assert s.node_by_id(n.ID) is None
+        assert snap.node_by_id(n.ID) is not None
+        assert len(snap.nodes()) == 1
+        assert len(s.nodes()) == 0
+
+    def test_snapshot_members(self):
+        s = StateStore()
+        a = mock.alloc()
+        s.upsert_allocs(1, [a])
+        snap = s.snapshot()
+        a2 = mock.alloc()
+        a2.NodeID = a.NodeID
+        s.upsert_allocs(2, [a2])
+        assert len(s.allocs_by_node(a.NodeID)) == 2
+        assert len(snap.allocs_by_node(a.NodeID)) == 1
+
+    def test_compact_preserves_live_snapshots(self):
+        s = StateStore()
+        n = mock.node()
+        s.upsert_node(1, n)
+        snap = s.snapshot()
+        s.update_node_status(2, n.ID, NodeStatusDown)
+        s.compact()
+        assert snap.node_by_id(n.ID).Status == NodeStatusReady
+        del snap
+        s.compact()
+        # After the snapshot is gone, history may be dropped; live view intact.
+        assert s.node_by_id(n.ID).Status == NodeStatusDown
+
+    def test_compact_removes_deleted(self):
+        s = StateStore()
+        e = mock.eval()
+        a = mock.alloc()
+        a.EvalID = e.ID
+        s.upsert_evals(1, [e])
+        s.upsert_allocs(2, [a])
+        s.delete_eval(3, [e.ID], [a.ID])
+        s.compact()
+        assert s._tables["allocs"].chains == {}
+        assert s._member_sets["alloc_eval"] == {}
+
+
+class TestRestore:
+    def test_roundtrip(self):
+        s = StateStore()
+        n, j, e, a = mock.node(), mock.job(), mock.eval(), mock.alloc()
+        s.upsert_node(1, n)
+        s.upsert_job(2, j)
+        s.upsert_evals(3, [e])
+        s.upsert_allocs(4, [a])
+        s.upsert_periodic_launch(5, PeriodicLaunch(ID=j.ID, Launch=123.0))
+
+        s2 = StateStore()
+        r = s2.restore()
+        snap = s.snapshot()
+        for node in snap.nodes():
+            r.node_restore(node)
+        for job in snap.jobs():
+            r.job_restore(job)
+        for ev in snap.evals():
+            r.eval_restore(ev)
+        for alloc in snap.allocs():
+            r.alloc_restore(alloc)
+        for pl in snap.periodic_launches():
+            r.periodic_launch_restore(pl)
+        for t in ("nodes", "jobs", "evals", "allocs", "periodic_launch"):
+            r.index_restore(t, s.get_index(t))
+        r.commit()
+
+        assert s2.node_by_id(n.ID) is not None
+        assert s2.job_by_id(j.ID) is not None
+        assert [x.ID for x in s2.evals_by_job(e.JobID)] == [e.ID]
+        assert [x.ID for x in s2.allocs_by_node(a.NodeID)] == [a.ID]
+        assert s2.periodic_launch_by_id(j.ID).Launch == 123.0
+        assert s2.latest_index() == s.latest_index()
+
+
+class TestBlockingQueryPattern:
+    def test_watch_wakes_blocked_reader(self):
+        s = StateStore()
+        n = mock.node()
+        s.upsert_node(1, n)
+        ev = threading.Event()
+        s.watch([Item(node=n.ID)], ev)
+        result = {}
+
+        def writer():
+            s.update_node_status(2, n.ID, NodeStatusDown)
+
+        t = threading.Timer(0.05, writer)
+        t.start()
+        assert ev.wait(2.0), "blocking query never woke"
+        result["status"] = s.node_by_id(n.ID).Status
+        assert result["status"] == NodeStatusDown
+        s.stop_watch([Item(node=n.ID)], ev)

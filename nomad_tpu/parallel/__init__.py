@@ -1,0 +1,16 @@
+"""Multi-chip scale-out: the node axis over the device mesh.
+
+The reference scales scheduling by adding servers (optimistic concurrency,
+reference: nomad/worker.go) and scales the cluster by sharding nothing — each
+scheduler scans all nodes. Here the node table itself shards across TPU
+devices over ICI: capacity/usage/masks are laid out [N, R] with N split over
+the mesh's 'nodes' axis, the placement kernel's reductions (argmax, sums)
+become XLA collectives, and regions federate over DCN (one mesh per region).
+"""
+
+from .mesh import (  # noqa: F401
+    node_sharding,
+    place_batch_sharded,
+    replicated,
+    scheduling_mesh,
+)

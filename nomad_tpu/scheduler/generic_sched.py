@@ -199,9 +199,10 @@ class GenericScheduler:
         self.plan_result = result
 
         if new_state is not None:
-            # Stale data: refresh and retry.
+            # Stale data: refresh and retry. A store-attached index stays in
+            # sync by itself; only a one-shot snapshot index must be rebuilt.
             self.state = new_state
-            if self.tindex is not None and not hasattr(self.tindex, "_attached"):
+            if self.tindex is not None and not self.tindex.attached:
                 self.tindex = None  # rebuilt from the fresh state next attempt
             return False
 

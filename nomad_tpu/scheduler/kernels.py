@@ -9,10 +9,6 @@ BinPackIterator -> feasible.go checkers) with batched device programs:
                 whole node axis, with in-register usage/anti-affinity updates
                 so placement k+1 sees placement k's proposed allocation
                 (reference semantics: scheduler/context.go:109-140).
-  system_feasible  one-shot mask for the system scheduler (one alloc per
-                eligible node, reference: scheduler/system_sched.go).
-  verify_plans  batched per-node fit re-check for the plan applier
-                (reference: nomad/plan_apply.go:318-361).
 
 Scoring matches reference funcs.go:102-137 (including its Inf/NaN division
 edges) with the job anti-affinity penalty applied after clamping (reference:
@@ -40,10 +36,22 @@ _LOG2_10 = float(np.log2(10.0))
 
 
 class PlacementResult(NamedTuple):
-    chosen: jax.Array      # [P] int32 row index, -1 when infeasible/padding
-    scores: jax.Array      # [P] f32 score of the chosen node
-    n_feasible: jax.Array  # [P] int32 feasible node count per step
+    packed: jax.Array       # [P, 3] f32: (chosen row or -1, score, n_feasible)
     usage_after: jax.Array  # [N, R] usage including the new placements
+
+    # The packed layout exists because a device->host readback has a fixed
+    # RTT cost on remote-attached TPUs: one transfer per eval, not three.
+    @property
+    def chosen(self):
+        return self.packed[:, 0].astype(jnp.int32)
+
+    @property
+    def scores(self):
+        return self.packed[:, 1]
+
+    @property
+    def n_feasible(self):
+        return self.packed[:, 2].astype(jnp.int32)
 
 
 def _score(usage2: jax.Array, score_cap: jax.Array) -> jax.Array:
@@ -96,47 +104,19 @@ def place_batch(
         job_counts = job_counts.at[idx].add(found.astype(job_counts.dtype))
         banned = banned.at[idx].set(banned[idx] | found)
 
-        out = (jnp.where(found, idx, -1).astype(jnp.int32),
-               jnp.where(found, masked[idx], -jnp.inf),
-               jnp.sum(ok).astype(jnp.int32))
+        out = jnp.stack([
+            jnp.where(found, idx, -1).astype(jnp.float32),
+            jnp.where(found, masked[idx], -jnp.inf),
+            jnp.sum(ok).astype(jnp.float32),
+        ])
         return (usage, job_counts, banned), out
 
-    (usage, _, _), (chosen, scores, n_feasible) = jax.lax.scan(
+    (usage, _, _), packed = jax.lax.scan(
         step, (usage, job_counts, banned0), (demands, tg_ids, valid))
-    return PlacementResult(chosen, scores, n_feasible, usage)
+    return PlacementResult(packed, usage)
 
 
-@jax.jit
-def system_feasible(
-    capacity: jax.Array,   # [N, R]
-    usage: jax.Array,      # [N, R]
-    eligible: jax.Array,   # [N]
-    demand: jax.Array,     # [R]
-) -> tuple[jax.Array, jax.Array]:
-    """Mask + score for one-alloc-per-node system placement."""
-    fits = jnp.all(capacity - usage >= demand[None, :], axis=1) & eligible
-    return fits, fits.sum().astype(jnp.int32)
-
-
-@jax.jit
-def exhaustion_dims(
-    capacity: jax.Array,   # [N, R]
-    usage: jax.Array,      # [N, R]
-    eligible: jax.Array,   # [N]
-    demand: jax.Array,     # [R]
-) -> jax.Array:
-    """For failed placements: count of eligible nodes exhausted per dimension
-    (feeds AllocMetric.DimensionExhausted, reference: structs.go:2552-2584)."""
-    lacking = (capacity - usage) < demand[None, :]  # [N, R]
-    return jnp.sum(lacking & eligible[:, None], axis=0).astype(jnp.int32)
-
-
-@jax.jit
-def verify_plans(
-    capacity: jax.Array,   # [N, R] rows for the plan's nodes
-    usage: jax.Array,      # [N, R] committed usage minus plan evictions
-    proposed: jax.Array,   # [N, R] summed proposed-alloc demand per node
-) -> jax.Array:
-    """Plan applier: per-node fit re-check, batched (reference:
-    plan_apply.go:318-361 evaluateNodePlan)."""
-    return jnp.all(capacity - usage >= proposed, axis=1)
+# Note: the system scheduler's per-node sweep and the plan applier's
+# re-verification run host-side (numpy / structs.allocs_fit) — they are
+# O(nodes-in-one-plan), tiny next to the placement scan, and need exact
+# port-level network checks that don't tensorize. Only place_batch is hot.

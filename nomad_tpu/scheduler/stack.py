@@ -158,6 +158,13 @@ class GenericStack:
         banned_extra = np.zeros(nt.n_rows, dtype=bool)
         results: List[Optional[SelectedOption]] = [None] * len(tgs)
         remaining = list(range(len(tgs)))
+        # Effects of winners from earlier attempts of THIS call: their usage,
+        # anti-affinity counts, and distinct-hosts occupancy must be visible
+        # to re-run placements (they aren't in ctx.plan yet).
+        placed_usage = np.zeros((nt.n_rows, RES_DIMS), dtype=np.float32)
+        placed_counts = np.zeros(nt.n_rows, dtype=np.int32)
+        placed_hosts = np.zeros(nt.n_rows, dtype=bool)
+        placed_any = False
 
         # The port-collision retry loop runs at most a handful of times: a
         # winner failing host-side network assignment is masked and the
@@ -171,6 +178,8 @@ class GenericStack:
             usage = d["usage"]
             if len(evict_rows):
                 usage = usage.at[evict_rows].add(-evict_vecs)
+            if placed_any:
+                usage = usage + jnp.asarray(placed_usage)
             masks = jnp.asarray(tg_masks & ~banned_extra[None, :])
             sel_demands = demands.copy()
             sel_valid = valid.copy()
@@ -179,17 +188,22 @@ class GenericStack:
             keep[remaining] = True
             sel_valid &= keep
 
+            counts_now = job_counts + placed_counts
             res = kernels.place_batch(
                 d["capacity"], d["score_cap"], usage, masks,
-                jnp.asarray(job_counts), jnp.asarray(sel_demands),
+                jnp.asarray(counts_now), jnp.asarray(sel_demands),
                 jnp.asarray(sel_tgids), jnp.asarray(sel_valid),
                 jnp.asarray(noise_vec), jnp.float32(penalty),
                 jnp.asarray(distinct), jnp.asarray(
-                    (job_counts > 0) if distinct else np.zeros(nt.n_rows, dtype=bool)),
+                    (counts_now > 0) | placed_hosts if distinct
+                    else np.zeros(nt.n_rows, dtype=bool)),
             )
-            chosen = np.asarray(res.chosen)
-            scores = np.asarray(res.scores)
-            n_feasible = np.asarray(res.n_feasible)
+            # ONE device->host transfer: on remote-attached TPUs a readback
+            # pays a fixed RTT, so results come back packed.
+            packed = np.asarray(res.packed)
+            chosen = packed[:, 0].astype(np.int32)
+            scores = packed[:, 1]
+            n_feasible = packed[:, 2].astype(np.int32)
 
             failed_rows: set = set()
             next_remaining = []
@@ -216,6 +230,10 @@ class GenericStack:
                     continue
                 results[p] = option
                 self.ctx.metrics.score_node(node, "binpack", float(scores[p]))
+                placed_usage[row] += demands[p]
+                placed_counts[row] += 1
+                placed_hosts[row] = True
+                placed_any = True
 
             if not failed_rows:
                 break

@@ -1,9 +1,10 @@
 """SystemScheduler: one allocation per eligible node (reference:
 scheduler/system_sched.go).
 
-The feasibility sweep over the node set runs as one device mask program
-(kernels.system_feasible semantics, folded into the class-eligibility masks);
-per-node network assignment stays host-side.
+System placement is per-specific-node (the diff pins each placement to its
+node), so it uses the host-side single-node fast path — class-memoized
+constraint checks plus a numpy fit — rather than the batched device scan;
+the candidate set per eval is exactly the node list, not a search.
 """
 
 from __future__ import annotations
@@ -118,7 +119,8 @@ class SystemScheduler:
         self.plan_result = result
         if new_state is not None:
             self.state = new_state
-            self.tindex = None
+            if self.tindex is not None and not self.tindex.attached:
+                self.tindex = None
             return False
         full_commit, expected, actual = result.full_commit(self.plan)
         if not full_commit:

@@ -1,0 +1,19 @@
+"""Server coordination services (reference: nomad/*.go).
+
+Leader-side singletons — eval broker, blocked-evals tracker, plan queue,
+plan applier — plus the FSM, scheduling workers, heartbeats, the periodic
+dispatcher, and the core GC scheduler: the host-side control plane around
+the TPU placement path.
+"""
+
+from .fsm import FSM, MessageType, DevRaft  # noqa: F401
+from .eval_broker import EvalBroker  # noqa: F401
+from .blocked_evals import BlockedEvals  # noqa: F401
+from .plan_queue import PlanQueue, PendingPlan  # noqa: F401
+from .plan_apply import PlanApplier, evaluate_plan  # noqa: F401
+from .worker import Worker  # noqa: F401
+from .heartbeat import HeartbeatTimers  # noqa: F401
+from .periodic import PeriodicDispatch, derive_job, derived_job_id  # noqa: F401
+from .timetable import TimeTable  # noqa: F401
+from .core_sched import CoreScheduler  # noqa: F401
+from .server import Server, ServerConfig  # noqa: F401

@@ -1,0 +1,196 @@
+"""BlockedEvals: capacity-gated evaluation parking (reference:
+nomad/blocked_evals.go).
+
+Evals that failed placement wait here until node capacity changes. Keyed by
+computed node class: an unblock on class C wakes evals that were eligible for
+C or never saw C; escaped evals (constraints outside class memoization) wake
+on any capacity change. missed-unblock indexes close the race between a
+scheduler running on an old snapshot and capacity arriving meanwhile.
+"""
+
+from __future__ import annotations
+
+import queue as _queue
+import threading
+import time
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Tuple
+
+from nomad_tpu.structs import Evaluation
+from nomad_tpu.structs.structs import EvalTriggerMaxPlans
+
+from .eval_broker import EvalBroker
+
+
+@dataclass
+class _Wrapped:
+    eval: Evaluation
+    token: str
+
+
+@dataclass
+class BlockedStats:
+    TotalEscaped: int = 0
+    TotalBlocked: int = 0
+
+
+class BlockedEvals:
+    def __init__(self, eval_broker: EvalBroker):
+        self.eval_broker = eval_broker
+        self._enabled = False
+        self._lock = threading.Lock()
+        self.stats = BlockedStats()
+
+        self._captured: Dict[str, _Wrapped] = {}
+        self._escaped: Dict[str, _Wrapped] = {}
+        self._jobs: set = set()
+        self._unblock_indexes: Dict[str, int] = {}
+        self._duplicates: List[Evaluation] = []
+        self._dup_cond = threading.Condition(self._lock)
+        self._capacity_ch: _queue.Queue = _queue.Queue(maxsize=8096)
+        self._watcher: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+
+    # ------------------------------------------------------------- lifecycle
+    def enabled(self) -> bool:
+        with self._lock:
+            return self._enabled
+
+    def set_enabled(self, enabled: bool) -> None:
+        with self._lock:
+            if self._enabled == enabled:
+                return
+            self._enabled = enabled
+            if enabled:
+                self._stop = threading.Event()
+                self._watcher = threading.Thread(target=self._watch_capacity,
+                                                 daemon=True)
+                self._watcher.start()
+            else:
+                self._stop.set()
+        if not enabled:
+            self.flush()
+
+    def flush(self) -> None:
+        with self._lock:
+            self.stats = BlockedStats()
+            self._captured.clear()
+            self._escaped.clear()
+            self._jobs.clear()
+            self._duplicates = []
+            self._capacity_ch = _queue.Queue(maxsize=8096)
+
+    # ----------------------------------------------------------------- block
+    def block(self, ev: Evaluation) -> None:
+        self._process_block(ev, "")
+
+    def reblock(self, ev: Evaluation, token: str) -> None:
+        """Block by an outstanding evaluation; carries its broker token."""
+        self._process_block(ev, token)
+
+    def _process_block(self, ev: Evaluation, token: str) -> None:
+        with self._lock:
+            if not self._enabled:
+                return
+            # One blocked eval per job; extras become duplicates for the
+            # leader's reaper to cancel.
+            if ev.JobID in self._jobs:
+                self._duplicates.append(ev)
+                self._dup_cond.notify_all()
+                return
+            if self._missed_unblock(ev):
+                self.eval_broker.enqueue_all({ev.ID: (ev, token)})
+                return
+            self.stats.TotalBlocked += 1
+            self._jobs.add(ev.JobID)
+            wrapped = _Wrapped(ev, token)
+            if ev.EscapedComputedClass:
+                self._escaped[ev.ID] = wrapped
+                self.stats.TotalEscaped += 1
+            else:
+                self._captured[ev.ID] = wrapped
+
+    def _missed_unblock(self, ev: Evaluation) -> bool:
+        """(reference: blocked_evals.go:208-245)"""
+        max_index = 0
+        for cls, index in self._unblock_indexes.items():
+            max_index = max(max_index, index)
+            elig = ev.ClassEligibility.get(cls)
+            if elig is None and ev.SnapshotIndex < index:
+                # Class appeared after the eval was processed: unblock.
+                return True
+            if elig and ev.SnapshotIndex < index:
+                return True
+        if ev.EscapedComputedClass and ev.SnapshotIndex < max_index:
+            return True
+        return False
+
+    # --------------------------------------------------------------- unblock
+    def unblock(self, computed_class: str, index: int) -> None:
+        with self._lock:
+            if not self._enabled:
+                return
+            self._unblock_indexes[computed_class] = index
+        self._capacity_ch.put((computed_class, index))
+
+    def _watch_capacity(self) -> None:
+        while not self._stop.is_set():
+            try:
+                computed_class, index = self._capacity_ch.get(timeout=0.2)
+            except _queue.Empty:
+                continue
+            self._unblock(computed_class, index)
+
+    def _unblock(self, computed_class: str, index: int) -> None:
+        with self._lock:
+            if not self._enabled:
+                return
+            unblocked: Dict[str, Tuple[Evaluation, str]] = {}
+            for eid, wrapped in list(self._escaped.items()):
+                unblocked[eid] = (wrapped.eval, wrapped.token)
+                del self._escaped[eid]
+                self._jobs.discard(wrapped.eval.JobID)
+            for eid, wrapped in list(self._captured.items()):
+                elig = wrapped.eval.ClassEligibility.get(computed_class)
+                if elig is False:
+                    continue  # explicitly ineligible for this class
+                unblocked[eid] = (wrapped.eval, wrapped.token)
+                self._jobs.discard(wrapped.eval.JobID)
+                del self._captured[eid]
+            if unblocked:
+                self.stats.TotalEscaped = 0
+                self.stats.TotalBlocked -= len(unblocked)
+                self.eval_broker.enqueue_all(unblocked)
+
+    def unblock_failed(self) -> None:
+        """Periodic retry of evals blocked by plan failures
+        (reference: blocked_evals.go:335-366)."""
+        with self._lock:
+            if not self._enabled:
+                return
+            unblocked: Dict[str, Tuple[Evaluation, str]] = {}
+            for source in (self._captured, self._escaped):
+                for eid, wrapped in list(source.items()):
+                    if wrapped.eval.TriggeredBy == EvalTriggerMaxPlans:
+                        unblocked[eid] = (wrapped.eval, wrapped.token)
+                        del source[eid]
+                        self._jobs.discard(wrapped.eval.JobID)
+                        if source is self._escaped:
+                            self.stats.TotalEscaped -= 1
+            if unblocked:
+                self.stats.TotalBlocked -= len(unblocked)
+                self.eval_broker.enqueue_all(unblocked)
+
+    def get_duplicates(self, timeout: float) -> List[Evaluation]:
+        """Blocking fetch of duplicate blocked evals for cancellation
+        (reference: blocked_evals.go:370-398)."""
+        end = time.monotonic() + timeout
+        with self._lock:
+            while True:
+                if self._duplicates:
+                    dups = self._duplicates
+                    self._duplicates = []
+                    return dups
+                remaining = end - time.monotonic()
+                if remaining <= 0 or not self._dup_cond.wait(remaining):
+                    return []

@@ -1,0 +1,119 @@
+"""CoreScheduler: internal GC scheduler for `_core` evals (reference:
+nomad/core_sched.go).
+
+Handles eval-gc, job-gc, node-gc, and force-gc evaluations, translating time
+thresholds to Raft indexes through the TimeTable.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import List, Optional
+
+from nomad_tpu.structs import Evaluation
+from nomad_tpu.structs.structs import (
+    CoreJobEvalGC,
+    CoreJobForceGC,
+    CoreJobJobGC,
+    CoreJobNodeGC,
+    JobStatusDead,
+)
+
+from .fsm import DevRaft, MessageType
+from .timetable import TimeTable
+
+logger = logging.getLogger("nomad.core_sched")
+
+
+class CoreScheduler:
+    """(reference: core_sched.go:20-51)"""
+
+    def __init__(self, raft: DevRaft, timetable: TimeTable,
+                 eval_gc_threshold: float = 3600.0,
+                 job_gc_threshold: float = 4 * 3600.0,
+                 node_gc_threshold: float = 24 * 3600.0):
+        self.raft = raft
+        self.timetable = timetable
+        self.eval_gc_threshold = eval_gc_threshold
+        self.job_gc_threshold = job_gc_threshold
+        self.node_gc_threshold = node_gc_threshold
+
+    def process(self, ev: Evaluation) -> None:
+        kind = ev.JobID.split(":")[0]
+        if kind == CoreJobEvalGC:
+            self._eval_gc()
+        elif kind == CoreJobJobGC:
+            self._job_gc()
+        elif kind == CoreJobNodeGC:
+            self._node_gc()
+        elif kind == CoreJobForceGC:
+            self._eval_gc(force=True)
+            self._job_gc(force=True)
+            self._node_gc(force=True)
+        else:
+            raise ValueError(f"core scheduler cannot handle job '{ev.JobID}'")
+
+    def _threshold_index(self, threshold: float, force: bool) -> int:
+        if force:
+            return self.raft.last_index + 1
+        return self.timetable.nearest_index(time.time() - threshold)
+
+    def _eval_gc(self, force: bool = False) -> None:
+        """GC terminal evals older than the threshold, plus their allocs
+        (reference: core_sched.go:53-117)."""
+        state = self.raft.fsm.state
+        oldest = self._threshold_index(self.eval_gc_threshold, force)
+        gc_evals: List[str] = []
+        gc_allocs: List[str] = []
+        for ev in state.evals():
+            if not ev.terminal_status() or ev.ModifyIndex >= oldest:
+                continue
+            allocs = state.allocs_by_eval(ev.ID)
+            if any(not a.terminal_status() or a.ModifyIndex >= oldest
+                   for a in allocs):
+                continue
+            gc_evals.append(ev.ID)
+            gc_allocs.extend(a.ID for a in allocs)
+        if gc_evals or gc_allocs:
+            logger.info("core: eval GC reaping %d evals, %d allocs",
+                        len(gc_evals), len(gc_allocs))
+            self.raft.apply(MessageType.EvalDelete,
+                            {"Evals": gc_evals, "Allocs": gc_allocs})
+
+    def _job_gc(self, force: bool = False) -> None:
+        """GC dead GC-eligible jobs whose evals/allocs are all terminal and
+        old (reference: core_sched.go:119-180)."""
+        state = self.raft.fsm.state
+        oldest = self._threshold_index(self.job_gc_threshold, force)
+        for job in state.jobs_by_gc(True):
+            if job.Status != JobStatusDead or job.ModifyIndex >= oldest:
+                continue
+            evals = state.evals_by_job(job.ID)
+            if any(not e.terminal_status() or e.ModifyIndex >= oldest
+                   for e in evals):
+                continue
+            allocs = state.allocs_by_job(job.ID)
+            if any(not a.terminal_status() or a.ModifyIndex >= oldest
+                   for a in allocs):
+                continue
+            logger.info("core: job GC reaping %s", job.ID)
+            if evals or allocs:
+                self.raft.apply(MessageType.EvalDelete, {
+                    "Evals": [e.ID for e in evals],
+                    "Allocs": [a.ID for a in allocs]})
+            self.raft.apply(MessageType.JobDeregister, {"JobID": job.ID})
+
+    def _node_gc(self, force: bool = False) -> None:
+        """GC down nodes with no non-terminal allocs
+        (reference: core_sched.go:182-232)."""
+        state = self.raft.fsm.state
+        oldest = self._threshold_index(self.node_gc_threshold, force)
+        for node in state.nodes():
+            if not node.terminal_status() or node.ModifyIndex >= oldest:
+                continue
+            allocs = state.allocs_by_node(node.ID)
+            if any(not a.terminal_status() for a in allocs):
+                continue
+            logger.info("core: node GC reaping %s", node.ID)
+            self.raft.apply(MessageType.NodeDeregister, {"NodeID": node.ID})

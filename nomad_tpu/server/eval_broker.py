@@ -1,0 +1,310 @@
+"""EvalBroker: leader-side priority queue of evaluations with at-least-once
+delivery (reference: nomad/eval_broker.go).
+
+Semantics mirrored: per-scheduler-type priority queues; per-JobID
+serialization (one in-flight eval per job, rest held "blocked"); Ack/Nack
+with nack-timeout redelivery; delivery-limit overflow into the `_failed`
+queue; wait-time deferral; token-gated requeue (a scheduler reblocking its
+own eval defers until the outstanding one is Ack'd/Nack'd).
+"""
+
+from __future__ import annotations
+
+import heapq
+import itertools
+import random
+import threading
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from nomad_tpu.structs import Evaluation, generate_uuid
+
+FAILED_QUEUE = "_failed"
+
+
+class NotOutstandingError(Exception):
+    pass
+
+
+class TokenMismatchError(Exception):
+    pass
+
+
+class _PriorityQueue:
+    """Max-priority heap of evaluations, FIFO within a priority."""
+
+    _seq = itertools.count()
+
+    def __init__(self) -> None:
+        self._heap: List[Tuple[int, int, int, Evaluation]] = []
+
+    def push(self, ev: Evaluation) -> None:
+        heapq.heappush(self._heap,
+                       (-ev.Priority, ev.CreateIndex, next(self._seq), ev))
+
+    def pop(self) -> Optional[Evaluation]:
+        if not self._heap:
+            return None
+        return heapq.heappop(self._heap)[3]
+
+    def peek(self) -> Optional[Evaluation]:
+        if not self._heap:
+            return None
+        return self._heap[0][3]
+
+    def __len__(self) -> int:
+        return len(self._heap)
+
+
+@dataclass
+class _Unack:
+    eval: Evaluation
+    token: str
+    nack_timer: threading.Timer
+
+
+@dataclass
+class BrokerStats:
+    TotalReady: int = 0
+    TotalUnacked: int = 0
+    TotalBlocked: int = 0
+    TotalWaiting: int = 0
+    ByScheduler: Dict[str, Dict[str, int]] = field(default_factory=dict)
+
+
+class EvalBroker:
+    def __init__(self, nack_timeout: float = 60.0, delivery_limit: int = 3):
+        if nack_timeout < 0:
+            raise ValueError("timeout cannot be negative")
+        self.nack_timeout = nack_timeout
+        self.delivery_limit = delivery_limit
+        self._enabled = False
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+
+        self._evals: Dict[str, int] = {}          # eval id -> delivery count
+        self._job_evals: Dict[str, str] = {}      # job id -> in-flight eval id
+        self._blocked: Dict[str, _PriorityQueue] = {}  # job id -> waiting
+        self._ready: Dict[str, _PriorityQueue] = {}    # scheduler -> ready
+        self._unack: Dict[str, _Unack] = {}
+        self._requeue: Dict[str, Evaluation] = {}  # token -> eval
+        self._time_wait: Dict[str, threading.Timer] = {}
+        self.stats = BrokerStats()
+
+    # ------------------------------------------------------------- lifecycle
+    def enabled(self) -> bool:
+        with self._lock:
+            return self._enabled
+
+    def set_enabled(self, enabled: bool) -> None:
+        with self._lock:
+            self._enabled = enabled
+        if not enabled:
+            self.flush()
+
+    def flush(self) -> None:
+        """(reference: eval_broker.go Flush)"""
+        with self._lock:
+            for unack in self._unack.values():
+                unack.nack_timer.cancel()
+            for timer in self._time_wait.values():
+                timer.cancel()
+            self._evals.clear()
+            self._job_evals.clear()
+            self._blocked.clear()
+            self._ready.clear()
+            self._unack.clear()
+            self._requeue.clear()
+            self._time_wait.clear()
+            self.stats = BrokerStats()
+            self._cond.notify_all()
+
+    # --------------------------------------------------------------- enqueue
+    def enqueue(self, ev: Evaluation) -> None:
+        with self._lock:
+            self._process_enqueue(ev, "")
+
+    def enqueue_all(self, evals: Dict[str, Tuple[Evaluation, str]]) -> None:
+        """evals: eval.ID -> (eval, token) for token-gated requeues."""
+        with self._lock:
+            for ev, token in evals.values():
+                self._process_enqueue(ev, token)
+
+    def _process_enqueue(self, ev: Evaluation, token: str) -> None:
+        if ev.ID in self._evals:
+            if token == "":
+                return
+            unack = self._unack.get(ev.ID)
+            if unack is not None and unack.token == token:
+                self._requeue[token] = ev
+            return
+        if self._enabled:
+            self._evals[ev.ID] = 0
+
+        if ev.Wait > 0:
+            timer = threading.Timer(ev.Wait / 1e9, self._enqueue_waiting, (ev,))
+            timer.daemon = True
+            self._time_wait[ev.ID] = timer
+            self.stats.TotalWaiting += 1
+            timer.start()
+            return
+        self._enqueue_locked(ev, ev.Type)
+
+    def _enqueue_waiting(self, ev: Evaluation) -> None:
+        with self._lock:
+            self._time_wait.pop(ev.ID, None)
+            self.stats.TotalWaiting -= 1
+            self._enqueue_locked(ev, ev.Type)
+
+    def _enqueue_locked(self, ev: Evaluation, queue: str) -> None:
+        if not self._enabled:
+            return
+        pending = self._job_evals.get(ev.JobID, "")
+        if pending == "":
+            self._job_evals[ev.JobID] = ev.ID
+        elif pending != ev.ID:
+            self._blocked.setdefault(ev.JobID, _PriorityQueue()).push(ev)
+            self.stats.TotalBlocked += 1
+            return
+        self._ready.setdefault(queue, _PriorityQueue()).push(ev)
+        self.stats.TotalReady += 1
+        sched = self.stats.ByScheduler.setdefault(
+            queue, {"Ready": 0, "Unacked": 0})
+        sched["Ready"] += 1
+        self._cond.notify_all()
+
+    # --------------------------------------------------------------- dequeue
+    def dequeue(self, schedulers: List[str], timeout: Optional[float] = None
+                ) -> Tuple[Optional[Evaluation], str]:
+        """Blocking dequeue of the highest-priority eligible eval.
+
+        timeout is in seconds; None or 0 blocks indefinitely (reference
+        semantics: Dequeue with timeout 0 has no timeout channel).
+        """
+        import time as _time
+
+        end = None if not timeout else _time.monotonic() + timeout
+        with self._lock:
+            while True:
+                if not self._enabled:
+                    raise RuntimeError("eval broker disabled")
+                got = self._scan(schedulers)
+                if got is not None:
+                    return got
+                if end is None:
+                    self._cond.wait()
+                else:
+                    remaining = end - _time.monotonic()
+                    if remaining <= 0 or not self._cond.wait(remaining):
+                        return None, ""
+
+    def _scan(self, schedulers: List[str]
+              ) -> Optional[Tuple[Evaluation, str]]:
+        eligible: List[str] = []
+        eligible_priority = 0
+        for sched in schedulers:
+            pending = self._ready.get(sched)
+            if pending is None:
+                continue
+            ready = pending.peek()
+            if ready is None:
+                continue
+            if not eligible or ready.Priority > eligible_priority:
+                eligible = [sched]
+                eligible_priority = ready.Priority
+            elif ready.Priority == eligible_priority:
+                eligible.append(sched)
+        if not eligible:
+            return None
+        return self._dequeue_for_sched(random.choice(eligible))
+
+    def _dequeue_for_sched(self, sched: str) -> Tuple[Evaluation, str]:
+        ev = self._ready[sched].pop()
+        token = generate_uuid()
+        timer = threading.Timer(self.nack_timeout, self.nack, (ev.ID, token))
+        timer.daemon = True
+        self._unack[ev.ID] = _Unack(ev, token, timer)
+        self._evals[ev.ID] = self._evals.get(ev.ID, 0) + 1
+        timer.start()
+        self.stats.TotalReady -= 1
+        self.stats.TotalUnacked += 1
+        by = self.stats.ByScheduler[sched]
+        by["Ready"] -= 1
+        by["Unacked"] += 1
+        return ev, token
+
+    # --------------------------------------------------------------- ack/nack
+    def outstanding(self, eval_id: str) -> Optional[str]:
+        with self._lock:
+            unack = self._unack.get(eval_id)
+            return unack.token if unack is not None else None
+
+    def outstanding_reset(self, eval_id: str, token: str) -> None:
+        """Reset the nack timer mid-flight (reference: OutstandingReset)."""
+        with self._lock:
+            unack = self._unack.get(eval_id)
+            if unack is None:
+                raise NotOutstandingError(eval_id)
+            if unack.token != token:
+                raise TokenMismatchError(eval_id)
+            unack.nack_timer.cancel()
+            timer = threading.Timer(self.nack_timeout, self.nack,
+                                    (eval_id, token))
+            timer.daemon = True
+            unack.nack_timer = timer
+            timer.start()
+
+    def ack(self, eval_id: str, token: str) -> None:
+        """(reference: eval_broker.go:461-519)"""
+        with self._lock:
+            requeued = self._requeue.pop(token, None)
+            unack = self._unack.get(eval_id)
+            if unack is None:
+                raise NotOutstandingError(f"Evaluation ID not found: {eval_id}")
+            if unack.token != token:
+                raise TokenMismatchError(eval_id)
+            unack.nack_timer.cancel()
+            job_id = unack.eval.JobID
+
+            self.stats.TotalUnacked -= 1
+            queue = unack.eval.Type
+            if self._evals.get(eval_id, 0) > self.delivery_limit:
+                queue = FAILED_QUEUE
+            by = self.stats.ByScheduler.get(queue)
+            if by is not None:
+                by["Unacked"] -= 1
+
+            self._unack.pop(eval_id, None)
+            self._evals.pop(eval_id, None)
+            self._job_evals.pop(job_id, None)
+
+            blocked = self._blocked.get(job_id)
+            if blocked is not None and len(blocked):
+                ev = blocked.pop()
+                if not len(blocked):
+                    self._blocked.pop(job_id, None)
+                self.stats.TotalBlocked -= 1
+                self._enqueue_locked(ev, ev.Type)
+
+            if requeued is not None:
+                self._process_enqueue(requeued, "")
+
+    def nack(self, eval_id: str, token: str) -> None:
+        """(reference: eval_broker.go:520-560)"""
+        with self._lock:
+            self._requeue.pop(token, None)
+            unack = self._unack.get(eval_id)
+            if unack is None:
+                raise NotOutstandingError(f"Evaluation ID not found: {eval_id}")
+            if unack.token != token:
+                raise TokenMismatchError(eval_id)
+            unack.nack_timer.cancel()
+            self._unack.pop(eval_id, None)
+            self.stats.TotalUnacked -= 1
+            by = self.stats.ByScheduler.get(unack.eval.Type)
+            if by is not None:
+                by["Unacked"] -= 1
+            if self._evals.get(eval_id, 0) >= self.delivery_limit:
+                self._enqueue_locked(unack.eval, FAILED_QUEUE)
+            else:
+                self._enqueue_locked(unack.eval, unack.eval.Type)

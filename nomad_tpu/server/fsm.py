@@ -1,0 +1,233 @@
+"""FSM: the replicated state machine (reference: nomad/fsm.go).
+
+Every cluster mutation is a typed message applied through the FSM. In a
+replicated deployment messages flow through the Raft log; in dev mode the
+DevRaft backend assigns indexes and applies directly. Either way the FSM is
+the single write path into the state store, and the hook point where the
+leader's eval broker / blocked-evals tracker observe state transitions
+(reference: fsm.go:99-144, 158-164, 320-328).
+"""
+
+from __future__ import annotations
+
+import enum
+import threading
+from typing import Any, Callable, Dict, List, Optional
+
+from nomad_tpu.state.state_store import StateStore
+from nomad_tpu.structs import (
+    Allocation,
+    Evaluation,
+    Job,
+    Node,
+    PeriodicLaunch,
+    from_dict,
+    to_dict,
+)
+from nomad_tpu.structs.structs import (
+    EvalStatusBlocked,
+    JobStatusRunning,
+    NodeStatusReady,
+)
+
+
+class MessageType(enum.IntEnum):
+    """(reference: structs.go:40-57 MessageType constants)"""
+
+    NodeRegister = 0
+    NodeDeregister = 1
+    NodeUpdateStatus = 2
+    NodeUpdateDrain = 3
+    JobRegister = 4
+    JobDeregister = 5
+    EvalUpdate = 6
+    EvalDelete = 7
+    AllocUpdate = 8
+    AllocClientUpdate = 9
+    PeriodicLaunchType = 10
+    PeriodicLaunchDelete = 11
+
+
+class FSM:
+    """Applies typed messages to the state store."""
+
+    def __init__(self, state: Optional[StateStore] = None):
+        self.state = state or StateStore()
+        # Leader-side observers (broker, blocked evals, periodic dispatch)
+        # registered by the server when it holds leadership.
+        self.on_eval_update: Optional[Callable[[Evaluation], None]] = None
+        self.on_node_ready: Optional[Callable[[Node], None]] = None
+        self.on_job_upsert: Optional[Callable[[Job], None]] = None
+        self.on_job_delete: Optional[Callable[[str], None]] = None
+        self.on_alloc_terminal: Optional[Callable[[Allocation], None]] = None
+
+    def apply(self, index: int, msg_type: MessageType, payload: Dict[str, Any]) -> Any:
+        """(reference: fsm.go:99-144 Apply dispatch)"""
+        handler = _HANDLERS[msg_type]
+        return handler(self, index, payload)
+
+    # ------------------------------------------------------------- handlers
+    def _apply_node_register(self, index: int, req: Dict[str, Any]):
+        node = from_dict(Node, req["Node"]) if isinstance(req["Node"], dict) \
+            else req["Node"]
+        existing = self.state.node_by_id(node.ID)
+        self.state.upsert_node(index, node)
+        # Re-registration to ready unblocks evals by class (fsm.go:158-164).
+        if (node.Status == NodeStatusReady
+                and (existing is None or existing.Status != NodeStatusReady)
+                and self.on_node_ready is not None):
+            self.on_node_ready(node)
+        return None
+
+    def _apply_node_deregister(self, index: int, req: Dict[str, Any]):
+        self.state.delete_node(index, req["NodeID"])
+        return None
+
+    def _apply_node_status_update(self, index: int, req: Dict[str, Any]):
+        self.state.update_node_status(index, req["NodeID"], req["Status"])
+        if req["Status"] == NodeStatusReady and self.on_node_ready is not None:
+            node = self.state.node_by_id(req["NodeID"])
+            if node is not None:
+                self.on_node_ready(node)
+        return None
+
+    def _apply_node_drain_update(self, index: int, req: Dict[str, Any]):
+        self.state.update_node_drain(index, req["NodeID"], req["Drain"])
+        return None
+
+    def _apply_job_register(self, index: int, req: Dict[str, Any]):
+        job = from_dict(Job, req["Job"]) if isinstance(req["Job"], dict) \
+            else req["Job"]
+        self.state.upsert_job(index, job)
+        if self.on_job_upsert is not None:
+            self.on_job_upsert(self.state.job_by_id(job.ID))
+        return None
+
+    def _apply_job_deregister(self, index: int, req: Dict[str, Any]):
+        self.state.delete_job(index, req["JobID"])
+        if self.on_job_delete is not None:
+            self.on_job_delete(req["JobID"])
+        return None
+
+    def _apply_eval_update(self, index: int, req: Dict[str, Any]):
+        evals: List[Evaluation] = [
+            from_dict(Evaluation, e) if isinstance(e, dict) else e
+            for e in req["Evals"]]
+        self.state.upsert_evals(index, evals)
+        # Leader enqueues runnable evals / blocks blocked ones (fsm.go:320-328).
+        if self.on_eval_update is not None:
+            for ev in evals:
+                self.on_eval_update(ev)
+        return None
+
+    def _apply_eval_delete(self, index: int, req: Dict[str, Any]):
+        self.state.delete_eval(index, req.get("Evals", []), req.get("Allocs", []))
+        return None
+
+    def _apply_alloc_update(self, index: int, req: Dict[str, Any]):
+        allocs: List[Allocation] = [
+            from_dict(Allocation, a) if isinstance(a, dict) else a
+            for a in req["Alloc"]]
+        # Attach the shared job if provided (plan apply normalization).
+        job = req.get("Job")
+        if isinstance(job, dict):
+            job = from_dict(Job, job)
+        for alloc in allocs:
+            if alloc.Job is None and job is not None:
+                alloc.Job = job
+        self.state.upsert_allocs(index, allocs)
+        return None
+
+    def _apply_alloc_client_update(self, index: int, req: Dict[str, Any]):
+        for a in req["Alloc"]:
+            alloc = from_dict(Allocation, a) if isinstance(a, dict) else a
+            self.state.update_alloc_from_client(index, alloc)
+            # Terminal client status frees capacity: unblock by node class
+            # (reference: fsm.go:395-428).
+            updated = self.state.alloc_by_id(alloc.ID)
+            if (updated is not None and updated.terminal_status()
+                    and self.on_alloc_terminal is not None):
+                self.on_alloc_terminal(updated)
+        return None
+
+    def _apply_periodic_launch(self, index: int, req: Dict[str, Any]):
+        launch = req["Launch"]
+        if isinstance(launch, dict):
+            launch = from_dict(PeriodicLaunch, launch)
+        self.state.upsert_periodic_launch(index, launch)
+        return None
+
+    def _apply_periodic_launch_delete(self, index: int, req: Dict[str, Any]):
+        self.state.delete_periodic_launch(index, req["JobID"])
+        return None
+
+    # ------------------------------------------------------ snapshot/restore
+    def snapshot(self) -> Dict[str, Any]:
+        """Serialize the full FSM state (reference: fsm.go:430-551)."""
+        snap = self.state.snapshot()
+        return {
+            "nodes": [to_dict(n) for n in snap.nodes()],
+            "jobs": [to_dict(j) for j in snap.jobs()],
+            "evals": [to_dict(e) for e in snap.evals()],
+            "allocs": [to_dict(a) for a in snap.allocs()],
+            "periodic_launches": [to_dict(p) for p in snap.periodic_launches()],
+            "indexes": {t: snap.get_index(t)
+                        for t in ("nodes", "jobs", "evals", "allocs",
+                                  "periodic_launch")},
+        }
+
+    def restore(self, data: Dict[str, Any]) -> None:
+        """(reference: fsm.go:444-551)"""
+        r = self.state.restore()
+        for n in data.get("nodes", ()):
+            r.node_restore(from_dict(Node, n))
+        for j in data.get("jobs", ()):
+            r.job_restore(from_dict(Job, j))
+        for e in data.get("evals", ()):
+            r.eval_restore(from_dict(Evaluation, e))
+        for a in data.get("allocs", ()):
+            r.alloc_restore(from_dict(Allocation, a))
+        for p in data.get("periodic_launches", ()):
+            r.periodic_launch_restore(from_dict(PeriodicLaunch, p))
+        for t, idx in data.get("indexes", {}).items():
+            r.index_restore(t, idx)
+        r.commit()
+
+
+_HANDLERS = {
+    MessageType.NodeRegister: FSM._apply_node_register,
+    MessageType.NodeDeregister: FSM._apply_node_deregister,
+    MessageType.NodeUpdateStatus: FSM._apply_node_status_update,
+    MessageType.NodeUpdateDrain: FSM._apply_node_drain_update,
+    MessageType.JobRegister: FSM._apply_job_register,
+    MessageType.JobDeregister: FSM._apply_job_deregister,
+    MessageType.EvalUpdate: FSM._apply_eval_update,
+    MessageType.EvalDelete: FSM._apply_eval_delete,
+    MessageType.AllocUpdate: FSM._apply_alloc_update,
+    MessageType.AllocClientUpdate: FSM._apply_alloc_client_update,
+    MessageType.PeriodicLaunchType: FSM._apply_periodic_launch,
+    MessageType.PeriodicLaunchDelete: FSM._apply_periodic_launch_delete,
+}
+
+
+class DevRaft:
+    """Single-node consensus stand-in: assigns monotone indexes and applies
+    synchronously. The replicated log implementation plugs in behind the same
+    `apply` seam (reference boot path: server.go:608 setupRaft DevMode)."""
+
+    def __init__(self, fsm: FSM):
+        self.fsm = fsm
+        self._lock = threading.Lock()
+        self._index = max(1, fsm.state.latest_index())
+
+    def apply(self, msg_type: MessageType, payload: Dict[str, Any]) -> int:
+        """Apply a mutation; returns the index it committed at."""
+        with self._lock:
+            self._index += 1
+            index = self._index
+        self.fsm.apply(index, msg_type, payload)
+        return index
+
+    @property
+    def last_index(self) -> int:
+        return self._index

@@ -1,0 +1,72 @@
+"""Heartbeats: leader-managed TTL timers per node (reference: nomad/heartbeat.go).
+
+A node that misses its TTL is marked down, which triggers per-job
+re-evaluations (node-update evals). The TTL is rate-scaled so heartbeat load
+stays under max_heartbeats_per_second across the node count.
+"""
+
+from __future__ import annotations
+
+import logging
+import random
+import threading
+from typing import Callable, Dict
+
+logger = logging.getLogger("nomad.heartbeat")
+
+
+class HeartbeatTimers:
+    def __init__(self, min_ttl: float = 10.0, grace: float = 10.0,
+                 max_per_second: float = 50.0,
+                 on_expire: Callable[[str], None] = lambda node_id: None):
+        self.min_ttl = min_ttl
+        self.grace = grace
+        self.max_per_second = max_per_second
+        self.on_expire = on_expire
+        self._lock = threading.Lock()
+        self._timers: Dict[str, threading.Timer] = {}
+
+    def reset_heartbeat_timer(self, node_id: str) -> float:
+        """Arm (or re-arm) the node's TTL; returns the TTL granted
+        (reference: heartbeat.go:47-74)."""
+        with self._lock:
+            # Rate-scale the TTL by node count (heartbeat.go:52-54).
+            n = len(self._timers) + 1
+            ttl = max(self.min_ttl, n / self.max_per_second)
+            # Jitter so heartbeats spread out.
+            ttl += random.random() * ttl / 2
+            existing = self._timers.get(node_id)
+            if existing is not None:
+                existing.cancel()
+            timer = threading.Timer(ttl + self.grace,
+                                    self._invalidate, (node_id,))
+            timer.daemon = True
+            self._timers[node_id] = timer
+            timer.start()
+            return ttl
+
+    def _invalidate(self, node_id: str) -> None:
+        """TTL expired: node is presumed down (reference: heartbeat.go:76-107)."""
+        with self._lock:
+            self._timers.pop(node_id, None)
+        logger.warning("heartbeat: node %s TTL expired", node_id)
+        try:
+            self.on_expire(node_id)
+        except Exception:
+            logger.exception("heartbeat: expiry handler failed for %s", node_id)
+
+    def clear_heartbeat_timer(self, node_id: str) -> None:
+        with self._lock:
+            timer = self._timers.pop(node_id, None)
+            if timer is not None:
+                timer.cancel()
+
+    def clear_all(self) -> None:
+        with self._lock:
+            for timer in self._timers.values():
+                timer.cancel()
+            self._timers.clear()
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._timers)

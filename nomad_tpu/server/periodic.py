@@ -1,0 +1,149 @@
+"""Periodic dispatcher: leader-side cron launcher (reference: nomad/periodic.go).
+
+Tracks periodic jobs in a next-launch-time heap; at each fire it derives a
+child job `<id>/periodic-<epoch>` and submits it through the job-register
+path, deduping via the periodic_launch table so leadership failover doesn't
+double-launch.
+"""
+
+from __future__ import annotations
+
+import heapq
+import logging
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Tuple
+
+from nomad_tpu.structs import Job, PeriodicLaunch
+from nomad_tpu.structs.structs import PeriodicLaunchSuffix
+
+logger = logging.getLogger("nomad.periodic")
+
+
+class PeriodicDispatch:
+    def __init__(self, dispatch_job: Callable[[Job, float], None]):
+        """dispatch_job(parent_job, launch_time) performs the derived-job
+        registration + launch-table write (the server provides it)."""
+        self.dispatch_job = dispatch_job
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._enabled = False
+        self._running = False
+        self._tracked: Dict[str, Job] = {}
+        self._heap: List[Tuple[float, str]] = []
+        self._heap_entries: Dict[str, float] = {}
+        self._thread: Optional[threading.Thread] = None
+
+    # ------------------------------------------------------------- lifecycle
+    def set_enabled(self, enabled: bool) -> None:
+        with self._lock:
+            self._enabled = enabled
+            if enabled and not self._running:
+                self._running = True
+                self._thread = threading.Thread(target=self._run, daemon=True,
+                                                name="periodic")
+                self._thread.start()
+            self._cond.notify_all()
+        if not enabled:
+            self.flush()
+
+    def flush(self) -> None:
+        with self._lock:
+            self._tracked.clear()
+            self._heap = []
+            self._heap_entries.clear()
+            self._running = False
+            self._cond.notify_all()
+
+    # -------------------------------------------------------------- tracking
+    def add(self, job: Job) -> None:
+        """Track or update a periodic job (reference: periodic.go:187-232)."""
+        with self._lock:
+            if not self._enabled:
+                return
+            if not job.is_periodic():
+                self._remove_locked(job.ID)
+                return
+            self._tracked[job.ID] = job
+            nxt = job.Periodic.next(time.time())
+            if nxt > 0:
+                self._heap_entries[job.ID] = nxt
+                heapq.heappush(self._heap, (nxt, job.ID))
+                self._cond.notify_all()
+
+    def remove(self, job_id: str) -> None:
+        with self._lock:
+            self._remove_locked(job_id)
+
+    def _remove_locked(self, job_id: str) -> None:
+        self._tracked.pop(job_id, None)
+        self._heap_entries.pop(job_id, None)
+        self._cond.notify_all()
+
+    def tracked(self) -> List[Job]:
+        with self._lock:
+            return list(self._tracked.values())
+
+    # ------------------------------------------------------------------ loop
+    def _run(self) -> None:
+        """(reference: periodic.go:302-326)"""
+        while True:
+            with self._lock:
+                if not self._enabled:
+                    return
+                now = time.time()
+                fire: List[str] = []
+                while self._heap and self._heap[0][0] <= now:
+                    launch_time, job_id = heapq.heappop(self._heap)
+                    # Skip stale heap entries.
+                    if self._heap_entries.get(job_id) != launch_time:
+                        continue
+                    del self._heap_entries[job_id]
+                    fire.append(job_id)
+                jobs = [(self._tracked[jid], now) for jid in fire
+                        if jid in self._tracked]
+                if not fire:
+                    wait = (self._heap[0][0] - now) if self._heap else 1.0
+                    self._cond.wait(timeout=min(max(wait, 0.01), 1.0))
+            for job, launch_time in jobs:
+                self._dispatch(job, launch_time)
+
+    def _dispatch(self, job: Job, launch_time: float) -> None:
+        """(reference: periodic.go:328-360)"""
+        try:
+            self.dispatch_job(job, launch_time)
+        except Exception:
+            logger.exception("periodic: dispatch failed for %s", job.ID)
+        # Schedule the next launch.
+        with self._lock:
+            if job.ID in self._tracked:
+                nxt = job.Periodic.next(launch_time)
+                if nxt > 0:
+                    self._heap_entries[job.ID] = nxt
+                    heapq.heappush(self._heap, (nxt, job.ID))
+                    self._cond.notify_all()
+
+    def force_run(self, job_id: str) -> None:
+        """(reference: periodic.go:274-298)"""
+        with self._lock:
+            job = self._tracked.get(job_id)
+        if job is None:
+            raise KeyError(f"periodic job not tracked: {job_id}")
+        self._dispatch(job, time.time())
+
+
+def derived_job_id(parent_id: str, launch_time: float) -> str:
+    """(reference: periodic.go:400-410)"""
+    return f"{parent_id}{PeriodicLaunchSuffix}{int(launch_time)}"
+
+
+def derive_job(parent: Job, launch_time: float) -> Job:
+    """Build the child job for one launch (reference: periodic.go:412-431)."""
+    child = parent.copy()
+    child.ID = derived_job_id(parent.ID, launch_time)
+    child.Name = child.ID
+    child.ParentID = parent.ID
+    child.Periodic = None
+    child.Status = ""
+    child.StatusDescription = ""
+    return child

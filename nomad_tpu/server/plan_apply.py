@@ -1,0 +1,146 @@
+"""Plan applier: THE serialization point (reference: nomad/plan_apply.go).
+
+Dequeues pending plans, verifies every placement against a state snapshot,
+computes partial commits + RefreshIndex, applies through the consensus
+backend, and responds to the waiting worker. The reference overlaps Raft
+apply of plan N with verification of plan N+1 via an optimistic snapshot
+(plan_apply.go:24-33); here the apply backend is pluggable. Verification is
+host-side: a plan touches only its own nodes, and the check needs exact
+port-level network accounting (structs.allocs_fit), so there's nothing hot
+to tensorize.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from nomad_tpu.structs import (
+    Allocation,
+    Plan,
+    PlanResult,
+    allocs_fit,
+    remove_allocs,
+)
+from nomad_tpu.structs.structs import NodeStatusReady
+
+from .eval_broker import EvalBroker
+from .fsm import DevRaft, MessageType
+from .plan_queue import PendingPlan, PlanQueue
+
+logger = logging.getLogger("nomad.plan_apply")
+
+def evaluate_plan(snap, plan: Plan) -> PlanResult:
+    """Per-node fit re-check of a plan (reference: plan_apply.go:194-316)."""
+    result = PlanResult()
+    node_ids = list(dict.fromkeys(list(plan.NodeUpdate) + list(plan.NodeAllocation)))
+
+    partial_commit = False
+    for node_id in node_ids:
+        fit = _evaluate_node_plan(snap, plan, node_id)
+        if not fit:
+            partial_commit = True
+            if plan.AllAtOnce:
+                result.NodeUpdate = {}
+                result.NodeAllocation = {}
+                break
+            continue
+        if plan.NodeUpdate.get(node_id):
+            result.NodeUpdate[node_id] = plan.NodeUpdate[node_id]
+        if plan.NodeAllocation.get(node_id):
+            result.NodeAllocation[node_id] = plan.NodeAllocation[node_id]
+
+    if partial_commit:
+        result.RefreshIndex = max(snap.get_index("nodes"),
+                                  snap.get_index("allocs"))
+    return result
+
+
+def _evaluate_node_plan(snap, plan: Plan, node_id: str) -> bool:
+    """(reference: plan_apply.go:318-361)"""
+    if not plan.NodeAllocation.get(node_id):
+        return True  # evict-only always fits
+    node = snap.node_by_id(node_id)
+    if node is None or node.Status != NodeStatusReady or node.Drain:
+        return False
+    existing = snap.allocs_by_node_terminal(node_id, False)
+    remove: List[Allocation] = list(plan.NodeUpdate.get(node_id, ()))
+    remove.extend(plan.NodeAllocation.get(node_id, ()))
+    proposed = remove_allocs(list(existing), remove)
+    proposed.extend(plan.NodeAllocation.get(node_id, ()))
+    try:
+        fit, _, _ = allocs_fit(node, proposed)
+    except ValueError:
+        return False
+    return fit
+
+
+class PlanApplier:
+    """The leader's plan-apply loop (reference: plan_apply.go:41-119)."""
+
+    def __init__(self, plan_queue: PlanQueue, raft: DevRaft,
+                 eval_broker: Optional[EvalBroker] = None):
+        self.plan_queue = plan_queue
+        self.raft = raft
+        self.eval_broker = eval_broker
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> None:
+        self._stop.clear()
+        self._thread = threading.Thread(target=self.run, daemon=True,
+                                        name="plan-apply")
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    def run(self) -> None:
+        while not self._stop.is_set():
+            try:
+                pending = self.plan_queue.dequeue(timeout=0.5)
+            except RuntimeError:
+                return  # queue disabled
+            if pending is None:
+                continue
+            self.apply_one(pending)
+
+    def apply_one(self, pending: PendingPlan) -> None:
+        plan = pending.plan
+
+        # Token check: the eval must still be outstanding to its worker
+        # (anti split-brain, reference: plan_apply.go:62-78).
+        if self.eval_broker is not None:
+            token = self.eval_broker.outstanding(plan.EvalID)
+            if token is None or (plan.EvalToken and token != plan.EvalToken):
+                pending.respond(None, RuntimeError(
+                    f"plan for evaluation {plan.EvalID} has stale token"))
+                return
+
+        snap = self.raft.fsm.state.snapshot()
+        try:
+            result = evaluate_plan(snap, plan)
+        except Exception as e:  # verification error: reject the plan
+            pending.respond(None, e)
+            return
+
+        if result.NodeUpdate or result.NodeAllocation:
+            index = self._apply(plan, result)
+            result.AllocIndex = index
+        pending.respond(result, None)
+
+    def _apply(self, plan: Plan, result: PlanResult) -> int:
+        """Commit the verified subset through consensus
+        (reference: plan_apply.go:122-164 applyPlan)."""
+        allocs: List[Allocation] = []
+        for updates in result.NodeUpdate.values():
+            allocs.extend(updates)
+        for placed in result.NodeAllocation.values():
+            allocs.extend(placed)
+        return self.raft.apply(MessageType.AllocUpdate, {
+            "Job": plan.Job,
+            "Alloc": allocs,
+        })

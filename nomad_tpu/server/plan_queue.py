@@ -1,0 +1,98 @@
+"""PlanQueue: leader-side priority queue of pending plans (reference:
+nomad/plan_queue.go).
+
+Each enqueued plan carries a future the scheduling worker blocks on; the plan
+applier dequeues in priority order and resolves the futures.
+"""
+
+from __future__ import annotations
+
+import heapq
+import itertools
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import List, Optional, Tuple
+
+from nomad_tpu.structs import Plan, PlanResult
+
+
+class PendingPlan:
+    """A plan + its response future (reference: plan_queue.go:52-93)."""
+
+    def __init__(self, plan: Plan):
+        self.plan = plan
+        self._event = threading.Event()
+        self._result: Optional[PlanResult] = None
+        self._error: Optional[Exception] = None
+
+    def wait(self, timeout: Optional[float] = None) -> PlanResult:
+        if not self._event.wait(timeout):
+            raise TimeoutError("plan response timeout")
+        if self._error is not None:
+            raise self._error
+        return self._result
+
+    def respond(self, result: Optional[PlanResult],
+                error: Optional[Exception]) -> None:
+        self._result = result
+        self._error = error
+        self._event.set()
+
+
+class PlanQueue:
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._enabled = False
+        self._heap: List[Tuple[int, int, PendingPlan]] = []
+        self._seq = itertools.count()
+        self.stats = {"Depth": 0}
+
+    def enabled(self) -> bool:
+        with self._lock:
+            return self._enabled
+
+    def set_enabled(self, enabled: bool) -> None:
+        with self._lock:
+            self._enabled = enabled
+        if not enabled:
+            self.flush()
+
+    def enqueue(self, plan: Plan) -> PendingPlan:
+        """(reference: plan_queue.go:95-124)"""
+        with self._lock:
+            if not self._enabled:
+                raise RuntimeError("plan queue is disabled")
+            pending = PendingPlan(plan)
+            heapq.heappush(self._heap,
+                           (-plan.Priority, next(self._seq), pending))
+            self.stats["Depth"] += 1
+            self._cond.notify_all()
+            return pending
+
+    def dequeue(self, timeout: Optional[float] = None) -> Optional[PendingPlan]:
+        """(reference: plan_queue.go:126-152)"""
+        end = None if not timeout else time.monotonic() + timeout
+        with self._lock:
+            while True:
+                if not self._enabled:
+                    raise RuntimeError("plan queue is disabled")
+                if self._heap:
+                    _, _, pending = heapq.heappop(self._heap)
+                    self.stats["Depth"] -= 1
+                    return pending
+                if end is None:
+                    self._cond.wait(timeout=0.2)
+                else:
+                    remaining = end - time.monotonic()
+                    if remaining <= 0 or not self._cond.wait(remaining):
+                        return None
+
+    def flush(self) -> None:
+        with self._lock:
+            for _, _, pending in self._heap:
+                pending.respond(None, RuntimeError("plan queue flushed"))
+            self._heap = []
+            self.stats["Depth"] = 0
+            self._cond.notify_all()

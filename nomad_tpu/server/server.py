@@ -1,0 +1,501 @@
+"""Server: composes the FSM, leader singletons, workers, and endpoints
+(reference: nomad/server.go, nomad/leader.go, nomad/*_endpoint.go).
+
+One Server instance is a full scheduling control plane. In dev mode it is a
+single-node "cluster" (DevRaft backend, always leader); the replicated
+deployment swaps the consensus backend and runs the same leadership
+enable/restore sequence on failover (reference: leader.go:107-243).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from nomad_tpu.state.state_store import StateStore
+from nomad_tpu.structs import (
+    Allocation,
+    Evaluation,
+    Job,
+    Node,
+    PeriodicLaunch,
+    generate_uuid,
+)
+from nomad_tpu.structs.structs import (
+    CoreJobEvalGC,
+    CoreJobForceGC,
+    CoreJobJobGC,
+    CoreJobNodeGC,
+    CoreJobPriority,
+    EvalStatusBlocked,
+    EvalStatusCancelled,
+    EvalStatusFailed,
+    EvalStatusPending,
+    EvalTriggerJobDeregister,
+    EvalTriggerJobRegister,
+    EvalTriggerNodeUpdate,
+    EvalTriggerPeriodicJob,
+    JobTypeCore,
+    JobTypeService,
+    JobTypeSystem,
+    NodeStatusDown,
+    NodeStatusInit,
+    NodeStatusReady,
+    valid_node_status,
+)
+from nomad_tpu.tensor import TensorIndex
+
+from .blocked_evals import BlockedEvals
+from .core_sched import CoreScheduler
+from .eval_broker import FAILED_QUEUE, EvalBroker
+from .fsm import FSM, DevRaft, MessageType
+from .heartbeat import HeartbeatTimers
+from .periodic import PeriodicDispatch, derive_job, derived_job_id
+from .plan_apply import PlanApplier
+from .plan_queue import PlanQueue
+from .timetable import TimeTable
+from .worker import Worker
+
+logger = logging.getLogger("nomad.server")
+
+
+@dataclass
+class ServerConfig:
+    """(reference: nomad/config.go)"""
+
+    region: str = "global"
+    datacenter: str = "dc1"
+    num_schedulers: int = 2
+    enabled_schedulers: List[str] = field(
+        default_factory=lambda: ["service", "batch", "system"])
+    eval_nack_timeout: float = 60.0
+    eval_delivery_limit: int = 3
+    min_heartbeat_ttl: float = 10.0
+    heartbeat_grace: float = 10.0
+    max_heartbeats_per_second: float = 50.0
+    eval_gc_interval: float = 300.0
+    job_gc_interval: float = 300.0
+    node_gc_interval: float = 300.0
+    eval_gc_threshold: float = 3600.0
+    job_gc_threshold: float = 4 * 3600.0
+    node_gc_threshold: float = 24 * 3600.0
+    failed_eval_unblock_interval: float = 60.0
+    dev_mode: bool = False
+
+
+class Server:
+    def __init__(self, config: Optional[ServerConfig] = None):
+        self.config = config or ServerConfig()
+        self.fsm = FSM()
+        self.raft = DevRaft(self.fsm)
+        self.state: StateStore = self.fsm.state
+        self.tindex = TensorIndex.attach(self.state)
+
+        self.eval_broker = EvalBroker(self.config.eval_nack_timeout,
+                                      self.config.eval_delivery_limit)
+        self.blocked_evals = BlockedEvals(self.eval_broker)
+        self.plan_queue = PlanQueue()
+        self.plan_applier = PlanApplier(self.plan_queue, self.raft,
+                                        self.eval_broker)
+        self.timetable = TimeTable()
+        self.core_sched = CoreScheduler(
+            self.raft, self.timetable,
+            eval_gc_threshold=self.config.eval_gc_threshold,
+            job_gc_threshold=self.config.job_gc_threshold,
+            node_gc_threshold=self.config.node_gc_threshold)
+        self.heartbeats = HeartbeatTimers(
+            min_ttl=self.config.min_heartbeat_ttl,
+            grace=self.config.heartbeat_grace,
+            max_per_second=self.config.max_heartbeats_per_second,
+            on_expire=self._invalidate_heartbeat)
+        self.periodic = PeriodicDispatch(self._dispatch_periodic)
+        self.workers: List[Worker] = []
+        self._leader = False
+        self._shutdown = threading.Event()
+        self._reapers: List[threading.Thread] = []
+
+    # ------------------------------------------------------------ leadership
+    def establish_leadership(self) -> None:
+        """(reference: leader.go:107-170)"""
+        self._leader = True
+        self.plan_queue.set_enabled(True)
+        self.plan_applier.start()
+        self.eval_broker.set_enabled(True)
+        self.blocked_evals.set_enabled(True)
+        self.periodic.set_enabled(True)
+
+        # FSM hooks only matter on the leader.
+        self.fsm.on_eval_update = self._on_eval_update
+        self.fsm.on_node_ready = self._on_node_ready
+        self.fsm.on_alloc_terminal = self._on_alloc_terminal
+        self.fsm.on_job_upsert = self.periodic.add
+        self.fsm.on_job_delete = self.periodic.remove
+
+        self._restore_evals()
+        self._restore_periodic_dispatcher()
+
+        # Workers
+        schedulers = list(self.config.enabled_schedulers) + [JobTypeCore]
+        for i in range(self.config.num_schedulers):
+            w = Worker(self.raft, self.eval_broker, self.plan_queue,
+                       self.blocked_evals, self.tindex, schedulers)
+            w.core_scheduler = self.core_sched
+            w.start(name=f"worker-{i}")
+            self.workers.append(w)
+
+        # Reapers + GC tickers (reference: leader.go:246-332)
+        self._start_loop(self._reap_failed_evaluations, 0.5)
+        self._start_loop(self._reap_dup_blocked_evaluations, 0.5)
+        self._start_loop(lambda: self._schedule_core_gc(CoreJobEvalGC),
+                         self.config.eval_gc_interval)
+        self._start_loop(lambda: self._schedule_core_gc(CoreJobJobGC),
+                         self.config.job_gc_interval)
+        self._start_loop(lambda: self._schedule_core_gc(CoreJobNodeGC),
+                         self.config.node_gc_interval)
+        self._start_loop(self.blocked_evals.unblock_failed,
+                         self.config.failed_eval_unblock_interval)
+
+    def revoke_leadership(self) -> None:
+        """(reference: leader.go:390-431)"""
+        self._leader = False
+        self.eval_broker.set_enabled(False)
+        self.blocked_evals.set_enabled(False)
+        self.plan_applier.stop()
+        self.plan_queue.set_enabled(False)
+        self.periodic.set_enabled(False)
+        self.heartbeats.clear_all()
+        for w in self.workers:
+            w.stop()
+        self.workers = []
+        self.fsm.on_eval_update = None
+        self.fsm.on_node_ready = None
+        self.fsm.on_alloc_terminal = None
+        self.fsm.on_job_upsert = None
+        self.fsm.on_job_delete = None
+
+    def shutdown(self) -> None:
+        self._shutdown.set()
+        self.revoke_leadership()
+
+    def _start_loop(self, fn, interval: float) -> None:
+        def loop():
+            while not self._shutdown.is_set():
+                if self._shutdown.wait(interval):
+                    return
+                if not self._leader:
+                    return
+                try:
+                    fn()
+                except Exception:
+                    logger.exception("leader loop task failed")
+
+        t = threading.Thread(target=loop, daemon=True)
+        t.start()
+        self._reapers.append(t)
+
+    # ------------------------------------------------------------- FSM hooks
+    def _on_eval_update(self, ev: Evaluation) -> None:
+        """Route evals to broker or blocked tracker (reference: fsm.go:320-344)."""
+        if ev.should_enqueue():
+            self.eval_broker.enqueue(ev)
+        elif ev.should_block():
+            token = self.eval_broker.outstanding(ev.ID) or ""
+            if token:
+                self.blocked_evals.reblock(ev, token)
+            else:
+                self.blocked_evals.block(ev)
+        self.timetable.witness(ev.ModifyIndex, time.time())
+
+    def _on_node_ready(self, node: Node) -> None:
+        self.blocked_evals.unblock(node.ComputedClass, node.ModifyIndex)
+
+    def _on_alloc_terminal(self, alloc: Allocation) -> None:
+        node = self.state.node_by_id(alloc.NodeID)
+        if node is not None:
+            self.blocked_evals.unblock(node.ComputedClass, alloc.ModifyIndex)
+
+    # ------------------------------------------------------- leader restores
+    def _restore_evals(self) -> None:
+        """Re-hydrate broker + blocked from replicated state
+        (reference: leader.go:176-202)."""
+        for ev in self.state.evals():
+            if ev.should_enqueue():
+                self.eval_broker.enqueue(ev)
+            elif ev.should_block():
+                self.blocked_evals.block(ev)
+
+    def _restore_periodic_dispatcher(self) -> None:
+        """(reference: leader.go:204-243)"""
+        now = time.time()
+        for job in self.state.jobs_by_periodic(True):
+            self.periodic.add(job)
+            launch = self.state.periodic_launch_by_id(job.ID)
+            last = launch.Launch if launch is not None else 0.0
+            nxt = job.Periodic.next(last)
+            if last and nxt < now:
+                # Catch up a missed launch.
+                try:
+                    self._dispatch_periodic(job, nxt)
+                except Exception:
+                    logger.exception("periodic: catch-up launch failed")
+
+    # ------------------------------------------------------- periodic launch
+    def _dispatch_periodic(self, job: Job, launch_time: float) -> None:
+        """Derive and register the child job, deduping by launch table."""
+        launch = self.state.periodic_launch_by_id(job.ID)
+        if launch is not None and launch.Launch >= launch_time:
+            return  # already launched (failover dedupe)
+        if job.Periodic is not None and job.Periodic.ProhibitOverlap:
+            # Skip if any previous child is still non-terminal.
+            children = self.state.jobs_by_id_prefix(job.ID + "/periodic-")
+            for child in children:
+                if child.Status != "dead":
+                    logger.debug("periodic: skipping %s, overlap prohibited",
+                                 job.ID)
+                    return
+        child = derive_job(job, launch_time)
+        self.raft.apply(MessageType.PeriodicLaunchType, {
+            "Launch": PeriodicLaunch(ID=job.ID, Launch=launch_time)})
+        self.job_register(child, trigger=EvalTriggerPeriodicJob)
+
+    # --------------------------------------------------------- reaper loops
+    def _reap_failed_evaluations(self) -> None:
+        """Mark over-delivered evals failed (reference: leader.go:302-332)."""
+        while True:
+            ev, token = self.eval_broker.dequeue([FAILED_QUEUE], timeout=0.01)
+            if ev is None:
+                return
+            updated = ev.copy()
+            updated.Status = EvalStatusFailed
+            updated.StatusDescription = "evaluation reached delivery limit"
+            self.raft.apply(MessageType.EvalUpdate, {"Evals": [updated]})
+            self.eval_broker.ack(ev.ID, token)
+
+    def _reap_dup_blocked_evaluations(self) -> None:
+        """Cancel duplicate blocked evals (reference: leader.go:334-360)."""
+        dups = self.blocked_evals.get_duplicates(0.01)
+        if not dups:
+            return
+        cancelled = []
+        for ev in dups:
+            updated = ev.copy()
+            updated.Status = EvalStatusCancelled
+            updated.StatusDescription = (
+                f"existing blocked evaluation exists for job {ev.JobID}")
+            cancelled.append(updated)
+        self.raft.apply(MessageType.EvalUpdate, {"Evals": cancelled})
+
+    def _schedule_core_gc(self, kind: str) -> None:
+        """(reference: leader.go:246-271 coreJobEval)"""
+        ev = Evaluation(
+            ID=generate_uuid(),
+            Priority=CoreJobPriority,
+            Type=JobTypeCore,
+            TriggeredBy="scheduled",
+            JobID=f"{kind}:{self.raft.last_index}",
+            Status=EvalStatusPending,
+            ModifyIndex=self.raft.last_index,
+        )
+        self.eval_broker.enqueue(ev)
+
+    # ========================================================== endpoints ==
+    # Job endpoint (reference: nomad/job_endpoint.go)
+
+    def job_register(self, job: Job, enforce_index: Optional[int] = None,
+                     trigger: str = EvalTriggerJobRegister
+                     ) -> Tuple[str, int, int]:
+        """Returns (eval_id, job_modify_index, index)."""
+        job.init_fields()
+        if not job.Region:
+            job.Region = self.config.region
+        errs = job.validate()
+        if errs:
+            raise ValueError("; ".join(errs))
+        if enforce_index is not None:
+            existing = self.state.job_by_id(job.ID)
+            cur = existing.JobModifyIndex if existing is not None else 0
+            if cur != enforce_index:
+                raise ValueError(
+                    f"Enforcing job modify index {enforce_index}: "
+                    f"job exists with conflicting job modify index: {cur}")
+        index = self.raft.apply(MessageType.JobRegister, {"Job": job})
+
+        # Periodic parents are launched by the dispatcher, not evaluated.
+        if job.is_periodic():
+            return "", index, index
+
+        ev = Evaluation(
+            ID=generate_uuid(),
+            Priority=job.Priority,
+            Type=job.Type,
+            TriggeredBy=trigger,
+            JobID=job.ID,
+            JobModifyIndex=index,
+            Status=EvalStatusPending,
+        )
+        self.raft.apply(MessageType.EvalUpdate, {"Evals": [ev]})
+        return ev.ID, index, index
+
+    def job_deregister(self, job_id: str) -> Tuple[str, int]:
+        """(reference: job_endpoint.go:155-207)"""
+        job = self.state.job_by_id(job_id)
+        index = self.raft.apply(MessageType.JobDeregister, {"JobID": job_id})
+        priority = job.Priority if job is not None else 50
+        jtype = job.Type if job is not None else JobTypeService
+        ev = Evaluation(
+            ID=generate_uuid(),
+            Priority=priority,
+            Type=jtype,
+            TriggeredBy=EvalTriggerJobDeregister,
+            JobID=job_id,
+            JobModifyIndex=index,
+            Status=EvalStatusPending,
+        )
+        self.raft.apply(MessageType.EvalUpdate, {"Evals": [ev]})
+        return ev.ID, index
+
+    def job_evaluate(self, job_id: str) -> Tuple[str, int]:
+        """Force a re-evaluation (reference: job_endpoint.go:209-257)."""
+        job = self.state.job_by_id(job_id)
+        if job is None:
+            raise KeyError(f"job not found: {job_id}")
+        if job.is_periodic():
+            raise ValueError("can't evaluate periodic job")
+        ev = Evaluation(
+            ID=generate_uuid(),
+            Priority=job.Priority,
+            Type=job.Type,
+            TriggeredBy=EvalTriggerJobRegister,
+            JobID=job.ID,
+            JobModifyIndex=job.JobModifyIndex,
+            Status=EvalStatusPending,
+        )
+        index = self.raft.apply(MessageType.EvalUpdate, {"Evals": [ev]})
+        return ev.ID, index
+
+    def periodic_force(self, job_id: str) -> None:
+        self.periodic.force_run(job_id)
+
+    # Node endpoint (reference: nomad/node_endpoint.go)
+
+    def node_register(self, node: Node) -> Tuple[float, int]:
+        """Returns (heartbeat_ttl, index)."""
+        if node.ID == "":
+            raise ValueError("missing node ID")
+        if node.Datacenter == "":
+            raise ValueError("missing datacenter")
+        if node.Name == "":
+            raise ValueError("missing node name")
+        if node.Status == "":
+            node.Status = NodeStatusInit
+        if not valid_node_status(node.Status):
+            raise ValueError(f"invalid status for node: {node.Status}")
+        from nomad_tpu.structs import compute_node_class
+
+        compute_node_class(node)
+        index = self.raft.apply(MessageType.NodeRegister, {"Node": node})
+        ttl = self.heartbeats.reset_heartbeat_timer(node.ID)
+        if node.Status == NodeStatusReady:
+            self._create_node_evals(node.ID, index)
+        return ttl, index
+
+    def node_update_status(self, node_id: str, status: str) -> Tuple[float, int]:
+        """(reference: node_endpoint.go:194-235)"""
+        if not valid_node_status(status):
+            raise ValueError(f"invalid status for node: {status}")
+        node = self.state.node_by_id(node_id)
+        if node is None:
+            raise KeyError(f"node not found: {node_id}")
+        index = self.raft.apply(MessageType.NodeUpdateStatus,
+                                {"NodeID": node_id, "Status": status})
+        if status != node.Status:
+            self._create_node_evals(node_id, index)
+        if status == NodeStatusDown:
+            self.heartbeats.clear_heartbeat_timer(node_id)
+            ttl = 0.0
+        else:
+            ttl = self.heartbeats.reset_heartbeat_timer(node_id)
+        return ttl, index
+
+    def node_heartbeat(self, node_id: str) -> float:
+        node = self.state.node_by_id(node_id)
+        if node is None:
+            raise KeyError(f"node not found: {node_id}")
+        return self.heartbeats.reset_heartbeat_timer(node_id)
+
+    def node_update_drain(self, node_id: str, drain: bool) -> int:
+        node = self.state.node_by_id(node_id)
+        if node is None:
+            raise KeyError(f"node not found: {node_id}")
+        index = self.raft.apply(MessageType.NodeUpdateDrain,
+                                {"NodeID": node_id, "Drain": drain})
+        if drain:
+            self._create_node_evals(node_id, index)
+        return index
+
+    def node_deregister(self, node_id: str) -> int:
+        index = self.raft.apply(MessageType.NodeDeregister,
+                                {"NodeID": node_id})
+        self._create_node_evals(node_id, index)
+        self.heartbeats.clear_heartbeat_timer(node_id)
+        return index
+
+    def node_evaluate(self, node_id: str) -> List[str]:
+        node = self.state.node_by_id(node_id)
+        if node is None:
+            raise KeyError(f"node not found: {node_id}")
+        return self._create_node_evals(node_id, self.raft.last_index)
+
+    def _create_node_evals(self, node_id: str, index: int) -> List[str]:
+        """One eval per job with allocs on the node + system jobs
+        (reference: node_endpoint.go:650-720)."""
+        evals: List[Evaluation] = []
+        job_ids = set()
+        for alloc in self.state.allocs_by_node(node_id):
+            if alloc.JobID in job_ids:
+                continue
+            job_ids.add(alloc.JobID)
+            job = self.state.job_by_id(alloc.JobID)
+            priority = job.Priority if job is not None else 50
+            jtype = job.Type if job is not None else JobTypeService
+            evals.append(Evaluation(
+                ID=generate_uuid(), Priority=priority, Type=jtype,
+                TriggeredBy=EvalTriggerNodeUpdate, JobID=alloc.JobID,
+                NodeID=node_id, NodeModifyIndex=index,
+                Status=EvalStatusPending))
+        for job in self.state.jobs_by_scheduler(JobTypeSystem):
+            if job.ID in job_ids:
+                continue
+            evals.append(Evaluation(
+                ID=generate_uuid(), Priority=job.Priority, Type=job.Type,
+                TriggeredBy=EvalTriggerNodeUpdate, JobID=job.ID,
+                NodeID=node_id, NodeModifyIndex=index,
+                Status=EvalStatusPending))
+        if evals:
+            self.raft.apply(MessageType.EvalUpdate, {"Evals": evals})
+        return [e.ID for e in evals]
+
+    def node_update_allocs(self, allocs: List[Allocation]) -> int:
+        """Client alloc status sync (reference: node_endpoint.go:530-593)."""
+        return self.raft.apply(MessageType.AllocClientUpdate,
+                               {"Alloc": allocs})
+
+    def _invalidate_heartbeat(self, node_id: str) -> None:
+        """(reference: heartbeat.go:84-107)"""
+        try:
+            self.node_update_status(node_id, NodeStatusDown)
+        except KeyError:
+            pass
+
+    # System endpoint (reference: nomad/system_endpoint.go)
+
+    def force_gc(self) -> None:
+        ev = Evaluation(
+            ID=generate_uuid(), Priority=CoreJobPriority, Type=JobTypeCore,
+            TriggeredBy="scheduled",
+            JobID=f"{CoreJobForceGC}:{self.raft.last_index}",
+            Status=EvalStatusPending)
+        self.eval_broker.enqueue(ev)

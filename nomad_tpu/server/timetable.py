@@ -1,0 +1,53 @@
+"""TimeTable: sparse Raft-index <-> wallclock mapping (reference:
+nomad/timetable.go).
+
+GC thresholds are expressed in time but state is indexed by Raft index; the
+timetable witnesses (index, time) pairs at a bounded granularity so
+NearestIndex(time) can translate.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import List, Tuple
+
+
+class TimeTable:
+    def __init__(self, granularity: float = 300.0, limit: float = 72 * 3600.0):
+        self.granularity = granularity
+        self.limit = limit
+        self._lock = threading.Lock()
+        self._table: List[Tuple[int, float]] = []  # newest first
+
+    def witness(self, index: int, when: float) -> None:
+        with self._lock:
+            if self._table and when - self._table[0][1] < self.granularity:
+                return
+            self._table.insert(0, (index, when))
+            # Prune entries beyond the limit.
+            cutoff = when - self.limit
+            while self._table and self._table[-1][1] < cutoff:
+                self._table.pop()
+
+    def nearest_index(self, when: float) -> int:
+        """Largest index witnessed at or before `when`."""
+        with self._lock:
+            for index, t in self._table:
+                if t <= when:
+                    return index
+            return 0
+
+    def nearest_time(self, index: int) -> float:
+        with self._lock:
+            for idx, t in self._table:
+                if idx <= index:
+                    return t
+            return 0.0
+
+    def serialize(self) -> List[Tuple[int, float]]:
+        with self._lock:
+            return list(self._table)
+
+    def deserialize(self, data) -> None:
+        with self._lock:
+            self._table = [(int(i), float(t)) for i, t in data]

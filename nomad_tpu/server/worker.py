@@ -1,0 +1,190 @@
+"""Worker: the per-server scheduling loop (reference: nomad/worker.go).
+
+Dequeue an evaluation from the broker, wait for the state store to catch up
+to the eval's modify index, snapshot, run the scheduler, act as its Planner
+(submitting plans to the leader's plan queue and creating/updating evals
+through consensus), then ack/nack.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import List, Optional, Tuple
+
+from nomad_tpu.scheduler import new_scheduler
+from nomad_tpu.scheduler.scheduler import SetStatusError
+from nomad_tpu.structs import Evaluation, Plan, PlanResult
+from nomad_tpu.structs.structs import EvalStatusBlocked
+from nomad_tpu.tensor import TensorIndex
+
+from .blocked_evals import BlockedEvals
+from .eval_broker import EvalBroker
+from .fsm import DevRaft, MessageType
+from .plan_queue import PlanQueue
+
+logger = logging.getLogger("nomad.worker")
+
+# Backoff for failed dequeues (reference: worker.go:32-40)
+BACKOFF_BASELINE = 0.02
+BACKOFF_LIMIT = 1.0
+
+RAFT_SYNC_LIMIT = 10.0  # max wait for state to catch up (worker.go:214)
+DEQUEUE_TIMEOUT = 0.5
+
+
+class Worker:
+    def __init__(self, raft: DevRaft, eval_broker: EvalBroker,
+                 plan_queue: PlanQueue,
+                 blocked_evals: Optional[BlockedEvals] = None,
+                 tindex: Optional[TensorIndex] = None,
+                 schedulers: Optional[List[str]] = None):
+        self.raft = raft
+        self.eval_broker = eval_broker
+        self.plan_queue = plan_queue
+        self.blocked_evals = blocked_evals
+        self.tindex = tindex
+        self.schedulers = schedulers or ["service", "batch", "system"]
+        self._stop = threading.Event()
+        self._paused = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._token: str = ""
+        self._eval: Optional[Evaluation] = None
+        self._snapshot = None
+        # Set by the server: handles `_core` GC evals (reference:
+        # worker.go invokeScheduler -> scheduler.NewScheduler("_core")).
+        self.core_scheduler = None
+
+    # ------------------------------------------------------------- lifecycle
+    def start(self, name: str = "worker") -> None:
+        self._stop.clear()
+        self._thread = threading.Thread(target=self.run, daemon=True, name=name)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    def set_pause(self, paused: bool) -> None:
+        """(reference: worker.go:81-99) Pause during leadership transitions."""
+        if paused:
+            self._paused.set()
+        else:
+            self._paused.clear()
+
+    # -------------------------------------------------------------- run loop
+    def run(self) -> None:
+        """(reference: worker.go:101-130)"""
+        while not self._stop.is_set():
+            if self._paused.is_set():
+                time.sleep(0.05)
+                continue
+            got = self._dequeue_evaluation()
+            if got is None:
+                continue
+            ev, token = got
+            self._eval, self._token = ev, token
+            try:
+                self._wait_for_index(ev.ModifyIndex)
+                self._invoke_scheduler(ev, token)
+            except Exception:
+                logger.exception("worker: failed to process eval %s", ev.ID)
+                self._send_nack(ev.ID, token)
+                continue
+            self._send_ack(ev.ID, token)
+
+    def process_one(self, timeout: float = DEQUEUE_TIMEOUT) -> bool:
+        """Synchronous single-step variant (dev mode / tests).
+        Returns True if an eval was processed."""
+        got = self._dequeue_evaluation(timeout)
+        if got is None:
+            return False
+        ev, token = got
+        try:
+            self._wait_for_index(ev.ModifyIndex)
+            self._invoke_scheduler(ev, token)
+        except Exception:
+            logger.exception("worker: failed to process eval %s", ev.ID)
+            self._send_nack(ev.ID, token)
+            return True
+        self._send_ack(ev.ID, token)
+        return True
+
+    def _dequeue_evaluation(self, timeout: float = DEQUEUE_TIMEOUT
+                            ) -> Optional[Tuple[Evaluation, str]]:
+        try:
+            ev, token = self.eval_broker.dequeue(self.schedulers, timeout)
+        except RuntimeError:
+            time.sleep(BACKOFF_BASELINE)
+            return None
+        if ev is None:
+            return None
+        return ev, token
+
+    def _wait_for_index(self, index: int) -> None:
+        """Raft-sync barrier (reference: worker.go:214-244)."""
+        deadline = time.monotonic() + RAFT_SYNC_LIMIT
+        while self.raft.fsm.state.latest_index() < index:
+            if time.monotonic() > deadline:
+                raise TimeoutError(f"timed out waiting for index {index}")
+            time.sleep(0.001)
+
+    def _invoke_scheduler(self, ev: Evaluation, token: str) -> None:
+        """(reference: worker.go:246-283)"""
+        self._snapshot = self.raft.fsm.state.snapshot()
+        if ev.Type == "_core":
+            if self.core_scheduler is not None:
+                self.core_scheduler.process(ev)
+            return
+        sched = new_scheduler(ev.Type, self._snapshot, self, self.tindex,
+                              logger)
+        sched.process(ev)
+
+    # ------------------------------------------------------------ ack / nack
+    def _send_ack(self, eval_id: str, token: str) -> None:
+        try:
+            self.eval_broker.ack(eval_id, token)
+        except Exception:
+            logger.exception("worker: ack failed for %s", eval_id)
+
+    def _send_nack(self, eval_id: str, token: str) -> None:
+        try:
+            self.eval_broker.nack(eval_id, token)
+        except Exception:
+            logger.exception("worker: nack failed for %s", eval_id)
+
+    # --------------------------------------------------------- Planner seam
+    def submit_plan(self, plan: Plan) -> Tuple[Optional[PlanResult], Optional[object]]:
+        """(reference: worker.go:285-342)"""
+        plan.EvalToken = self._token
+        pending = self.plan_queue.enqueue(plan)
+        # Keep the nack timer fresh while we wait on the applier.
+        self.eval_broker.outstanding_reset(plan.EvalID, self._token)
+        result = pending.wait(timeout=30.0)
+
+        # If the state is behind the plan result, refresh before retrying.
+        state = None
+        if result is not None and result.RefreshIndex > 0:
+            self._wait_for_index(result.RefreshIndex)
+            state = self.raft.fsm.state.snapshot()
+        return result, state
+
+    def update_eval(self, ev: Evaluation) -> None:
+        """(reference: worker.go:345-371)"""
+        self.eval_broker.outstanding_reset(ev.ID, self._token)
+        self.raft.apply(MessageType.EvalUpdate, {"Evals": [ev],
+                                                 "EvalToken": self._token})
+
+    def create_eval(self, ev: Evaluation) -> None:
+        """(reference: worker.go:373-398)"""
+        ev.SnapshotIndex = self._snapshot.latest_index() if self._snapshot else 0
+        self.eval_broker.outstanding_reset(self._eval.ID, self._token)
+        self.raft.apply(MessageType.EvalUpdate, {"Evals": [ev],
+                                                 "EvalToken": self._token})
+
+    def reblock_eval(self, ev: Evaluation) -> None:
+        """(reference: worker.go:400-426)"""
+        self.eval_broker.outstanding_reset(ev.ID, self._token)
+        ev.SnapshotIndex = self._snapshot.latest_index() if self._snapshot else 0
+        self.raft.apply(MessageType.EvalUpdate, {"Evals": [ev],
+                                                 "EvalToken": self._token})

@@ -1074,15 +1074,13 @@ class Plan:
     Annotations: Optional["PlanAnnotations"] = None
 
     def append_update(self, alloc: Allocation, status: str, desc: str) -> None:
-        # Strip the embedded job BEFORE copying: the plan carries the job
-        # once, and deep-copying it per evicted alloc would dominate plan
-        # construction cost on large jobs.
-        saved_job = alloc.Job
-        alloc.Job = None
-        try:
-            new_alloc = alloc.copy()
-        finally:
-            alloc.Job = saved_job
+        # Strip the embedded job from a SHALLOW copy before deep-copying:
+        # the plan carries the job once, so deep-copying it per evicted alloc
+        # would dominate plan construction — and the shallow copy means the
+        # store-shared alloc object is never mutated (other threads read it).
+        shallow = copy.copy(alloc)
+        shallow.Job = None
+        new_alloc = copy.deepcopy(shallow)
         new_alloc.DesiredStatus = status
         new_alloc.DesiredDescription = desc
         self.NodeUpdate.setdefault(alloc.NodeID, []).append(new_alloc)

@@ -64,8 +64,9 @@ class Version:
 
 
 def _cmp_prerelease(a: str, b: str) -> int:
-    """Semver-style dot-segment comparison: numeric segments compare as
-    integers (rc.9 < rc.10), numeric < alphanumeric, shorter < longer."""
+    """Semver dot-segment comparison: numeric identifiers compare as
+    integers (rc.9 < rc.10), numeric < alphanumeric, alphanumeric compare
+    ASCII-lexically, shorter sequence < longer when equal so far."""
     for sa, sb in zip(a.split("."), b.split(".")):
         na, nb = sa.isdigit(), sb.isdigit()
         if na and nb:
@@ -75,12 +76,6 @@ def _cmp_prerelease(a: str, b: str) -> int:
         elif na != nb:
             return -1 if na else 1
         elif sa != sb:
-            # Compare embedded trailing numbers numerically (rc10 vs rc9).
-            ma = re.match(r"^(\D*)(\d*)$", sa)
-            mb = re.match(r"^(\D*)(\d*)$", sb)
-            if (ma and mb and ma.group(1) == mb.group(1)
-                    and ma.group(2) and mb.group(2)):
-                return -1 if int(ma.group(2)) < int(mb.group(2)) else 1
             return -1 if sa < sb else 1
     la, lb = len(a.split(".")), len(b.split("."))
     if la != lb:

@@ -22,11 +22,15 @@ from .node_table import NodeTensor
 class TensorIndex:
     def __init__(self, nt: Optional[NodeTensor] = None):
         self.nt = nt or NodeTensor()
+        # True when subscribed to a store's change feed (stays in sync and
+        # must not be discarded on state refresh).
+        self.attached = False
 
     @staticmethod
     def attach(store: StateStore) -> "TensorIndex":
         """Production mode: subscribe to store changes and stay in sync."""
         idx = TensorIndex()
+        idx.attached = True
         for node in store.nodes():
             idx.nt.upsert_node(node)
         for alloc in store.allocs():

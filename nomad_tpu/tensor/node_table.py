@@ -185,6 +185,7 @@ class NodeTensor:
     # --------------------------------------------------------- device sync
     def device_arrays(self) -> dict:
         """Return jax device arrays, refreshing dirty rows via scatter."""
+        ensure_backend()
         import jax.numpy as jnp
 
         with self._lock:
@@ -219,6 +220,33 @@ class NodeTensor:
             if class_ok is not None:
                 mask &= class_ok[self.class_ids]
             return mask
+
+
+_BACKEND_CHECKED = False
+
+
+def ensure_backend() -> None:
+    """Fail over to any available JAX backend if the configured one is gone.
+
+    A scheduler must keep placing when an accelerator platform fails to
+    initialize (e.g. a remote-TPU plugin configured in the environment but
+    not registered); XLA:CPU runs the same programs.
+    """
+    global _BACKEND_CHECKED
+    if _BACKEND_CHECKED:
+        return
+    import jax
+
+    try:
+        jax.devices()
+    except RuntimeError:
+        import logging
+
+        logging.getLogger("nomad.tensor").warning(
+            "configured JAX backend unavailable; falling back to auto-detect")
+        jax.config.update("jax_platforms", "")
+        jax.devices()
+    _BACKEND_CHECKED = True
 
 
 def _next_pow2(n: int) -> int:

@@ -2,17 +2,23 @@
 
 Multi-chip TPU hardware is not available in CI; sharding tests run against
 8 virtual CPU devices (the driver separately dry-runs the multi-chip path via
-__graft_entry__.dryrun_multichip).
+__graft_entry__.dryrun_multichip). The axon TPU plugin overrides
+JAX_PLATFORMS from sitecustomize, so the config must be forced
+programmatically before any backend initializes.
 """
 
 import os
 import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
 xla_flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in xla_flags:
     os.environ["XLA_FLAGS"] = (
         xla_flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

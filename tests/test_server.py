@@ -1,0 +1,418 @@
+"""Server services tests (shaped after reference nomad/eval_broker_test.go,
+blocked_evals_test.go, plan_apply_test.go, leader_test.go scenarios)."""
+
+import time
+
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.server import (
+    BlockedEvals,
+    DevRaft,
+    EvalBroker,
+    FSM,
+    MessageType,
+    PlanQueue,
+    Server,
+    ServerConfig,
+    TimeTable,
+    evaluate_plan,
+)
+from nomad_tpu.server.eval_broker import FAILED_QUEUE, TokenMismatchError
+from nomad_tpu.structs import Plan
+from nomad_tpu.structs.structs import (
+    AllocClientStatusComplete,
+    EvalStatusBlocked,
+    EvalStatusComplete,
+    EvalStatusPending,
+    NodeStatusDown,
+    NodeStatusReady,
+)
+
+
+def wait_for(cond, timeout=15.0, interval=0.05):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if cond():
+            return True
+        time.sleep(interval)
+    return False
+
+
+class TestEvalBroker:
+    def _broker(self, **kw):
+        b = EvalBroker(**{"nack_timeout": 5.0, "delivery_limit": 3, **kw})
+        b.set_enabled(True)
+        return b
+
+    def test_enqueue_dequeue_ack(self):
+        b = self._broker()
+        ev = mock.eval()
+        b.enqueue(ev)
+        out, token = b.dequeue(["service"], timeout=1)
+        assert out.ID == ev.ID
+        assert b.outstanding(ev.ID) == token
+        b.ack(ev.ID, token)
+        assert b.outstanding(ev.ID) is None
+        out2, _ = b.dequeue(["service"], timeout=0.1)
+        assert out2 is None
+
+    def test_priority_order(self):
+        b = self._broker()
+        low, high = mock.eval(), mock.eval()
+        low.Priority = 10
+        high.Priority = 90
+        b.enqueue(low)
+        b.enqueue(high)
+        first, t1 = b.dequeue(["service"], timeout=1)
+        assert first.ID == high.ID
+
+    def test_scheduler_type_routing(self):
+        b = self._broker()
+        ev = mock.eval()
+        ev.Type = "batch"
+        b.enqueue(ev)
+        none, _ = b.dequeue(["system"], timeout=0.1)
+        assert none is None
+        got, _ = b.dequeue(["batch", "system"], timeout=1)
+        assert got.ID == ev.ID
+
+    def test_job_serialization(self):
+        """Two evals for one job: second waits until first is acked."""
+        b = self._broker()
+        e1, e2 = mock.eval(), mock.eval()
+        e2.JobID = e1.JobID
+        b.enqueue(e1)
+        b.enqueue(e2)
+        got1, t1 = b.dequeue(["service"], timeout=1)
+        none, _ = b.dequeue(["service"], timeout=0.1)
+        assert none is None, "second eval for same job must be held"
+        b.ack(got1.ID, t1)
+        got2, t2 = b.dequeue(["service"], timeout=1)
+        assert got2.ID == e2.ID
+        b.ack(got2.ID, t2)
+
+    def test_nack_requeues(self):
+        b = self._broker()
+        ev = mock.eval()
+        b.enqueue(ev)
+        got, token = b.dequeue(["service"], timeout=1)
+        b.nack(got.ID, token)
+        got2, token2 = b.dequeue(["service"], timeout=1)
+        assert got2.ID == ev.ID
+        assert token2 != token
+
+    def test_nack_timeout_redelivery(self):
+        b = self._broker(nack_timeout=0.1)
+        ev = mock.eval()
+        b.enqueue(ev)
+        got, token = b.dequeue(["service"], timeout=1)
+        # Don't ack; wait for auto-nack.
+        got2, token2 = b.dequeue(["service"], timeout=2)
+        assert got2.ID == ev.ID
+        with pytest.raises(TokenMismatchError):
+            b.ack(ev.ID, token)  # stale token rejected
+        b.ack(ev.ID, token2)
+
+    def test_delivery_limit_failed_queue(self):
+        b = self._broker(delivery_limit=2)
+        ev = mock.eval()
+        b.enqueue(ev)
+        for _ in range(2):
+            got, token = b.dequeue(["service"], timeout=1)
+            b.nack(got.ID, token)
+        got, token = b.dequeue([FAILED_QUEUE], timeout=1)
+        assert got.ID == ev.ID
+
+    def test_wait_time_deferral(self):
+        b = self._broker()
+        ev = mock.eval()
+        ev.Wait = int(0.2 * 1e9)
+        b.enqueue(ev)
+        none, _ = b.dequeue(["service"], timeout=0.05)
+        assert none is None
+        got, _ = b.dequeue(["service"], timeout=2)
+        assert got.ID == ev.ID
+
+    def test_disabled_drops(self):
+        b = EvalBroker(5.0, 3)
+        b.enqueue(mock.eval())
+        b.set_enabled(True)
+        none, _ = b.dequeue(["service"], timeout=0.05)
+        assert none is None
+
+
+class TestBlockedEvals:
+    def _setup(self):
+        broker = EvalBroker(5.0, 3)
+        broker.set_enabled(True)
+        blocked = BlockedEvals(broker)
+        blocked.set_enabled(True)
+        return broker, blocked
+
+    def test_block_and_unblock_by_class(self):
+        broker, blocked = self._setup()
+        ev = mock.eval()
+        ev.Status = EvalStatusBlocked
+        ev.ClassEligibility = {"v1:123": True}
+        ev.SnapshotIndex = 10
+        blocked.block(ev)
+        assert blocked.stats.TotalBlocked == 1
+        blocked.unblock("v1:123", 20)
+        assert wait_for(lambda: broker.dequeue(["service"], timeout=0.1)[0] is not None)
+
+    def test_ineligible_class_not_unblocked(self):
+        broker, blocked = self._setup()
+        ev = mock.eval()
+        ev.Status = EvalStatusBlocked
+        ev.ClassEligibility = {"v1:bad": False}
+        ev.SnapshotIndex = 10
+        blocked.block(ev)
+        blocked.unblock("v1:bad", 20)
+        time.sleep(0.3)
+        got, _ = broker.dequeue(["service"], timeout=0.05)
+        assert got is None
+        assert blocked.stats.TotalBlocked == 1
+
+    def test_unknown_class_unblocks(self):
+        """A class the eval never saw must unblock it (correctness rule)."""
+        broker, blocked = self._setup()
+        ev = mock.eval()
+        ev.Status = EvalStatusBlocked
+        ev.ClassEligibility = {"v1:other": False}
+        ev.SnapshotIndex = 10
+        blocked.block(ev)
+        blocked.unblock("v1:new-class", 20)
+        assert wait_for(lambda: blocked.stats.TotalBlocked == 0)
+
+    def test_escaped_always_unblocked(self):
+        broker, blocked = self._setup()
+        ev = mock.eval()
+        ev.Status = EvalStatusBlocked
+        ev.EscapedComputedClass = True
+        ev.SnapshotIndex = 10
+        blocked.block(ev)
+        assert blocked.stats.TotalEscaped == 1
+        blocked.unblock("v1:anything", 20)
+        assert wait_for(lambda: blocked.stats.TotalBlocked == 0)
+
+    def test_missed_unblock(self):
+        """Eval whose snapshot predates an unblock enqueues immediately."""
+        broker, blocked = self._setup()
+        blocked.unblock("v1:123", 100)
+        time.sleep(0.1)
+        ev = mock.eval()
+        ev.Status = EvalStatusBlocked
+        ev.ClassEligibility = {"v1:123": True}
+        ev.SnapshotIndex = 50  # older than unblock index 100
+        blocked.block(ev)
+        got, _ = broker.dequeue(["service"], timeout=1)
+        assert got is not None and got.ID == ev.ID
+
+    def test_duplicates(self):
+        broker, blocked = self._setup()
+        e1, e2 = mock.eval(), mock.eval()
+        e2.JobID = e1.JobID
+        for e in (e1, e2):
+            e.Status = EvalStatusBlocked
+        blocked.block(e1)
+        blocked.block(e2)
+        dups = blocked.get_duplicates(0.5)
+        assert [d.ID for d in dups] == [e2.ID]
+
+
+class TestPlanApply:
+    def test_evaluate_plan_partial_commit(self):
+        fsm = FSM()
+        raft = DevRaft(fsm)
+        node = mock.node()
+        raft.apply(MessageType.NodeRegister, {"Node": node})
+        # Fill the node almost completely.
+        big = mock.alloc()
+        big.NodeID = node.ID
+        big.Resources.CPU = 3800
+        big.TaskResources = {}
+        raft.apply(MessageType.AllocUpdate, {"Alloc": [big], "Job": big.Job})
+
+        plan = Plan(EvalID="e1", Priority=50)
+        ok_alloc = mock.alloc()
+        ok_alloc.NodeID = node.ID
+        ok_alloc.Resources.CPU = 50
+        ok_alloc.TaskResources = {}
+        plan.NodeAllocation[node.ID] = [ok_alloc]
+        ghost = mock.alloc()
+        ghost.NodeID = "missing-node"
+        plan.NodeAllocation["missing-node"] = [ghost]
+
+        result = evaluate_plan(fsm.state.snapshot(), plan)
+        assert node.ID in result.NodeAllocation
+        assert "missing-node" not in result.NodeAllocation
+        assert result.RefreshIndex > 0  # partial commit
+
+    def test_evaluate_plan_all_at_once_fails_whole(self):
+        fsm = FSM()
+        raft = DevRaft(fsm)
+        node = mock.node()
+        raft.apply(MessageType.NodeRegister, {"Node": node})
+        plan = Plan(EvalID="e1", Priority=50, AllAtOnce=True)
+        ok_alloc = mock.alloc()
+        ok_alloc.NodeID = node.ID
+        plan.NodeAllocation[node.ID] = [ok_alloc]
+        plan.NodeAllocation["missing"] = [mock.alloc()]
+        result = evaluate_plan(fsm.state.snapshot(), plan)
+        assert result.NodeAllocation == {}
+
+    def test_overcommit_rejected(self):
+        fsm = FSM()
+        raft = DevRaft(fsm)
+        node = mock.node()
+        raft.apply(MessageType.NodeRegister, {"Node": node})
+        plan = Plan(EvalID="e1", Priority=50)
+        huge = mock.alloc()
+        huge.NodeID = node.ID
+        huge.Resources.CPU = 100000
+        huge.TaskResources = {}
+        plan.NodeAllocation[node.ID] = [huge]
+        result = evaluate_plan(fsm.state.snapshot(), plan)
+        assert result.NodeAllocation == {}
+        assert result.RefreshIndex > 0
+
+
+class TestTimeTable:
+    def test_witness_and_lookup(self):
+        tt = TimeTable(granularity=1.0)
+        tt.witness(100, 1000.0)
+        tt.witness(200, 2000.0)
+        assert tt.nearest_index(1500.0) == 100
+        assert tt.nearest_index(2500.0) == 200
+        assert tt.nearest_index(500.0) == 0
+
+    def test_granularity_dedupe(self):
+        tt = TimeTable(granularity=10.0)
+        tt.witness(1, 100.0)
+        tt.witness(2, 101.0)  # within granularity: dropped
+        assert tt.nearest_index(200.0) == 1
+
+
+class TestServerIntegration:
+    def _server(self, ttl: float = 60.0, grace: float = 30.0):
+        srv = Server(ServerConfig(num_schedulers=2, min_heartbeat_ttl=ttl,
+                                  heartbeat_grace=grace))
+        srv.establish_leadership()
+        return srv
+
+    def test_full_pipeline(self):
+        srv = self._server()
+        try:
+            for _ in range(3):
+                srv.node_register(mock.node())
+            job = mock.job()
+            eval_id, _, _ = srv.job_register(job)
+            assert wait_for(lambda: (
+                (e := srv.state.eval_by_id(eval_id)) is not None
+                and e.Status == EvalStatusComplete))
+            allocs = srv.state.allocs_by_job(job.ID)
+            assert len(allocs) == 10
+            assert srv.state.job_by_id(job.ID).Status == "running"
+        finally:
+            srv.shutdown()
+
+    def test_blocked_then_capacity_arrives(self):
+        srv = self._server()
+        try:
+            job = mock.job()
+            job.TaskGroups[0].Count = 2
+            eval_id, _, _ = srv.job_register(job)
+            # No nodes: placement fails, blocked eval parks.
+            assert wait_for(lambda: srv.blocked_evals.stats.TotalBlocked == 1)
+            # Capacity arrives: node registration unblocks by class.
+            srv.node_register(mock.node())
+            assert wait_for(lambda: len([
+                a for a in srv.state.allocs_by_job(job.ID)
+                if not a.terminal_status()]) == 2, timeout=20)
+        finally:
+            srv.shutdown()
+
+    def test_heartbeat_expiry_marks_down_and_reschedules(self):
+        srv = self._server(ttl=0.3, grace=0.2)
+        try:
+            n1 = mock.node()
+            srv.node_register(n1)
+            srv.node_update_status(n1.ID, NodeStatusReady)
+            job = mock.job()
+            job.TaskGroups[0].Count = 2
+            eval_id, _, _ = srv.job_register(job)
+            assert wait_for(lambda: len(srv.state.allocs_by_job(job.ID)) == 2)
+            # Stop heartbeating n1; second node will take the migrations.
+            n2 = mock.node()
+            srv.node_register(n2)
+            srv.node_update_status(n2.ID, NodeStatusReady)
+
+            def n2_keepalive():
+                try:
+                    srv.node_heartbeat(n2.ID)
+                except KeyError:
+                    pass
+                return srv.state.node_by_id(n1.ID).Status == NodeStatusDown
+
+            assert wait_for(n2_keepalive, timeout=20, interval=0.2)
+
+            # All running allocs end up on n2 (keep n2's heartbeat alive
+            # while we wait).
+            def migrated():
+                try:
+                    srv.node_heartbeat(n2.ID)
+                except KeyError:
+                    pass
+                allocs = srv.state.allocs_by_job(job.ID)
+                running = [a for a in allocs if not a.terminal_status()]
+                return running and all(a.NodeID == n2.ID for a in running)
+
+            assert wait_for(migrated, timeout=20, interval=0.2)
+        finally:
+            srv.shutdown()
+
+    def test_enforce_index(self):
+        srv = self._server()
+        try:
+            job = mock.job()
+            _, jmi, _ = srv.job_register(job)
+            with pytest.raises(ValueError, match="Enforcing job modify index"):
+                srv.job_register(job.copy(), enforce_index=jmi + 5)
+            srv.job_register(job.copy(), enforce_index=jmi)
+        finally:
+            srv.shutdown()
+
+    def test_periodic_job_dispatch(self):
+        srv = self._server()
+        try:
+            job = mock.job()
+            job.Type = "batch"
+            from nomad_tpu.structs import PeriodicConfig
+            from nomad_tpu.structs.structs import PeriodicSpecTest
+            nxt = time.time() + 0.5
+            job.Periodic = PeriodicConfig(Enabled=True,
+                                          SpecType=PeriodicSpecTest,
+                                          Spec=f"{nxt}")
+            srv.node_register(mock.node())
+            eval_id, _, _ = srv.job_register(job)
+            assert eval_id == ""  # periodic parents aren't evaluated directly
+            assert wait_for(lambda: len(
+                srv.state.jobs_by_id_prefix(job.ID + "/periodic-")) == 1,
+                timeout=20)
+            launch = srv.state.periodic_launch_by_id(job.ID)
+            assert launch is not None
+        finally:
+            srv.shutdown()
+
+    def test_force_gc(self):
+        srv = self._server()
+        try:
+            node = mock.node()
+            srv.node_register(node)
+            srv.node_update_status(node.ID, NodeStatusDown)
+            srv.force_gc()
+            assert wait_for(
+                lambda: srv.state.node_by_id(node.ID) is None, timeout=20)
+        finally:
+            srv.shutdown()

@@ -295,6 +295,14 @@ class TestVersionConstraint:
         assert check_version_constraint("1.2.9", "~> 1.2.3")
         assert not check_version_constraint("1.3.0", "~> 1.2.3")
 
+    def test_prerelease_ordering_semver(self):
+        # Dotted numeric identifiers compare numerically...
+        assert check_version_constraint("1.0.0-rc.10", "> 1.0.0-rc.9")
+        # ...but alphanumeric identifiers compare ASCII-lexically (semver):
+        # "rc10" < "rc9".
+        assert not check_version_constraint("1.0.0-rc10", "> 1.0.0-rc9")
+        assert check_version_constraint("1.0.0", "> 1.0.0-rc.10")
+
 
 class TestPeriodic:
     def test_cron_next(self):

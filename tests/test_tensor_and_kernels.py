@@ -1,0 +1,322 @@
+"""Tensor layer, kernel, pipelined placer, and multi-chip sharding tests."""
+
+import random
+
+import numpy as np
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.structs import Constraint, compute_node_class
+from nomad_tpu.tensor import ClassEligibility, NodeTensor, TensorIndex
+from nomad_tpu.tensor.node_table import RES_DIMS, resources_vec
+
+
+class TestNodeTensor:
+    def test_upsert_and_usage(self):
+        nt = NodeTensor()
+        n = mock.node()
+        nt.upsert_node(n)
+        row = nt.row_of[n.ID]
+        assert nt.capacity[row][0] == 4000
+        assert nt.usage[row][0] == 100  # reserved CPU counts as usage
+        assert nt.score_cap[row][0] == 3900
+        a = mock.alloc()
+        a.NodeID = n.ID
+        nt.add_alloc_usage(a)
+        assert nt.usage[row][0] == 600
+        nt.remove_alloc_usage(a)
+        assert nt.usage[row][0] == 100
+
+    def test_row_reuse_and_growth(self):
+        nt = NodeTensor(capacity_hint=2)
+        nodes = [mock.node() for _ in range(100)]
+        for n in nodes:
+            nt.upsert_node(n)
+        assert nt.n_rows >= 100
+        rows = {nt.row_of[n.ID] for n in nodes}
+        assert len(rows) == 100
+        nt.remove_node(nodes[0].ID)
+        n_new = mock.node()
+        nt.upsert_node(n_new)
+        assert nt.row_of[n_new.ID] in range(nt.n_rows)
+
+    def test_device_sync_dirty_rows(self):
+        nt = NodeTensor()
+        n = mock.node()
+        nt.upsert_node(n)
+        d1 = nt.device_arrays()
+        row = nt.row_of[n.ID]
+        a = mock.alloc()
+        a.NodeID = n.ID
+        nt.add_alloc_usage(a)
+        d2 = nt.device_arrays()
+        assert float(d2["usage"][row][0]) == nt.usage[row][0]
+
+    def test_reserved_change_preserves_alloc_usage(self):
+        nt = NodeTensor()
+        n = mock.node()
+        nt.upsert_node(n)
+        a = mock.alloc()
+        a.NodeID = n.ID
+        nt.add_alloc_usage(a)
+        row = nt.row_of[n.ID]
+        before = nt.usage[row].copy()
+        # Re-upsert with doubled reservation.
+        n2 = n.copy()
+        n2.Reserved.CPU = 200
+        nt.upsert_node(n2)
+        assert nt.usage[row][0] == before[0] + 100
+
+
+class TestClassEligibility:
+    def test_class_memoization_and_escape(self):
+        nt = NodeTensor()
+        nodes = [mock.node() for _ in range(4)]
+        nodes[2].Attributes["kernel.name"] = "windows"
+        nodes[3].Attributes["unique.special"] = "yes"
+        for n in nodes:
+            compute_node_class(n)
+            nt.upsert_node(n)
+        elig = ClassEligibility(nt, nodes)
+        cons = [Constraint(LTarget="${attr.kernel.name}", RTarget="linux",
+                           Operand="=")]
+        mask, table, escaped = elig.job_mask("j1", cons)
+        assert not escaped
+        rows = [nt.row_of[n.ID] for n in nodes]
+        assert mask[rows[0]] and mask[rows[1]] and mask[rows[3]]
+        assert not mask[rows[2]]
+
+    def test_escaped_constraint_per_node(self):
+        nt = NodeTensor()
+        n1, n2 = mock.node(), mock.node()
+        n1.Attributes["unique.network.ip-address"] = "10.0.0.1"
+        n2.Attributes["unique.network.ip-address"] = "10.0.0.2"
+        for n in (n1, n2):
+            compute_node_class(n)
+            nt.upsert_node(n)
+        # Same computed class (unique.* excluded) but different unique attrs.
+        assert n1.ComputedClass == n2.ComputedClass
+        elig = ClassEligibility(nt, [n1, n2])
+        cons = [Constraint(LTarget="${attr.unique.network.ip-address}",
+                           RTarget="10.0.0.1", Operand="=")]
+        mask, _, escaped = elig.job_mask("j1", cons)
+        assert escaped
+        assert mask[nt.row_of[n1.ID]]
+        assert not mask[nt.row_of[n2.ID]]
+
+
+class TestPlaceBatchKernel:
+    def _inputs(self, n=64, p=8):
+        import jax.numpy as jnp
+
+        capacity = np.full((n, RES_DIMS), 1000, np.float32)
+        score_cap = np.full((n, 2), 1000, np.float32)
+        usage = np.zeros((n, RES_DIMS), np.float32)
+        masks = np.ones((1, n), bool)
+        demands = np.full((p, RES_DIMS), 100, np.float32)
+        return dict(
+            capacity=jnp.asarray(capacity), score_cap=jnp.asarray(score_cap),
+            usage=jnp.asarray(usage), tg_masks=jnp.asarray(masks),
+            job_counts=jnp.zeros(n, jnp.int32), demands=jnp.asarray(demands),
+            tg_ids=jnp.zeros(p, jnp.int32), valid=jnp.ones(p, bool),
+            noise=jnp.zeros(n, jnp.float32), penalty=jnp.float32(10.0),
+            distinct_hosts=jnp.asarray(False),
+            banned0=jnp.zeros(n, bool))
+
+    def test_spreads_with_anti_affinity(self):
+        from nomad_tpu.scheduler import kernels
+
+        kw = self._inputs()
+        res = kernels.place_batch(**kw)
+        chosen = np.asarray(res.chosen)
+        assert (chosen >= 0).all()
+        # Penalty 10 dominates bin-pack deltas: placements spread.
+        assert len(set(chosen.tolist())) == 8
+
+    def test_packs_without_penalty(self):
+        import jax.numpy as jnp
+
+        from nomad_tpu.scheduler import kernels
+
+        kw = self._inputs()
+        kw["penalty"] = jnp.float32(0.0)
+        res = kernels.place_batch(**kw)
+        chosen = np.asarray(res.chosen)
+        # Bin packing: everything lands on one node until full.
+        assert len(set(chosen.tolist())) == 1
+
+    def test_capacity_exhaustion(self):
+        import jax.numpy as jnp
+
+        from nomad_tpu.scheduler import kernels
+
+        kw = self._inputs(n=2, p=8)
+        kw["tg_masks"] = jnp.ones((1, 2), bool)
+        kw["job_counts"] = jnp.zeros(2, jnp.int32)
+        kw["noise"] = jnp.zeros(2, jnp.float32)
+        kw["banned0"] = jnp.zeros(2, bool)
+        # 2 nodes x 1000 cap, 8 placements x 300: only 3 fit per node.
+        kw["demands"] = jnp.full((8, RES_DIMS), 300, jnp.float32)
+        res = kernels.place_batch(**kw)
+        chosen = np.asarray(res.chosen)
+        assert (chosen >= 0).sum() == 6
+        assert (chosen < 0).sum() == 2
+
+    def test_distinct_hosts(self):
+        import jax.numpy as jnp
+
+        from nomad_tpu.scheduler import kernels
+
+        kw = self._inputs(n=4, p=8)
+        kw["tg_masks"] = jnp.ones((1, 4), bool)
+        kw["job_counts"] = jnp.zeros(4, jnp.int32)
+        kw["noise"] = jnp.zeros(4, jnp.float32)
+        kw["banned0"] = jnp.zeros(4, bool)
+        kw["demands"] = jnp.full((8, RES_DIMS), 10, jnp.float32)
+        kw["distinct_hosts"] = jnp.asarray(True)
+        res = kernels.place_batch(**kw)
+        chosen = np.asarray(res.chosen)
+        placed = chosen[chosen >= 0]
+        assert len(placed) == 4  # one per host, rest fail
+        assert len(set(placed.tolist())) == 4
+
+
+class TestPipelinedPlacer:
+    def test_chained_contention(self):
+        """Evals in one window contend for capacity device-side."""
+        from nomad_tpu.scheduler.pipeline import EvalRequest, PipelinedPlacer
+
+        node = mock.node()  # 3900 usable CPU
+        tindex = TensorIndex()
+        tindex.nt.upsert_node(node)
+        placer = PipelinedPlacer(tindex, [node], rng=random.Random(1),
+                                 window=10)
+        job = mock.job()
+        job.TaskGroups[0].Tasks[0].Resources.CPU = 1000
+        job.TaskGroups[0].Tasks[0].Resources.Networks = []
+        # 6 evals x 1 placement x 1000 CPU on one 3900-CPU node: 3 fit.
+        for _ in range(6):
+            placer.submit(EvalRequest(job=job, tgs=[job.TaskGroups[0]]))
+        results = placer.flush()
+        placed = sum(int((r.chosen_rows >= 0).sum()) for r in results)
+        assert placed == 3
+
+    def test_matches_stack_semantics(self):
+        from nomad_tpu.scheduler.pipeline import EvalRequest, PipelinedPlacer
+
+        nodes = [mock.node() for _ in range(8)]
+        tindex = TensorIndex()
+        for n in nodes:
+            tindex.nt.upsert_node(n)
+        placer = PipelinedPlacer(tindex, nodes, rng=random.Random(1))
+        job = mock.job()
+        job.TaskGroups[0].Tasks[0].Resources.Networks = []
+        placer.submit(EvalRequest(job=job, tgs=[job.TaskGroups[0]] * 8))
+        (res,) = placer.flush()
+        assert (res.chosen_rows >= 0).all()
+        # Anti-affinity spreads over all 8 nodes.
+        assert len(set(res.chosen_rows.tolist())) == 8
+
+
+class TestSharding:
+    def test_place_batch_sharded_8dev(self):
+        import jax
+
+        if len(jax.devices()) < 8:
+            pytest.skip("needs 8 virtual devices")
+        from nomad_tpu.parallel import place_batch_sharded, scheduling_mesh
+
+        mesh = scheduling_mesh(jax.devices()[:8])
+        n, p = 512, 16
+        rng = np.random.default_rng(0)
+        res = place_batch_sharded(
+            mesh,
+            rng.uniform(1000, 4000, (n, 5)).astype(np.float32),
+            rng.uniform(800, 3800, (n, 2)).astype(np.float32),
+            np.zeros((n, 5), np.float32),
+            np.ones((1, n), bool),
+            np.zeros(n, np.int32),
+            np.full((p, 5), 50, np.float32),
+            np.zeros(p, np.int32),
+            np.ones(p, bool),
+            (rng.random(n) * 1e-3).astype(np.float32),
+            np.float32(10.0),
+            np.asarray(False),
+            np.zeros(n, bool),
+        )
+        packed = np.asarray(res.packed)
+        chosen = packed[:, 0].astype(np.int32)
+        assert (chosen >= 0).all()
+        assert len(set(chosen.tolist())) == p  # spread
+
+    def test_sharded_matches_single_device(self):
+        import jax
+
+        if len(jax.devices()) < 8:
+            pytest.skip("needs 8 virtual devices")
+        import jax.numpy as jnp
+
+        from nomad_tpu.parallel import place_batch_sharded, scheduling_mesh
+        from nomad_tpu.scheduler import kernels
+
+        n, p = 256, 8
+        rng = np.random.default_rng(3)
+        args = [
+            rng.uniform(1000, 4000, (n, 5)).astype(np.float32),
+            rng.uniform(800, 3800, (n, 2)).astype(np.float32),
+            np.zeros((n, 5), np.float32),
+            np.ones((1, n), bool),
+            np.zeros(n, np.int32),
+            np.full((p, 5), 50, np.float32),
+            np.zeros(p, np.int32),
+            np.ones(p, bool),
+            (rng.random(n) * 1e-3).astype(np.float32),
+            np.float32(10.0),
+            np.asarray(False),
+            np.zeros(n, bool),
+        ]
+        single = kernels.place_batch(*[jnp.asarray(a) for a in args])
+        mesh = scheduling_mesh(jax.devices()[:8])
+        sharded = place_batch_sharded(mesh, *args)
+        np.testing.assert_array_equal(np.asarray(single.packed)[:, 0],
+                                      np.asarray(sharded.packed)[:, 0])
+
+
+class TestPlacementQualityParity:
+    def test_tpu_at_least_as_good_as_reference_algorithm(self):
+        """Global argmax must reach >= the reference iterator chain's total
+        bin-pack score on the same workload."""
+        from nomad_tpu.scheduler.cpu_reference import CPUReferenceStack
+        from nomad_tpu.scheduler.pipeline import EvalRequest, PipelinedPlacer
+
+        nodes = []
+        rng = np.random.default_rng(11)
+        for i in range(50):
+            n = mock.node()
+            # Heterogeneous capacity so scores differ meaningfully.
+            n.Resources.CPU = int(rng.integers(2000, 8000))
+            n.Resources.MemoryMB = int(rng.integers(4096, 16384))
+            compute_node_class(n)
+            nodes.append(n)
+
+        job = mock.job()
+        job.TaskGroups[0].Tasks[0].Resources.Networks = []
+        tgs = [job.TaskGroups[0]] * 20
+
+        tindex = TensorIndex()
+        for n in nodes:
+            tindex.nt.upsert_node(n)
+        placer = PipelinedPlacer(tindex, nodes, rng=random.Random(5))
+        placer.submit(EvalRequest(job=job, tgs=tgs))
+        (res,) = placer.flush()
+        tpu_scores = res.scores[res.chosen_rows >= 0]
+        # Remove the tie-break noise contribution before comparing.
+        tpu_total = float(tpu_scores.sum()) - 1e-3 * len(tpu_scores)
+
+        ref = CPUReferenceStack(nodes, rng=random.Random(5))
+        ref.set_job(job)
+        ref_results = [r for r in ref.select_batch(tgs) if r is not None]
+        ref_total = sum(s for _, s in ref_results)
+
+        assert len(tpu_scores) >= len(ref_results)
+        assert tpu_total >= ref_total - 1e-3

@@ -1,0 +1,5 @@
+"""Agent: composes Server and/or Client with the HTTP API (reference:
+command/agent/agent.go)."""
+
+from .agent import Agent, AgentConfig  # noqa: F401
+from .http import HTTPServer  # noqa: F401

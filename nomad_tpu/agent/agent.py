@@ -1,0 +1,140 @@
+"""Agent: one process running a server, a client, or both (reference:
+command/agent/agent.go:61-675).
+
+Dev mode mirrors the reference's `-dev` flag: in-memory single-node server
+(always leader) + client in the same process with raw_exec enabled
+(reference: command/agent/command.go DevConfig).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import tempfile
+from dataclasses import dataclass, field
+from typing import Dict, Optional
+
+from nomad_tpu.client import Client, ClientConfig, InProcServerChannel
+from nomad_tpu.server import Server, ServerConfig
+
+from .http import HTTPServer
+
+logger = logging.getLogger("nomad.agent")
+
+
+@dataclass
+class AgentConfig:
+    """(reference: command/agent/config.go)"""
+
+    region: str = "global"
+    datacenter: str = "dc1"
+    node_name: str = ""
+    data_dir: str = ""
+    bind_addr: str = "127.0.0.1"
+    http_port: int = 4646
+    server_enabled: bool = False
+    client_enabled: bool = False
+    num_schedulers: int = 2
+    node_class: str = ""
+    meta: Dict[str, str] = field(default_factory=dict)
+    options: Dict[str, str] = field(default_factory=dict)
+    dev_mode: bool = False
+
+    @staticmethod
+    def dev() -> "AgentConfig":
+        return AgentConfig(
+            server_enabled=True,
+            client_enabled=True,
+            dev_mode=True,
+            options={"driver.raw_exec.enable": "true"},
+        )
+
+
+class Agent:
+    def __init__(self, config: AgentConfig):
+        self.config = config
+        self.server: Optional[Server] = None
+        self.client: Optional[Client] = None
+        self.http: Optional[HTTPServer] = None
+        if not config.data_dir:
+            config.data_dir = tempfile.mkdtemp(prefix="nomad_tpu_")
+
+    def start(self) -> None:
+        if self.config.server_enabled:
+            self._setup_server()
+        if self.config.client_enabled:
+            self._setup_client()
+        self.http = HTTPServer(self, host=self.config.bind_addr,
+                               port=self.config.http_port)
+        self.http.start()
+
+    def _setup_server(self) -> None:
+        """(reference: agent.go:356 setupServer)"""
+        sconf = ServerConfig(
+            region=self.config.region,
+            datacenter=self.config.datacenter,
+            num_schedulers=self.config.num_schedulers,
+            dev_mode=self.config.dev_mode,
+        )
+        self.server = Server(sconf)
+        self.server.establish_leadership()
+
+    def _setup_client(self) -> None:
+        """(reference: agent.go:428 setupClient)"""
+        if self.server is None:
+            raise ValueError(
+                "client-only agents need a server address; in-process RPC "
+                "requires server_enabled (wire RPC lands with multi-node)")
+        cconf = ClientConfig(
+            state_dir=os.path.join(self.config.data_dir, "client"),
+            alloc_dir=os.path.join(self.config.data_dir, "alloc"),
+            datacenter=self.config.datacenter,
+            region=self.config.region,
+            node_class=self.config.node_class,
+            meta=dict(self.config.meta),
+            options=dict(self.config.options),
+            dev_mode=self.config.dev_mode,
+        )
+        self.client = Client(cconf, InProcServerChannel(self.server))
+        if self.config.node_name:
+            self.client.node.Name = self.config.node_name
+        self.client.start()
+
+    def shutdown(self) -> None:
+        if self.http is not None:
+            self.http.shutdown()
+        if self.client is not None:
+            self.client.shutdown()
+        if self.server is not None:
+            self.server.shutdown()
+
+    # -------------------------------------------------------- http helpers
+    def region(self) -> str:
+        return self.config.region
+
+    def self_config(self) -> dict:
+        return {
+            "Region": self.config.region,
+            "Datacenter": self.config.datacenter,
+            "Server": self.config.server_enabled,
+            "Client": self.config.client_enabled,
+            "DevMode": self.config.dev_mode,
+            "DataDir": self.config.data_dir,
+        }
+
+    def member_info(self) -> dict:
+        return {
+            "Name": self.config.node_name or "local",
+            "Addr": self.config.bind_addr,
+            "Port": self.http.port if self.http else self.config.http_port,
+            "Status": "alive",
+            "Tags": {"region": self.config.region, "dc": self.config.datacenter,
+                     "role": "nomad"},
+        }
+
+    def server_addresses(self) -> list:
+        port = self.http.port if self.http else self.config.http_port
+        return [f"{self.config.bind_addr}:{port}"]
+
+    def leader_address(self) -> str:
+        return self.server_addresses()[0]

@@ -1,0 +1,54 @@
+"""Agent config files: HCL/JSON (reference: command/agent/config.go,
+config_parse.go).
+
+Supports the reference's block layout:
+
+  region = "global"
+  datacenter = "dc1"
+  data_dir = "/var/lib/nomad"
+  bind_addr = "0.0.0.0"
+  ports { http = 4646 }
+  server { enabled = true  num_schedulers = 4 }
+  client { enabled = true  node_class = "foo"  meta { k = "v" }
+           options { "driver.raw_exec.enable" = "1" } }
+"""
+
+from __future__ import annotations
+
+import json
+
+from nomad_tpu.jobspec.hcl import parse as parse_hcl
+
+from .agent import AgentConfig
+
+
+def load_config_file(path: str) -> AgentConfig:
+    with open(path) as f:
+        text = f.read()
+    if path.endswith(".json"):
+        data = json.loads(text)
+    else:
+        data = parse_hcl(text)
+    return config_from_dict(data)
+
+
+def config_from_dict(data: dict) -> AgentConfig:
+    cfg = AgentConfig()
+    cfg.region = data.get("region", cfg.region)
+    cfg.datacenter = data.get("datacenter", cfg.datacenter)
+    cfg.node_name = data.get("name", cfg.node_name)
+    cfg.data_dir = data.get("data_dir", cfg.data_dir)
+    cfg.bind_addr = data.get("bind_addr", cfg.bind_addr)
+    ports = data.get("ports") or {}
+    cfg.http_port = int(ports.get("http", cfg.http_port))
+
+    server = data.get("server") or {}
+    cfg.server_enabled = bool(server.get("enabled", False))
+    cfg.num_schedulers = int(server.get("num_schedulers", cfg.num_schedulers))
+
+    client = data.get("client") or {}
+    cfg.client_enabled = bool(client.get("enabled", False))
+    cfg.node_class = client.get("node_class", "")
+    cfg.meta = {k: str(v) for k, v in (client.get("meta") or {}).items()}
+    cfg.options = {k: str(v) for k, v in (client.get("options") or {}).items()}
+    return cfg

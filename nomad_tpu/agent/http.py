@@ -1,0 +1,407 @@
+"""HTTP API (reference: command/agent/http.go + *_endpoint.go).
+
+Serves the /v1 API over a stdlib threading HTTP server: jobs, nodes,
+allocations, evaluations, client fs/stats, agent, status, regions, system GC,
+with blocking-query support (`index` + `wait` params) wired to state-store
+watches and the same JSON envelope/headers as the reference (X-Nomad-Index,
+error text bodies, 4xx/5xx codes).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import re
+import threading
+import time
+import urllib.parse
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from nomad_tpu.state.watch import Item
+from nomad_tpu.structs import Job, from_dict, job_stub, to_dict
+
+logger = logging.getLogger("nomad.http")
+
+MAX_WAIT = 300.0  # blocking query cap (reference: rpc.go:33-43)
+
+
+class CodedError(Exception):
+    def __init__(self, code: int, message: str):
+        super().__init__(message)
+        self.code = code
+
+
+class HTTPServer:
+    def __init__(self, agent, host: str = "127.0.0.1", port: int = 4646):
+        self.agent = agent
+        self.host = host
+        self.port = port
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> None:
+        handler = _make_handler(self.agent)
+        self._httpd = ThreadingHTTPServer((self.host, self.port), handler)
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        daemon=True, name="http")
+        self._thread.start()
+        logger.info("http: listening on %s:%d", self.host, self.port)
+
+    def shutdown(self) -> None:
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+
+
+def _make_handler(agent):
+    class Handler(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def log_message(self, fmt, *args):  # route through logging
+            logger.debug("http: " + fmt, *args)
+
+        def _respond(self, obj: Any, index: Optional[int] = None,
+                     code: int = 200) -> None:
+            body = json.dumps(obj).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            if index is not None:
+                self.send_header("X-Nomad-Index", str(index))
+                self.send_header("X-Nomad-KnownLeader", "true")
+            self.end_headers()
+            self.wfile.write(body)
+
+        def _error(self, code: int, message: str) -> None:
+            body = message.encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "text/plain")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def _body(self) -> Any:
+            length = int(self.headers.get("Content-Length", 0))
+            if length == 0:
+                return None
+            return json.loads(self.rfile.read(length))
+
+        def _dispatch(self) -> None:
+            parsed = urllib.parse.urlparse(self.path)
+            query = urllib.parse.parse_qs(parsed.query)
+            try:
+                result = route(agent, self.command, parsed.path, query,
+                               self._body)
+            except CodedError as e:
+                self._error(e.code, str(e))
+                return
+            except KeyError as e:
+                self._error(404, str(e))
+                return
+            except ValueError as e:
+                self._error(400, str(e))
+                return
+            except Exception as e:
+                logger.exception("http: request failed")
+                self._error(500, str(e))
+                return
+            if result is None:
+                self._respond(None)
+            else:
+                obj, index = result
+                self._respond(obj, index)
+
+        do_GET = _dispatch
+        do_PUT = _dispatch
+        do_POST = _dispatch
+        do_DELETE = _dispatch
+
+    return Handler
+
+
+# ---------------------------------------------------------------- routing
+
+
+def _parse_wait(query) -> Tuple[int, float]:
+    from nomad_tpu.jobspec import parse_duration
+
+    min_index = int(query.get("index", ["0"])[0])
+    wait_raw = query.get("wait", ["0"])[0]
+    try:
+        wait = float(wait_raw or 0)  # bare number: seconds
+    except ValueError:
+        wait = parse_duration(wait_raw) / 1e9  # Go duration string
+    return min_index, min(wait, MAX_WAIT)
+
+
+def _blocking(state, items: List[Item], query, run: Callable[[], Tuple[Any, int]]
+              ) -> Tuple[Any, int]:
+    """Blocking-query wrapper (reference: rpc.go:294-349 blockingRPC)."""
+    min_index, wait = _parse_wait(query)
+    if min_index <= 0 or wait <= 0:
+        return run()
+    event = threading.Event()
+    state.watch(items, event)
+    try:
+        deadline = time.monotonic() + wait
+        while True:
+            obj, index = run()
+            if index > min_index:
+                return obj, index
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                return obj, index
+            event.clear()
+            event.wait(remaining)
+    finally:
+        state.stop_watch(items, event)
+
+
+def _require_write(method: str) -> None:
+    if method not in ("PUT", "POST"):
+        raise CodedError(405, "method not allowed")
+
+
+def route(agent, method: str, path: str, query, get_body):
+    server = agent.server
+    client = agent.client
+    state = server.state if server is not None else None
+
+    def need_server():
+        if server is None:
+            raise CodedError(501, "no server running on this agent")
+        return server
+
+    def need_client():
+        if client is None:
+            raise CodedError(501, "no client running on this agent")
+        return client
+
+    # ------------------------------ jobs
+    if path == "/v1/jobs":
+        need_server()
+        if method == "GET":
+            prefix = query.get("prefix", [""])[0]
+
+            def run():
+                jobs = state.jobs_by_id_prefix(prefix) if prefix else state.jobs()
+                stubs = sorted((to_dict(job_stub(j)) for j in jobs),
+                               key=lambda j: j["ID"])
+                return stubs, state.get_index("jobs")
+
+            return _blocking(state, [Item(table="jobs")], query, run)
+        if method in ("PUT", "POST"):
+            payload = get_body()
+            job = from_dict(Job, payload.get("Job"))
+            enforce = payload.get("EnforceIndex")
+            enforce_index = payload.get("JobModifyIndex") if enforce else None
+            eval_id, jmi, index = server.job_register(
+                job, enforce_index=enforce_index)
+            return ({"EvalID": eval_id, "EvalCreateIndex": index,
+                     "JobModifyIndex": jmi, "Index": index}, index)
+        raise CodedError(405, "method not allowed")
+
+    m = re.match(r"^/v1/job/([^/]+)$", path)
+    if m:
+        need_server()
+        job_id = urllib.parse.unquote(m.group(1))
+        if method == "GET":
+            def run():
+                job = state.job_by_id(job_id)
+                if job is None:
+                    raise KeyError(f"job not found: {job_id}")
+                return to_dict(job), state.get_index("jobs")
+
+            return _blocking(state, [Item(job=job_id)], query, run)
+        if method in ("PUT", "POST"):
+            payload = get_body()
+            job = from_dict(Job, payload.get("Job"))
+            eval_id, jmi, index = server.job_register(job)
+            return ({"EvalID": eval_id, "JobModifyIndex": jmi,
+                     "Index": index}, index)
+        if method == "DELETE":
+            eval_id, index = server.job_deregister(job_id)
+            return ({"EvalID": eval_id, "Index": index}, index)
+        raise CodedError(405, "method not allowed")
+
+    m = re.match(r"^/v1/job/([^/]+)/allocations$", path)
+    if m:
+        need_server()
+        job_id = urllib.parse.unquote(m.group(1))
+
+        def run():
+            allocs = [to_dict(a.stub()) for a in state.allocs_by_job(job_id)]
+            return allocs, state.get_index("allocs")
+
+        return _blocking(state, [Item(alloc_job=job_id)], query, run)
+
+    m = re.match(r"^/v1/job/([^/]+)/evaluations$", path)
+    if m:
+        need_server()
+        job_id = urllib.parse.unquote(m.group(1))
+
+        def run():
+            evals = [to_dict(e) for e in state.evals_by_job(job_id)]
+            return evals, state.get_index("evals")
+
+        return _blocking(state, [Item(table="evals")], query, run)
+
+    m = re.match(r"^/v1/job/([^/]+)/evaluate$", path)
+    if m:
+        _require_write(method)
+        eval_id, index = need_server().job_evaluate(
+            urllib.parse.unquote(m.group(1)))
+        return ({"EvalID": eval_id, "Index": index}, index)
+
+    m = re.match(r"^/v1/job/([^/]+)/periodic/force$", path)
+    if m:
+        _require_write(method)
+        need_server().periodic_force(urllib.parse.unquote(m.group(1)))
+        index = state.latest_index()
+        return ({"Index": index}, index)
+
+    # ------------------------------ nodes
+    if path == "/v1/nodes":
+        need_server()
+
+        def run():
+            stubs = sorted((to_dict(n.stub()) for n in state.nodes()),
+                           key=lambda n: n["ID"])
+            return stubs, state.get_index("nodes")
+
+        return _blocking(state, [Item(table="nodes")], query, run)
+
+    m = re.match(r"^/v1/node/([^/]+)$", path)
+    if m:
+        need_server()
+        node_id = urllib.parse.unquote(m.group(1))
+
+        def run():
+            node = state.node_by_id(node_id)
+            if node is None:
+                raise KeyError(f"node not found: {node_id}")
+            return to_dict(node), state.get_index("nodes")
+
+        return _blocking(state, [Item(node=node_id)], query, run)
+
+    m = re.match(r"^/v1/node/([^/]+)/allocations$", path)
+    if m:
+        need_server()
+        node_id = urllib.parse.unquote(m.group(1))
+
+        def run():
+            allocs = [to_dict(a) for a in state.allocs_by_node(node_id)]
+            return allocs, state.get_index("allocs")
+
+        return _blocking(state, [Item(alloc_node=node_id)], query, run)
+
+    m = re.match(r"^/v1/node/([^/]+)/drain$", path)
+    if m:
+        _require_write(method)
+        enable = query.get("enable", ["false"])[0].lower() in ("1", "true")
+        index = need_server().node_update_drain(
+            urllib.parse.unquote(m.group(1)), enable)
+        return ({"Index": index}, index)
+
+    m = re.match(r"^/v1/node/([^/]+)/evaluate$", path)
+    if m:
+        _require_write(method)
+        eval_ids = need_server().node_evaluate(urllib.parse.unquote(m.group(1)))
+        index = state.latest_index()
+        return ({"EvalIDs": eval_ids, "Index": index}, index)
+
+    # ------------------------------ allocations
+    if path == "/v1/allocations":
+        need_server()
+
+        def run():
+            allocs = sorted((to_dict(a.stub()) for a in state.allocs()),
+                            key=lambda a: a["ID"])
+            return allocs, state.get_index("allocs")
+
+        return _blocking(state, [Item(table="allocs")], query, run)
+
+    m = re.match(r"^/v1/allocation/([^/]+)$", path)
+    if m:
+        need_server()
+        alloc_id = urllib.parse.unquote(m.group(1))
+        alloc = state.alloc_by_id(alloc_id)
+        if alloc is None:
+            raise KeyError(f"alloc not found: {alloc_id}")
+        return to_dict(alloc), state.get_index("allocs")
+
+    # ------------------------------ evaluations
+    if path == "/v1/evaluations":
+        need_server()
+
+        def run():
+            evals = sorted((to_dict(e) for e in state.evals()),
+                           key=lambda e: e["ID"])
+            return evals, state.get_index("evals")
+
+        return _blocking(state, [Item(table="evals")], query, run)
+
+    m = re.match(r"^/v1/evaluation/([^/]+)$", path)
+    if m:
+        need_server()
+        eval_id = urllib.parse.unquote(m.group(1))
+
+        def run():
+            ev = state.eval_by_id(eval_id)
+            if ev is None:
+                raise KeyError(f"eval not found: {eval_id}")
+            return to_dict(ev), state.get_index("evals")
+
+        return _blocking(state, [Item(eval=eval_id)], query, run)
+
+    m = re.match(r"^/v1/evaluation/([^/]+)/allocations$", path)
+    if m:
+        need_server()
+        eval_id = urllib.parse.unquote(m.group(1))
+        allocs = [to_dict(a.stub()) for a in state.allocs_by_eval(eval_id)]
+        return allocs, state.get_index("allocs")
+
+    # ------------------------------ client fs + stats
+    m = re.match(r"^/v1/client/fs/(ls|stat|cat|readat)/([^/]+)$", path)
+    if m:
+        op = m.group(1)
+        alloc_id = urllib.parse.unquote(m.group(2))
+        fs = need_client().get_alloc_fs(alloc_id)
+        if fs is None:
+            raise KeyError(f"alloc not found on client: {alloc_id}")
+        rel = query.get("path", ["/"])[0]
+        if op == "ls":
+            return [to_dict(fi) for fi in fs.list_dir(rel)], None
+        if op == "stat":
+            return to_dict(fs.stat(rel)), None
+        offset = int(query.get("offset", ["0"])[0])
+        limit = int(query.get("limit", ["-1"])[0])
+        data = fs.read_at(rel, offset, limit)
+        return data.decode("utf-8", "replace"), None
+
+    if path == "/v1/client/stats":
+        return need_client().stats(), None
+
+    # ------------------------------ agent / status / regions / system
+    if path == "/v1/agent/self":
+        out = {"config": agent.self_config(), "member": agent.member_info()}
+        return out, None
+    if path == "/v1/agent/members":
+        return [agent.member_info()], None
+    if path == "/v1/agent/servers":
+        return agent.server_addresses(), None
+    if path == "/v1/status/leader":
+        need_server()
+        return agent.leader_address(), None
+    if path == "/v1/status/peers":
+        need_server()
+        return [agent.leader_address()], None
+    if path == "/v1/regions":
+        return [agent.region()], None
+    if path == "/v1/system/gc":
+        _require_write(method)
+        need_server().force_gc()
+        return None
+    raise CodedError(404, f"no handler for {path}")

@@ -1,0 +1,16 @@
+"""Typed HTTP API client (reference: api/)."""
+
+from .client import (  # noqa: F401
+    APIError,
+    Agent as AgentAPI,
+    AllocFS,
+    Allocations,
+    Client,
+    Evaluations,
+    Jobs,
+    Nodes,
+    QueryOptions,
+    Regions,
+    System,
+    WriteOptions,
+)

@@ -1,0 +1,256 @@
+"""API client library (reference: api/api.go, api/jobs.go, api/nodes.go,
+api/allocations.go, api/evaluations.go, api/fs.go, api/agent.go).
+
+Typed wrappers over the /v1 HTTP API with blocking-query support
+(QueryOptions.wait_index / wait_time -> `index`/`wait` params, last index
+read back from X-Nomad-Index).
+"""
+
+from __future__ import annotations
+
+import json
+import urllib.error
+import urllib.parse
+import urllib.request
+from dataclasses import dataclass
+from typing import Any, Dict, List, Optional, Tuple
+
+from nomad_tpu.structs import Job, from_dict, to_dict
+
+
+class APIError(Exception):
+    def __init__(self, code: int, message: str):
+        super().__init__(f"Unexpected response code: {code} ({message})")
+        self.code = code
+
+
+@dataclass
+class QueryOptions:
+    region: str = ""
+    prefix: str = ""
+    wait_index: int = 0
+    wait_time: float = 0.0  # seconds
+
+
+@dataclass
+class WriteOptions:
+    region: str = ""
+
+
+@dataclass
+class QueryMeta:
+    last_index: int = 0
+    known_leader: bool = False
+
+
+class Client:
+    def __init__(self, address: str = "http://127.0.0.1:4646",
+                 region: str = ""):
+        self.address = address.rstrip("/")
+        self.region = region
+        self.jobs = Jobs(self)
+        self.nodes = Nodes(self)
+        self.allocations = Allocations(self)
+        self.evaluations = Evaluations(self)
+        self.agent = Agent(self)
+        self.regions = Regions(self)
+        self.system = System(self)
+        self.alloc_fs = AllocFS(self)
+
+    # ------------------------------------------------------------ plumbing
+    def _url(self, path: str, params: Optional[Dict[str, str]] = None) -> str:
+        url = self.address + path
+        if params:
+            url += "?" + urllib.parse.urlencode(params)
+        return url
+
+    def _params(self, q: Optional[QueryOptions]) -> Dict[str, str]:
+        params: Dict[str, str] = {}
+        region = (q.region if q else "") or self.region
+        if region:
+            params["region"] = region
+        if q is not None:
+            if q.prefix:
+                params["prefix"] = q.prefix
+            if q.wait_index:
+                params["index"] = str(q.wait_index)
+            if q.wait_time:
+                params["wait"] = f"{q.wait_time}s"
+        return params
+
+    def request(self, method: str, path: str,
+                params: Optional[Dict[str, str]] = None,
+                body: Any = None,
+                timeout: float = 310.0) -> Tuple[Any, QueryMeta]:
+        data = json.dumps(body).encode() if body is not None else None
+        req = urllib.request.Request(self._url(path, params), data=data,
+                                     method=method)
+        if data is not None:
+            req.add_header("Content-Type", "application/json")
+        try:
+            with urllib.request.urlopen(req, timeout=timeout) as resp:
+                raw = resp.read()
+                meta = QueryMeta(
+                    last_index=int(resp.headers.get("X-Nomad-Index", 0)),
+                    known_leader=resp.headers.get(
+                        "X-Nomad-KnownLeader", "") == "true")
+                return (json.loads(raw) if raw else None), meta
+        except urllib.error.HTTPError as e:
+            raise APIError(e.code, e.read().decode(errors="replace")) from e
+
+    def get(self, path: str, q: Optional[QueryOptions] = None):
+        return self.request("GET", path, self._params(q))
+
+    def put(self, path: str, body: Any = None,
+            w: Optional[WriteOptions] = None,
+            params: Optional[Dict[str, str]] = None):
+        merged = self._params(None)
+        if params:
+            merged.update(params)
+        return self.request("PUT", path, merged, body)
+
+    def delete(self, path: str):
+        return self.request("DELETE", path, self._params(None))
+
+
+class Jobs:
+    """(reference: api/jobs.go)"""
+
+    def __init__(self, c: Client):
+        self.c = c
+
+    def register(self, job: Job, enforce_index: Optional[int] = None
+                 ) -> Tuple[str, QueryMeta]:
+        body: Dict[str, Any] = {"Job": to_dict(job)}
+        if enforce_index is not None:
+            body["EnforceIndex"] = True
+            body["JobModifyIndex"] = enforce_index
+        out, meta = self.c.put("/v1/jobs", body)
+        return out.get("EvalID", ""), meta
+
+    def list(self, q: Optional[QueryOptions] = None):
+        return self.c.get("/v1/jobs", q)
+
+    def info(self, job_id: str, q: Optional[QueryOptions] = None) -> Tuple[Job, QueryMeta]:
+        out, meta = self.c.get(f"/v1/job/{urllib.parse.quote(job_id)}", q)
+        return from_dict(Job, out), meta
+
+    def deregister(self, job_id: str) -> Tuple[str, QueryMeta]:
+        out, meta = self.c.delete(f"/v1/job/{urllib.parse.quote(job_id)}")
+        return out.get("EvalID", ""), meta
+
+    def allocations(self, job_id: str, q: Optional[QueryOptions] = None):
+        return self.c.get(f"/v1/job/{urllib.parse.quote(job_id)}/allocations", q)
+
+    def evaluations(self, job_id: str, q: Optional[QueryOptions] = None):
+        return self.c.get(f"/v1/job/{urllib.parse.quote(job_id)}/evaluations", q)
+
+    def force_evaluate(self, job_id: str) -> Tuple[str, QueryMeta]:
+        out, meta = self.c.put(f"/v1/job/{urllib.parse.quote(job_id)}/evaluate")
+        return out.get("EvalID", ""), meta
+
+    def periodic_force(self, job_id: str):
+        return self.c.put(
+            f"/v1/job/{urllib.parse.quote(job_id)}/periodic/force")
+
+
+class Nodes:
+    """(reference: api/nodes.go)"""
+
+    def __init__(self, c: Client):
+        self.c = c
+
+    def list(self, q: Optional[QueryOptions] = None):
+        return self.c.get("/v1/nodes", q)
+
+    def info(self, node_id: str, q: Optional[QueryOptions] = None):
+        return self.c.get(f"/v1/node/{node_id}", q)
+
+    def allocations(self, node_id: str, q: Optional[QueryOptions] = None):
+        return self.c.get(f"/v1/node/{node_id}/allocations", q)
+
+    def toggle_drain(self, node_id: str, drain: bool):
+        return self.c.put(f"/v1/node/{node_id}/drain",
+                          params={"enable": "true" if drain else "false"})
+
+    def force_evaluate(self, node_id: str):
+        return self.c.put(f"/v1/node/{node_id}/evaluate")
+
+
+class Allocations:
+    def __init__(self, c: Client):
+        self.c = c
+
+    def list(self, q: Optional[QueryOptions] = None):
+        return self.c.get("/v1/allocations", q)
+
+    def info(self, alloc_id: str, q: Optional[QueryOptions] = None):
+        return self.c.get(f"/v1/allocation/{alloc_id}", q)
+
+
+class Evaluations:
+    def __init__(self, c: Client):
+        self.c = c
+
+    def list(self, q: Optional[QueryOptions] = None):
+        return self.c.get("/v1/evaluations", q)
+
+    def info(self, eval_id: str, q: Optional[QueryOptions] = None):
+        return self.c.get(f"/v1/evaluation/{eval_id}", q)
+
+    def allocations(self, eval_id: str, q: Optional[QueryOptions] = None):
+        return self.c.get(f"/v1/evaluation/{eval_id}/allocations", q)
+
+
+class AllocFS:
+    """(reference: api/fs.go)"""
+
+    def __init__(self, c: Client):
+        self.c = c
+
+    def list(self, alloc_id: str, path: str = "/"):
+        return self.c.request("GET", f"/v1/client/fs/ls/{alloc_id}",
+                              {"path": path})[0]
+
+    def stat(self, alloc_id: str, path: str):
+        return self.c.request("GET", f"/v1/client/fs/stat/{alloc_id}",
+                              {"path": path})[0]
+
+    def cat(self, alloc_id: str, path: str) -> str:
+        return self.c.request("GET", f"/v1/client/fs/cat/{alloc_id}",
+                              {"path": path})[0]
+
+    def read_at(self, alloc_id: str, path: str, offset: int, limit: int) -> str:
+        return self.c.request("GET", f"/v1/client/fs/readat/{alloc_id}",
+                              {"path": path, "offset": str(offset),
+                               "limit": str(limit)})[0]
+
+
+class Agent:
+    def __init__(self, c: Client):
+        self.c = c
+
+    def self(self):
+        return self.c.get("/v1/agent/self")[0]
+
+    def members(self):
+        return self.c.get("/v1/agent/members")[0]
+
+    def servers(self):
+        return self.c.get("/v1/agent/servers")[0]
+
+
+class Regions:
+    def __init__(self, c: Client):
+        self.c = c
+
+    def list(self):
+        return self.c.get("/v1/regions")[0]
+
+
+class System:
+    def __init__(self, c: Client):
+        self.c = c
+
+    def garbage_collect(self):
+        return self.c.put("/v1/system/gc")

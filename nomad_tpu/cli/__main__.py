@@ -1,0 +1,8 @@
+"""CLI entry: python -m nomad_tpu.cli <command> (reference: main.go)."""
+
+import sys
+
+from .commands import main
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

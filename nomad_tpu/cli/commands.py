@@ -1,0 +1,469 @@
+"""CLI commands (reference: command/ — agent, run, status, stop, node-status,
+node-drain, alloc-status, eval-status, validate, init, inspect, fs,
+server-members, agent-info, system gc).
+
+`run` parses the HCL spec, registers, and monitors the evaluation to
+completion (reference: command/run.go + command/monitor.go).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+from nomad_tpu.api import APIError, Client, QueryOptions
+
+
+def _client(args) -> Client:
+    return Client(address=args.address, region=args.region or "")
+
+
+def _add_meta(p: argparse.ArgumentParser) -> None:
+    p.add_argument("-address", default="http://127.0.0.1:4646",
+                   help="HTTP API address")
+    p.add_argument("-region", default="", help="region to forward to")
+
+
+def main(argv) -> int:
+    parser = argparse.ArgumentParser(
+        prog="nomad-tpu", description="TPU-native cluster scheduler")
+    sub = parser.add_subparsers(dest="command")
+
+    p = sub.add_parser("agent", help="run an agent")
+    p.add_argument("-dev", action="store_true", help="dev mode: server+client")
+    p.add_argument("-server", action="store_true")
+    p.add_argument("-client", action="store_true")
+    p.add_argument("-config", default="", help="HCL/JSON config file")
+    # Defaults are None so config-file settings win unless a flag is given.
+    p.add_argument("-bind", default=None)
+    p.add_argument("-http-port", type=int, default=None)
+    p.add_argument("-data-dir", default=None)
+    p.add_argument("-node-class", default=None)
+    p.add_argument("-dc", default=None)
+
+    p = sub.add_parser("run", help="run a job")
+    _add_meta(p)
+    p.add_argument("-detach", action="store_true")
+    p.add_argument("-output", action="store_true",
+                   help="print the JSON job instead of submitting")
+    p.add_argument("-check-index", type=int, default=None)
+    p.add_argument("jobfile")
+
+    p = sub.add_parser("plan", help="dry-run a job diff")
+    _add_meta(p)
+    p.add_argument("jobfile")
+
+    p = sub.add_parser("validate", help="validate a job spec")
+    p.add_argument("jobfile")
+
+    p = sub.add_parser("init", help="write an example job file")
+
+    p = sub.add_parser("status", help="job status")
+    _add_meta(p)
+    p.add_argument("job_id", nargs="?")
+
+    p = sub.add_parser("stop", help="stop a job")
+    _add_meta(p)
+    p.add_argument("-detach", action="store_true")
+    p.add_argument("job_id")
+
+    p = sub.add_parser("inspect", help="print a registered job as JSON")
+    _add_meta(p)
+    p.add_argument("job_id")
+
+    p = sub.add_parser("node-status", help="node status")
+    _add_meta(p)
+    p.add_argument("node_id", nargs="?")
+
+    p = sub.add_parser("node-drain", help="toggle node drain")
+    _add_meta(p)
+    grp = p.add_mutually_exclusive_group(required=True)
+    grp.add_argument("-enable", action="store_true")
+    grp.add_argument("-disable", action="store_true")
+    p.add_argument("node_id")
+
+    p = sub.add_parser("alloc-status", help="allocation status")
+    _add_meta(p)
+    p.add_argument("alloc_id")
+
+    p = sub.add_parser("eval-status", help="evaluation status")
+    _add_meta(p)
+    p.add_argument("eval_id")
+
+    p = sub.add_parser("fs", help="inspect an allocation's filesystem")
+    _add_meta(p)
+    p.add_argument("alloc_id")
+    p.add_argument("path", nargs="?", default="/")
+    p.add_argument("-stat", action="store_true")
+    p.add_argument("-cat", action="store_true")
+
+    p = sub.add_parser("server-members", help="server membership")
+    _add_meta(p)
+
+    p = sub.add_parser("agent-info", help="agent self info")
+    _add_meta(p)
+
+    p = sub.add_parser("system-gc", help="force garbage collection")
+    _add_meta(p)
+
+    args = parser.parse_args(argv)
+    if args.command is None:
+        parser.print_help()
+        return 1
+    try:
+        return globals()[f"cmd_{args.command.replace('-', '_')}"](args)
+    except APIError as e:
+        print(f"Error: {e}", file=sys.stderr)
+        return 1
+    except (OSError, ValueError) as e:
+        print(f"Error: {e}", file=sys.stderr)
+        return 1
+
+
+# ---------------------------------------------------------------- commands
+
+def cmd_agent(args) -> int:
+    import logging
+
+    logging.basicConfig(
+        level=logging.INFO,
+        format="%(asctime)s [%(levelname)s] %(name)s: %(message)s")
+    from nomad_tpu.agent import Agent, AgentConfig
+
+    if args.config:
+        from nomad_tpu.agent.config import load_config_file
+
+        config = load_config_file(args.config)
+    elif args.dev:
+        config = AgentConfig.dev()
+    else:
+        config = AgentConfig(server_enabled=args.server,
+                             client_enabled=args.client)
+    if args.bind is not None:
+        config.bind_addr = args.bind
+    if args.http_port is not None:
+        config.http_port = args.http_port
+    if args.data_dir is not None:
+        config.data_dir = args.data_dir
+    if args.node_class is not None:
+        config.node_class = args.node_class
+    if args.dc is not None:
+        config.datacenter = args.dc
+
+    agent = Agent(config)
+    agent.start()
+    mode = ("dev" if args.dev else
+            "+".join(m for m, on in (("server", config.server_enabled),
+                                     ("client", config.client_enabled)) if on))
+    print(f"==> nomad-tpu agent started ({mode}) on "
+          f"http://{config.bind_addr}:{agent.http.port}")
+    try:
+        while True:
+            time.sleep(1)
+    except KeyboardInterrupt:
+        print("==> shutting down")
+        agent.shutdown()
+    return 0
+
+
+def cmd_run(args) -> int:
+    from nomad_tpu.jobspec import parse_job_file
+    from nomad_tpu.structs import to_dict
+
+    job = parse_job_file(args.jobfile)
+    job.init_fields()
+    errs = job.validate()
+    if errs:
+        print("Job validation errors:", file=sys.stderr)
+        for e in errs:
+            print(f"  * {e}", file=sys.stderr)
+        return 1
+    if args.output:
+        print(json.dumps({"Job": to_dict(job)}, indent=2))
+        return 0
+    client = _client(args)
+    eval_id, meta = client.jobs.register(job, enforce_index=args.check_index)
+    if not eval_id:  # periodic parent
+        print(f'Job "{job.ID}" registered (periodic)')
+        return 0
+    print(f"==> Evaluation {eval_id[:8]} created")
+    if args.detach:
+        print(eval_id)
+        return 0
+    return _monitor_eval(client, eval_id)
+
+
+def _monitor_eval(client: Client, eval_id: str) -> int:
+    """(reference: command/monitor.go)"""
+    seen_status = ""
+    deadline = time.time() + 300
+    while time.time() < deadline:
+        ev, _ = client.evaluations.info(eval_id)
+        if ev["Status"] != seen_status:
+            seen_status = ev["Status"]
+            print(f'    Evaluation status: {seen_status}')
+        if seen_status in ("complete", "failed", "canceled"):
+            allocs = client.evaluations.allocations(eval_id)[0]
+            for a in allocs:
+                print(f'    Allocation {a["ID"][:8]} ({a["Name"]}) on node '
+                      f'{a["NodeID"][:8]}: {a["ClientStatus"]}')
+            failed = ev.get("FailedTGAllocs") or {}
+            for tg, metric in failed.items():
+                print(f'    Task group "{tg}" failed to place '
+                      f'({metric.get("CoalescedFailures", 0) + 1} failures)')
+                if ev.get("BlockedEval"):
+                    print(f'    Blocked evaluation {ev["BlockedEval"][:8]} '
+                          "waiting for capacity")
+            return 0 if seen_status == "complete" else 1
+        time.sleep(0.25)
+    print("    Timed out waiting for evaluation")
+    return 1
+
+
+def cmd_plan(args) -> int:
+    from nomad_tpu.jobspec import parse_job_file
+
+    job = parse_job_file(args.jobfile)
+    job.init_fields()
+    errs = job.validate()
+    if errs:
+        for e in errs:
+            print(f"  * {e}", file=sys.stderr)
+        return 255
+    client = _client(args)
+    try:
+        existing, _ = client.jobs.info(job.ID)
+        print(f'+/- Job: "{job.ID}" (update)')
+        print(f"    Job Modify Index: {existing.JobModifyIndex}")
+        print(f'    Run with -check-index {existing.JobModifyIndex} to '
+              "enforce this state")
+    except APIError as e:
+        if e.code == 404:
+            print(f'+ Job: "{job.ID}" (new)')
+            print("    Job Modify Index: 0")
+        else:
+            raise
+    return 0
+
+
+def cmd_validate(args) -> int:
+    from nomad_tpu.jobspec import parse_job_file
+
+    job = parse_job_file(args.jobfile)
+    job.init_fields()
+    errs = job.validate()
+    if errs:
+        print("Job validation errors:", file=sys.stderr)
+        for e in errs:
+            print(f"  * {e}", file=sys.stderr)
+        return 1
+    print("Job validation successful")
+    return 0
+
+
+EXAMPLE_JOB = '''# Example nomad-tpu job specification
+job "example" {
+  datacenters = ["dc1"]
+  type = "service"
+
+  group "cache" {
+    count = 1
+
+    restart {
+      attempts = 10
+      interval = "5m"
+      delay = "25s"
+      mode = "delay"
+    }
+
+    task "sleeper" {
+      driver = "raw_exec"
+      config {
+        command = "/bin/sleep"
+        args = ["300"]
+      }
+      resources {
+        cpu = 100
+        memory = 64
+        disk = 300
+      }
+    }
+  }
+}
+'''
+
+
+def cmd_init(args) -> int:
+    import os
+
+    if os.path.exists("example.nomad"):
+        print("Error: example.nomad already exists", file=sys.stderr)
+        return 1
+    with open("example.nomad", "w") as f:
+        f.write(EXAMPLE_JOB)
+    print("Example job file written to example.nomad")
+    return 0
+
+
+def cmd_status(args) -> int:
+    client = _client(args)
+    if not args.job_id:
+        jobs, _ = client.jobs.list()
+        if not jobs:
+            print("No running jobs")
+            return 0
+        print(f"{'ID':<20} {'Type':<10} {'Priority':<9} Status")
+        for j in jobs:
+            print(f"{j['ID']:<20} {j['Type']:<10} {j['Priority']:<9} "
+                  f"{j['Status']}")
+        return 0
+    job, _ = client.jobs.info(args.job_id)
+    print(f"ID          = {job.ID}")
+    print(f"Name        = {job.Name}")
+    print(f"Type        = {job.Type}")
+    print(f"Priority    = {job.Priority}")
+    print(f"Datacenters = {','.join(job.Datacenters)}")
+    print(f"Status      = {job.Status}")
+    allocs, _ = client.jobs.allocations(args.job_id)
+    if allocs:
+        print("\nAllocations")
+        print(f"{'ID':<10} {'Eval ID':<10} {'Node ID':<10} {'Task Group':<12} "
+              f"{'Desired':<8} Status")
+        for a in allocs:
+            print(f"{a['ID'][:8]:<10} {a['EvalID'][:8]:<10} "
+                  f"{a['NodeID'][:8]:<10} {a['TaskGroup']:<12} "
+                  f"{a['DesiredStatus']:<8} {a['ClientStatus']}")
+    return 0
+
+
+def cmd_stop(args) -> int:
+    client = _client(args)
+    eval_id, _ = client.jobs.deregister(args.job_id)
+    print(f"==> Evaluation {eval_id[:8]} created")
+    if args.detach:
+        return 0
+    return _monitor_eval(client, eval_id)
+
+
+def cmd_inspect(args) -> int:
+    client = _client(args)
+    from nomad_tpu.structs import to_dict
+
+    job, _ = client.jobs.info(args.job_id)
+    print(json.dumps(to_dict(job), indent=2))
+    return 0
+
+
+def cmd_node_status(args) -> int:
+    client = _client(args)
+    if not args.node_id:
+        nodes, _ = client.nodes.list()
+        print(f"{'ID':<10} {'DC':<8} {'Name':<16} {'Class':<12} "
+              f"{'Drain':<6} Status")
+        for n in nodes:
+            print(f"{n['ID'][:8]:<10} {n['Datacenter']:<8} {n['Name']:<16} "
+                  f"{n['NodeClass']:<12} {str(n['Drain']).lower():<6} "
+                  f"{n['Status']}")
+        return 0
+    node, _ = client.nodes.info(args.node_id)
+    print(f"ID     = {node['ID']}")
+    print(f"Name   = {node['Name']}")
+    print(f"Class  = {node['NodeClass']}")
+    print(f"DC     = {node['Datacenter']}")
+    print(f"Drain  = {node['Drain']}")
+    print(f"Status = {node['Status']}")
+    allocs, _ = client.nodes.allocations(args.node_id)
+    if allocs:
+        print("\nAllocations")
+        for a in allocs:
+            print(f"{a['ID'][:8]} {a['JobID']:<20} {a['TaskGroup']:<12} "
+                  f"{a['DesiredStatus']:<8} {a['ClientStatus']}")
+    return 0
+
+
+def cmd_node_drain(args) -> int:
+    client = _client(args)
+    client.nodes.toggle_drain(args.node_id, args.enable)
+    state = "enabled" if args.enable else "disabled"
+    print(f"Node {args.node_id[:8]} drain {state}")
+    return 0
+
+
+def cmd_alloc_status(args) -> int:
+    client = _client(args)
+    alloc, _ = client.allocations.info(args.alloc_id)
+    print(f"ID            = {alloc['ID']}")
+    print(f"Eval ID       = {alloc['EvalID'][:8]}")
+    print(f"Name          = {alloc['Name']}")
+    print(f"Node ID       = {alloc['NodeID'][:8]}")
+    print(f"Job ID        = {alloc['JobID']}")
+    print(f"Client Status = {alloc['ClientStatus']}")
+    print(f"Desired       = {alloc['DesiredStatus']}")
+    for task, state in (alloc.get("TaskStates") or {}).items():
+        print(f"\nTask {task!r} is {state['State']}")
+        for ev in state.get("Events", []):
+            detail = ev.get("DriverError") or ev.get("Message") or \
+                ev.get("ValidationError") or ev.get("DownloadError") or ""
+            print(f"  {ev['Type']}: exit={ev.get('ExitCode', 0)} {detail}")
+    metrics = alloc.get("Metrics") or {}
+    if metrics:
+        print(f"\nPlacement Metrics")
+        print(f"  Nodes evaluated: {metrics.get('NodesEvaluated', 0)}")
+        print(f"  Nodes filtered:  {metrics.get('NodesFiltered', 0)}")
+        print(f"  Nodes exhausted: {metrics.get('NodesExhausted', 0)}")
+    return 0
+
+
+def cmd_eval_status(args) -> int:
+    client = _client(args)
+    ev, _ = client.evaluations.info(args.eval_id)
+    print(f"ID           = {ev['ID'][:8]}")
+    print(f"Status       = {ev['Status']}")
+    print(f"Type         = {ev['Type']}")
+    print(f"TriggeredBy  = {ev['TriggeredBy']}")
+    print(f"Job ID       = {ev['JobID']}")
+    print(f"Priority     = {ev['Priority']}")
+    for tg, metric in (ev.get("FailedTGAllocs") or {}).items():
+        print(f"\nFailed placement: task group {tg!r}")
+        print(f"  Nodes evaluated: {metric.get('NodesEvaluated', 0)}")
+        for dim, count in (metric.get("DimensionExhausted") or {}).items():
+            print(f"  Dimension {dim!r} exhausted on {count} nodes")
+    return 0
+
+
+def cmd_fs(args) -> int:
+    client = _client(args)
+    if args.stat:
+        info = client.alloc_fs.stat(args.alloc_id, args.path)
+        print(f"{info['FileMode']} {info['Size']:>10} {info['Name']}")
+        return 0
+    if args.cat:
+        sys.stdout.write(client.alloc_fs.cat(args.alloc_id, args.path))
+        return 0
+    for fi in client.alloc_fs.list(args.alloc_id, args.path):
+        kind = "d" if fi["IsDir"] else "-"
+        print(f"{kind} {fi['FileMode']} {fi['Size']:>10} {fi['Name']}")
+    return 0
+
+
+def cmd_server_members(args) -> int:
+    client = _client(args)
+    for m in client.agent.members():
+        print(f"{m['Name']:<16} {m['Addr']}:{m['Port']} {m['Status']} "
+              f"region={m['Tags'].get('region')} dc={m['Tags'].get('dc')}")
+    return 0
+
+
+def cmd_agent_info(args) -> int:
+    client = _client(args)
+    print(json.dumps(client.agent.self(), indent=2))
+    return 0
+
+
+def cmd_system_gc(args) -> int:
+    client = _client(args)
+    client.system.garbage_collect()
+    print("System GC triggered")
+    return 0

@@ -1,0 +1,194 @@
+"""AllocRunner: per-allocation supervisor (reference: client/alloc_runner.go).
+
+Builds the AllocDir, runs one TaskRunner per task, aggregates task states
+into the allocation's client status, and persists/restores runner state.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import threading
+from typing import Callable, Dict, Optional
+
+from nomad_tpu.structs import Allocation, TaskEvent, TaskState
+from nomad_tpu.structs.structs import (
+    AllocClientStatusComplete,
+    AllocClientStatusFailed,
+    AllocClientStatusPending,
+    AllocClientStatusRunning,
+    TaskStateDead,
+    TaskStatePending,
+    TaskStateRunning,
+)
+
+from .allocdir import AllocDir
+from .driver import ExecContext
+from .env import TaskEnv
+from .restarts import RestartTracker
+from .task_runner import TaskRunner
+
+logger = logging.getLogger("nomad.alloc_runner")
+
+
+class AllocRunner:
+    def __init__(self, client_config, alloc: Allocation, node,
+                 on_status_change: Callable[[Allocation], None]):
+        self.config = client_config
+        self.alloc = alloc
+        self.node = node
+        self.on_status_change = on_status_change
+        self.alloc_dir: Optional[AllocDir] = None
+        self.task_runners: Dict[str, TaskRunner] = {}
+        self.task_states: Dict[str, TaskState] = dict(alloc.TaskStates or {})
+        self._lock = threading.Lock()
+        self._destroyed = False
+
+    # ------------------------------------------------------------- lifecycle
+    def run(self) -> None:
+        """(reference: alloc_runner.go:365-464)"""
+        tg = (self.alloc.Job.lookup_task_group(self.alloc.TaskGroup)
+              if self.alloc.Job is not None else None)
+        if tg is None:
+            logger.error("alloc %s: task group %r not in job", self.alloc.ID,
+                         self.alloc.TaskGroup)
+            self._set_alloc_status(AllocClientStatusFailed,
+                                   "task group missing from job")
+            return
+
+        with self._lock:
+            if self._destroyed:
+                return
+            self.alloc_dir = AllocDir(os.path.join(self.config.alloc_dir,
+                                                   self.alloc.ID))
+        self.alloc_dir.build([t.Name for t in tg.Tasks])
+
+        for task in tg.Tasks:
+            task = task.copy()
+            # Merge in the scheduler-assigned resources (ports!).
+            assigned = self.alloc.TaskResources.get(task.Name)
+            if assigned is not None:
+                task.Resources = assigned
+            env = TaskEnv(node=self.node, task=task, alloc=self.alloc,
+                          alloc_dir=self.alloc_dir.shared_dir,
+                          task_dir=os.path.join(
+                              self.alloc_dir.task_dirs.get(task.Name, ""),
+                              "local"))
+            exec_ctx = ExecContext(alloc_dir=self.alloc_dir,
+                                   alloc_id=self.alloc.ID, task_env=env)
+            policy = tg.RestartPolicy
+            if policy is None:
+                from nomad_tpu.structs import RestartPolicy as RP
+
+                policy = RP.for_job_type(self.alloc.Job.Type) or RP(
+                    Attempts=0, Mode="fail")
+            tracker = RestartTracker(policy, self.alloc.Job.Type)
+            runner = TaskRunner(self.config, self.alloc, task, exec_ctx,
+                                self.node, self._on_task_state, tracker)
+            with self._lock:
+                if self._destroyed:
+                    return  # stopped while building: don't start more tasks
+                self.task_runners[task.Name] = runner
+            saved = self._load_handle(task.Name)
+            if saved:
+                runner.restore(saved)
+            runner.start()
+
+    def update(self, alloc: Allocation) -> None:
+        """Server pushed a new version of the alloc (desired status)."""
+        with self._lock:
+            self.alloc = alloc
+        if alloc.terminal_status():
+            self.destroy_tasks()
+
+    def destroy_tasks(self) -> None:
+        with self._lock:
+            self._destroyed = True
+            runners = list(self.task_runners.values())
+        for runner in runners:
+            runner.destroy()
+
+    def destroy(self) -> None:
+        """Stop tasks and remove the alloc dir (GC)."""
+        self.destroy_tasks()
+        if self.alloc_dir is not None:
+            self.alloc_dir.destroy()
+
+    # ------------------------------------------------------------ aggregation
+    def _on_task_state(self, task_name: str, state: str,
+                       event: Optional[TaskEvent]) -> None:
+        """(reference: alloc_runner.go:285-335 setTaskState/syncStatus)"""
+        with self._lock:
+            ts = self.task_states.setdefault(task_name, TaskState())
+            ts.State = state
+            if event is not None:
+                ts.Events.append(event)
+                ts.Events = ts.Events[-10:]
+            self._persist_handles()
+            client_status, desc = self._alloc_status()
+        self._push_status(client_status, desc)
+
+    def _alloc_status(self) -> tuple:
+        """Aggregate task states -> alloc client status
+        (reference: alloc_runner.go:253-283)."""
+        pending = running = dead = failed = 0
+        for ts in self.task_states.values():
+            if ts.State == TaskStateRunning:
+                running += 1
+            elif ts.State == TaskStatePending:
+                pending += 1
+            elif ts.State == TaskStateDead:
+                if ts.successful():
+                    dead += 1
+                else:
+                    failed += 1
+        if failed > 0:
+            return AllocClientStatusFailed, "failed tasks"
+        if running > 0:
+            return AllocClientStatusRunning, "tasks are running"
+        if pending > 0:
+            return AllocClientStatusPending, "tasks are pending"
+        return AllocClientStatusComplete, "all tasks have completed"
+
+    def _set_alloc_status(self, status: str, desc: str) -> None:
+        self._push_status(status, desc)
+
+    def _push_status(self, status: str, desc: str) -> None:
+        with self._lock:
+            updated = self.alloc.copy()
+            updated.ClientStatus = status
+            updated.ClientDescription = desc
+            updated.TaskStates = {k: TaskState(State=v.State,
+                                               Events=list(v.Events))
+                                  for k, v in self.task_states.items()}
+            self.alloc = updated
+        self.on_status_change(updated)
+
+    # ------------------------------------------------------------ persistence
+    def _state_path(self) -> str:
+        return os.path.join(self.config.state_dir,
+                            f"alloc_{self.alloc.ID}.json")
+
+    def _persist_handles(self) -> None:
+        """Persist driver handle IDs for reattach (reference:
+        alloc_runner.go:105-215 + task handle persistence)."""
+        try:
+            os.makedirs(self.config.state_dir, exist_ok=True)
+            data = {"alloc_id": self.alloc.ID,
+                    "handles": {name: r.handle_id
+                                for name, r in self.task_runners.items()
+                                if r.handle_id}}
+            tmp = self._state_path() + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(data, f)
+            os.replace(tmp, self._state_path())
+        except OSError:
+            logger.exception("alloc %s: failed to persist state", self.alloc.ID)
+
+    def _load_handle(self, task_name: str) -> str:
+        try:
+            with open(self._state_path()) as f:
+                return json.load(f).get("handles", {}).get(task_name, "")
+        except (OSError, json.JSONDecodeError):
+            return ""

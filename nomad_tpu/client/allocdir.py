@@ -1,0 +1,90 @@
+"""Allocation directory layout (reference: client/allocdir/alloc_dir.go).
+
+  <alloc_dir>/<alloc_id>/
+    alloc/            shared between the alloc's tasks
+      logs/ tmp/ data/
+    <task>/
+      local/          task-private scratch
+
+Also provides the list/stat/read primitives behind the fs API
+(reference: AllocDirFS, client/allocdir/alloc_dir.go:303-360).
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import stat as statmod
+from dataclasses import dataclass
+from typing import Dict, List
+
+SHARED_ALLOC_NAME = "alloc"
+SHARED_DIRS = ("logs", "tmp", "data")
+TASK_LOCAL = "local"
+
+
+@dataclass
+class FileInfo:
+    Name: str = ""
+    IsDir: bool = False
+    Size: int = 0
+    FileMode: str = ""
+    ModTime: float = 0.0
+
+
+class AllocDir:
+    def __init__(self, root: str):
+        self.alloc_dir = root
+        self.shared_dir = os.path.join(root, SHARED_ALLOC_NAME)
+        self.task_dirs: Dict[str, str] = {}
+
+    def build(self, tasks: List[str]) -> None:
+        os.makedirs(self.alloc_dir, exist_ok=True)
+        os.makedirs(self.shared_dir, exist_ok=True)
+        for sub in SHARED_DIRS:
+            os.makedirs(os.path.join(self.shared_dir, sub), exist_ok=True)
+        for task in tasks:
+            tdir = os.path.join(self.alloc_dir, task)
+            os.makedirs(os.path.join(tdir, TASK_LOCAL), exist_ok=True)
+            self.task_dirs[task] = tdir
+
+    def log_dir(self) -> str:
+        return os.path.join(self.shared_dir, "logs")
+
+    def destroy(self) -> None:
+        shutil.rmtree(self.alloc_dir, ignore_errors=True)
+
+    # ------------------------------------------------------------ fs API
+    def _resolve(self, path: str) -> str:
+        """Resolve a relative path, refusing escapes from the alloc dir."""
+        root = os.path.normpath(self.alloc_dir)
+        full = os.path.normpath(os.path.join(root, path.lstrip("/")))
+        # Separator-anchored check: a sibling like <root>-evil must not pass.
+        if full != root and not full.startswith(root + os.sep):
+            raise PermissionError(f"path escapes alloc dir: {path}")
+        return full
+
+    def list_dir(self, path: str) -> List[FileInfo]:
+        full = self._resolve(path)
+        out = []
+        for name in sorted(os.listdir(full)):
+            st = os.stat(os.path.join(full, name))
+            out.append(FileInfo(
+                Name=name, IsDir=statmod.S_ISDIR(st.st_mode),
+                Size=st.st_size, FileMode=statmod.filemode(st.st_mode),
+                ModTime=st.st_mtime))
+        return out
+
+    def stat(self, path: str) -> FileInfo:
+        full = self._resolve(path)
+        st = os.stat(full)
+        return FileInfo(
+            Name=os.path.basename(full), IsDir=statmod.S_ISDIR(st.st_mode),
+            Size=st.st_size, FileMode=statmod.filemode(st.st_mode),
+            ModTime=st.st_mtime)
+
+    def read_at(self, path: str, offset: int = 0, limit: int = -1) -> bytes:
+        full = self._resolve(path)
+        with open(full, "rb") as f:
+            f.seek(offset)
+            return f.read(limit if limit >= 0 else -1)

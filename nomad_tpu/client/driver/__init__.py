@@ -1,0 +1,31 @@
+"""Task drivers (reference: client/driver/).
+
+A Driver validates config, fingerprints its availability onto the node
+(`driver.<name>` attribute), starts tasks, and re-opens handles after agent
+restart. Built-ins: raw_exec, exec (cgroup/chroot isolation), java, qemu,
+docker, and mock_driver for tests.
+"""
+
+from .base import Driver, DriverContext, DriverHandle, ExecContext, WaitResult  # noqa: F401
+from .raw_exec import RawExecDriver
+from .exec_driver import ExecDriver
+from .java import JavaDriver
+from .qemu import QemuDriver
+from .docker import DockerDriver
+from .mock_driver import MockDriver
+
+BUILTIN_DRIVERS = {
+    "raw_exec": RawExecDriver,
+    "exec": ExecDriver,
+    "java": JavaDriver,
+    "qemu": QemuDriver,
+    "docker": DockerDriver,
+    "mock_driver": MockDriver,
+}
+
+
+def new_driver(name: str, ctx: DriverContext) -> Driver:
+    cls = BUILTIN_DRIVERS.get(name)
+    if cls is None:
+        raise ValueError(f"unknown driver '{name}'")
+    return cls(ctx)

@@ -1,0 +1,242 @@
+"""Driver interface (reference: client/driver/driver.go:50-172)."""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional
+
+from nomad_tpu.client.allocdir import AllocDir
+from nomad_tpu.client.env import TaskEnv
+from nomad_tpu.structs import Allocation, Node, Task
+
+
+@dataclass
+class WaitResult:
+    exit_code: int = 0
+    signal: int = 0
+    error: str = ""
+
+    def successful(self) -> bool:
+        return self.exit_code == 0 and self.signal == 0 and not self.error
+
+
+@dataclass
+class DriverContext:
+    """Static driver context (reference: driver.go:64-90)."""
+
+    task_name: str = ""
+    config: Any = None  # client config
+    node: Optional[Node] = None
+
+
+@dataclass
+class ExecContext:
+    """Per-task execution context (reference: driver.go:135-152)."""
+
+    alloc_dir: Optional[AllocDir] = None
+    alloc_id: str = ""
+    task_env: Optional[TaskEnv] = None
+
+
+class DriverHandle:
+    """A running task (reference: driver.go:120-133)."""
+
+    def id(self) -> str:
+        raise NotImplementedError
+
+    def wait(self, timeout: Optional[float] = None) -> Optional[WaitResult]:
+        """Block until exit (or timeout); None on timeout."""
+        raise NotImplementedError
+
+    def update(self, task: Task) -> None:
+        pass
+
+    def kill(self, kill_timeout: float = 5.0) -> None:
+        raise NotImplementedError
+
+
+class Driver:
+    name = "base"
+
+    def __init__(self, ctx: DriverContext):
+        self.ctx = ctx
+
+    def fingerprint(self, config, node: Node) -> bool:
+        """Set driver.<name> attribute if available on this machine."""
+        raise NotImplementedError
+
+    def validate(self, config: Dict[str, Any]) -> None:
+        """Raise ValueError on invalid task config."""
+
+    def start(self, ctx: ExecContext, task: Task) -> DriverHandle:
+        raise NotImplementedError
+
+    def open(self, ctx: ExecContext, handle_id: str) -> DriverHandle:
+        """Re-attach to a running task after agent restart."""
+        raise NotImplementedError
+
+
+class ExecutorHandle(DriverHandle):
+    """Handle over the detached executor process (see client/executor.py)."""
+
+    def __init__(self, state_dir: str, task_name: str, executor_pid: int):
+        self.state_dir = state_dir
+        self.task_name = task_name
+        self.executor_pid = executor_pid
+        self._result: Optional[WaitResult] = None
+        self._done = threading.Event()
+        self._watcher = threading.Thread(target=self._watch, daemon=True)
+        self._watcher.start()
+
+    # ------------------------------------------------------------- protocol
+    def id(self) -> str:
+        return json.dumps({"state_dir": self.state_dir,
+                           "task_name": self.task_name,
+                           "executor_pid": self.executor_pid})
+
+    @staticmethod
+    def from_id(handle_id: str) -> "ExecutorHandle":
+        data = json.loads(handle_id)
+        return ExecutorHandle(data["state_dir"], data["task_name"],
+                              data["executor_pid"])
+
+    # -------------------------------------------------------------- running
+    def _exit_path(self) -> str:
+        return os.path.join(self.state_dir,
+                            f"{self.task_name}.exit_status.json")
+
+    def _state_path(self) -> str:
+        return os.path.join(self.state_dir,
+                            f"{self.task_name}.executor_state.json")
+
+    def _watch(self) -> None:
+        while not self._done.is_set():
+            if os.path.exists(self._exit_path()):
+                try:
+                    with open(self._exit_path()) as f:
+                        data = json.load(f)
+                    self._result = WaitResult(
+                        exit_code=data.get("exit_code", 0),
+                        signal=data.get("signal", 0))
+                except (OSError, json.JSONDecodeError):
+                    self._result = WaitResult(error="failed to read exit status")
+                self._done.set()
+                return
+            if not _pid_alive(self.executor_pid):
+                # Executor died without writing status.
+                time.sleep(0.2)  # allow a just-written file to land
+                if not os.path.exists(self._exit_path()):
+                    self._result = WaitResult(
+                        error="executor terminated unexpectedly")
+                    self._done.set()
+                    return
+                continue
+            time.sleep(0.1)
+
+    def wait(self, timeout: Optional[float] = None) -> Optional[WaitResult]:
+        if not self._done.wait(timeout):
+            return None
+        return self._result
+
+    def kill(self, kill_timeout: float = 5.0) -> None:
+        pgid = self._pgid()
+        if pgid is None:
+            return
+        try:
+            os.killpg(pgid, signal.SIGTERM)
+        except ProcessLookupError:
+            return
+        if not self._done.wait(kill_timeout):
+            try:
+                os.killpg(pgid, signal.SIGKILL)
+            except ProcessLookupError:
+                pass
+            self._done.wait(2.0)
+
+    def _pgid(self) -> Optional[int]:
+        try:
+            with open(self._state_path()) as f:
+                return json.load(f).get("pgid")
+        except (OSError, json.JSONDecodeError):
+            return None
+
+
+def _pid_alive(pid: int) -> bool:
+    try:
+        os.kill(pid, 0)
+        return True
+    except ProcessLookupError:
+        return False
+    except PermissionError:
+        return True
+
+
+def build_executor_spec(ctx: "ExecContext", task: Task, command: str,
+                        args: list) -> Dict[str, Any]:
+    """Common executor spec: interpolated command/args, env, cwd, log config.
+    Shared by every executor-backed driver."""
+    env = ctx.task_env
+    task_dir = ctx.alloc_dir.task_dirs[task.Name]
+    spec: Dict[str, Any] = {
+        "command": env.replace(str(command)),
+        "args": [env.replace(str(a)) for a in args],
+        "env": env.build_env(),
+        "cwd": task_dir,
+        "log_dir": ctx.alloc_dir.log_dir(),
+        "max_files": task.LogConfig.MaxFiles if task.LogConfig else 10,
+        "max_file_size_mb": (task.LogConfig.MaxFileSizeMB
+                             if task.LogConfig else 10),
+    }
+    if task.User:
+        spec["user"] = task.User
+    return spec
+
+
+def launch_executor(state_dir: str, task_name: str, spec: Dict[str, Any]
+                    ) -> ExecutorHandle:
+    """Write the spec and start the detached executor."""
+    os.makedirs(state_dir, exist_ok=True)
+    spec_path = os.path.join(state_dir, f"{task_name}.executor_spec.json")
+    spec = dict(spec, task_name=task_name)
+    with open(spec_path, "w") as f:
+        json.dump(spec, f)
+    # Clear stale exit/state files from a previous run.
+    for suffix in ("exit_status.json", "executor_state.json"):
+        try:
+            os.unlink(os.path.join(state_dir, f"{task_name}.{suffix}"))
+        except FileNotFoundError:
+            pass
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "nomad_tpu.client.executor", spec_path],
+        start_new_session=True,
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+        env=dict(os.environ,
+                 PYTHONPATH=os.pathsep.join(
+                     [p for p in [os.environ.get("PYTHONPATH"),
+                                  _repo_root()] if p])),
+    )
+    # Wait for the executor to write its state file.
+    state_path = os.path.join(state_dir, f"{task_name}.executor_state.json")
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        if os.path.exists(state_path):
+            break
+        if proc.poll() is not None:
+            raise RuntimeError(
+                f"executor exited immediately with code {proc.returncode}")
+        time.sleep(0.02)
+    else:
+        raise RuntimeError("executor failed to start in time")
+    return ExecutorHandle(state_dir, task_name, proc.pid)
+
+
+def _repo_root() -> str:
+    return os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__)))))

@@ -1,0 +1,107 @@
+"""docker driver: container lifecycle via the docker CLI (reference:
+client/driver/docker.go speaks the daemon API; the CLI carries the same
+operations without a vendored daemon client).
+"""
+
+from __future__ import annotations
+
+import json
+import shutil
+import subprocess
+import threading
+import time
+from typing import Any, Dict, Optional
+
+from nomad_tpu.structs import Node, Task
+
+from .base import Driver, DriverHandle, ExecContext, WaitResult
+
+
+class DockerHandle(DriverHandle):
+    def __init__(self, container_id: str):
+        self.container_id = container_id
+        self._result: Optional[WaitResult] = None
+        self._done = threading.Event()
+        self._watcher = threading.Thread(target=self._watch, daemon=True)
+        self._watcher.start()
+
+    def id(self) -> str:
+        return json.dumps({"container_id": self.container_id})
+
+    @staticmethod
+    def from_id(handle_id: str) -> "DockerHandle":
+        return DockerHandle(json.loads(handle_id)["container_id"])
+
+    def _watch(self) -> None:
+        try:
+            out = subprocess.run(["docker", "wait", self.container_id],
+                                 capture_output=True, text=True)
+            code = int(out.stdout.strip() or 0)
+            self._result = WaitResult(exit_code=code)
+        except Exception as e:
+            self._result = WaitResult(error=str(e))
+        self._done.set()
+
+    def wait(self, timeout: Optional[float] = None) -> Optional[WaitResult]:
+        if not self._done.wait(timeout):
+            return None
+        return self._result
+
+    def kill(self, kill_timeout: float = 5.0) -> None:
+        subprocess.run(["docker", "stop", "-t", str(int(kill_timeout)),
+                        self.container_id], capture_output=True)
+
+
+class DockerDriver(Driver):
+    name = "docker"
+
+    def fingerprint(self, config, node: Node) -> bool:
+        if shutil.which("docker") is None:
+            node.Attributes.pop("driver.docker", None)
+            return False
+        try:
+            out = subprocess.run(["docker", "version", "--format",
+                                  "{{.Server.Version}}"],
+                                 capture_output=True, text=True, timeout=10)
+            if out.returncode != 0:
+                node.Attributes.pop("driver.docker", None)
+                return False
+            node.Attributes["driver.docker"] = "1"
+            node.Attributes["driver.docker.version"] = out.stdout.strip()
+            return True
+        except Exception:
+            return False
+
+    def validate(self, config: Dict[str, Any]) -> None:
+        if not config.get("image"):
+            raise ValueError("missing image for docker driver")
+
+    def start(self, ctx: ExecContext, task: Task) -> DriverHandle:
+        self.validate(task.Config)
+        env = ctx.task_env
+        image = env.replace(str(task.Config["image"]))
+        task_dir = ctx.alloc_dir.task_dirs[task.Name]
+        cmd = ["docker", "run", "-d",
+               "-v", f"{ctx.alloc_dir.shared_dir}:/alloc",
+               "-v", f"{task_dir}/local:/local"]
+        if task.Resources is not None:
+            cmd.extend(["--memory", f"{task.Resources.MemoryMB}m",
+                        "--cpu-shares", str(task.Resources.CPU)])
+            for net in task.Resources.Networks:
+                for label, value in net.port_labels().items():
+                    guest = task.Config.get("port_map", {}).get(label, value)
+                    cmd.extend(["-p", f"{value}:{guest}"])
+        for k, v in env.build_env().items():
+            cmd.extend(["-e", f"{k}={v}"])
+        cmd.append(image)
+        if task.Config.get("command"):
+            cmd.append(env.replace(str(task.Config["command"])))
+            cmd.extend(env.replace(str(a))
+                       for a in task.Config.get("args", []))
+        out = subprocess.run(cmd, capture_output=True, text=True, timeout=300)
+        if out.returncode != 0:
+            raise RuntimeError(f"docker run failed: {out.stderr.strip()}")
+        return DockerHandle(out.stdout.strip())
+
+    def open(self, ctx: ExecContext, handle_id: str) -> DriverHandle:
+        return DockerHandle.from_id(handle_id)

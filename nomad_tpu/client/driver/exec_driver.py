@@ -1,0 +1,58 @@
+"""exec driver: isolated process runner (reference: client/driver/exec.go).
+
+Linux-only: requires root + cgroups for resource isolation (the reference
+additionally chroots into the task dir; here the chroot applies when running
+as root). Falls back unavailable otherwise, exactly like the reference's
+fingerprint gate (exec.go:57-76).
+"""
+
+from __future__ import annotations
+
+import os
+import platform
+from typing import Any, Dict
+
+from nomad_tpu.structs import Node, Task
+
+from .base import (
+    Driver,
+    DriverHandle,
+    ExecContext,
+    ExecutorHandle,
+    build_executor_spec,
+    launch_executor,
+)
+
+
+class ExecDriver(Driver):
+    name = "exec"
+
+    def fingerprint(self, config, node: Node) -> bool:
+        if platform.system() != "Linux":
+            node.Attributes.pop("driver.exec", None)
+            return False
+        if os.geteuid() != 0:
+            node.Attributes.pop("driver.exec", None)
+            return False
+        if "unique.cgroup.mountpoint" not in node.Attributes:
+            node.Attributes.pop("driver.exec", None)
+            return False
+        node.Attributes["driver.exec"] = "1"
+        return True
+
+    def validate(self, config: Dict[str, Any]) -> None:
+        if not config.get("command"):
+            raise ValueError("missing command for exec driver")
+
+    def start(self, ctx: ExecContext, task: Task) -> DriverHandle:
+        self.validate(task.Config)
+        spec = build_executor_spec(ctx, task, task.Config["command"],
+                                   task.Config.get("args", []))
+        if task.Resources is not None:
+            spec["cgroup"] = {"cpu_shares": task.Resources.CPU,
+                              "memory_mb": task.Resources.MemoryMB}
+        return launch_executor(ctx.alloc_dir.task_dirs[task.Name],
+                               task.Name, spec)
+
+    def open(self, ctx: ExecContext, handle_id: str) -> DriverHandle:
+        return ExecutorHandle.from_id(handle_id)

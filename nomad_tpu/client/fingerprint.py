@@ -1,0 +1,156 @@
+"""Node fingerprinting (reference: client/fingerprint/).
+
+Each fingerprinter inspects the machine and fills node attributes/resources;
+`fingerprint_node` runs them all. Readings come from /proc and the stdlib
+(the reference shells out to gopsutil for the same data).
+"""
+
+from __future__ import annotations
+
+import multiprocessing
+import os
+import platform
+import shutil
+import socket
+import time
+from typing import Callable, Dict, List
+
+from nomad_tpu import __version__ as NOMAD_TPU_VERSION
+from nomad_tpu.structs import NetworkResource, Node, Resources
+
+
+def _arch(node: Node, config) -> bool:
+    node.Attributes["arch"] = platform.machine() or "unknown"
+    return True
+
+
+def _host(node: Node, config) -> bool:
+    node.Attributes["os.name"] = platform.system().lower()
+    node.Attributes["kernel.name"] = platform.system().lower()
+    node.Attributes["kernel.version"] = platform.release()
+    node.Attributes["unique.hostname"] = socket.gethostname()
+    if not node.Name:
+        node.Name = socket.gethostname()
+    return True
+
+
+def _cpu(node: Node, config) -> bool:
+    cores = multiprocessing.cpu_count()
+    mhz = 1000.0
+    try:
+        with open("/proc/cpuinfo") as f:
+            for line in f:
+                if line.lower().startswith("cpu mhz"):
+                    mhz = float(line.split(":")[1])
+                    break
+                if line.lower().startswith("bogomips"):
+                    mhz = float(line.split(":")[1]) / 2
+    except OSError:
+        pass
+    node.Attributes["cpu.numcores"] = str(cores)
+    node.Attributes["cpu.frequency"] = f"{mhz:.0f}"
+    total = int(cores * mhz)
+    node.Attributes["cpu.totalcompute"] = str(total)
+    if node.Resources is None:
+        node.Resources = Resources()
+    if node.Resources.CPU == 0:
+        node.Resources.CPU = total
+    return True
+
+
+def _memory(node: Node, config) -> bool:
+    total_mb = 0
+    try:
+        with open("/proc/meminfo") as f:
+            for line in f:
+                if line.startswith("MemTotal:"):
+                    total_mb = int(line.split()[1]) // 1024
+                    break
+    except OSError:
+        return False
+    node.Attributes["memory.totalbytes"] = str(total_mb * 1024 * 1024)
+    if node.Resources is None:
+        node.Resources = Resources()
+    if node.Resources.MemoryMB == 0:
+        node.Resources.MemoryMB = total_mb
+    return True
+
+
+def _storage(node: Node, config) -> bool:
+    path = getattr(config, "alloc_dir", None) or "/tmp"
+    # The alloc dir may not exist yet at fingerprint time: measure the
+    # closest existing ancestor (same filesystem).
+    probe = path
+    while probe and not os.path.exists(probe):
+        parent = os.path.dirname(probe)
+        if parent == probe:
+            break
+        probe = parent
+    try:
+        usage = shutil.disk_usage(probe or "/")
+    except OSError:
+        return False
+    node.Attributes["unique.storage.volume"] = path
+    node.Attributes["unique.storage.bytestotal"] = str(usage.total)
+    node.Attributes["unique.storage.bytesfree"] = str(usage.free)
+    if node.Resources is None:
+        node.Resources = Resources()
+    if node.Resources.DiskMB == 0:
+        node.Resources.DiskMB = usage.free // (1024 * 1024)
+    return True
+
+
+def _network(node: Node, config) -> bool:
+    ip = _default_ip()
+    if ip is None:
+        return False
+    node.Attributes["unique.network.ip-address"] = ip
+    if node.Resources is None:
+        node.Resources = Resources()
+    if not node.Resources.Networks:
+        speed = getattr(config, "network_speed", 0) or 1000
+        node.Resources.Networks.append(NetworkResource(
+            Device="eth0", CIDR=f"{ip}/32", IP=ip, MBits=speed))
+    return True
+
+
+def _default_ip() -> str:
+    try:
+        s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        try:
+            s.connect(("10.255.255.255", 1))
+            return s.getsockname()[0]
+        finally:
+            s.close()
+    except OSError:
+        return "127.0.0.1"
+
+
+def _nomad(node: Node, config) -> bool:
+    node.Attributes["nomad.version"] = NOMAD_TPU_VERSION
+    return True
+
+
+def _cgroup(node: Node, config) -> bool:
+    for path in ("/sys/fs/cgroup/cgroup.controllers", "/sys/fs/cgroup/memory"):
+        if os.path.exists(path):
+            node.Attributes["unique.cgroup.mountpoint"] = "/sys/fs/cgroup"
+            return True
+    return False
+
+
+BUILTIN_FINGERPRINTERS: List[Callable] = [
+    _arch, _host, _cpu, _memory, _storage, _network, _nomad, _cgroup,
+]
+
+
+def fingerprint_node(node: Node, config=None) -> Dict[str, bool]:
+    """Run all fingerprinters; returns name -> applied."""
+    results = {}
+    for fp in BUILTIN_FINGERPRINTERS:
+        name = fp.__name__.lstrip("_")
+        try:
+            results[name] = bool(fp(node, config))
+        except Exception:
+            results[name] = False
+    return results

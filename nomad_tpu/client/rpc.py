@@ -1,0 +1,77 @@
+"""Client <-> server channel (reference: the msgpack RPC surface the client
+uses — Node.Register, Node.UpdateStatus, Node.GetClientAllocs with blocking,
+Alloc.GetAllocs, Node.UpdateAlloc; nomad/rpc.go + client/rpcproxy/).
+
+The dev-mode/in-process implementation calls the Server directly and uses
+state-store watches for blocking queries; a wire implementation (msgpack over
+TCP) plugs in behind the same interface.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Protocol, Tuple
+
+from nomad_tpu.state.watch import Item
+from nomad_tpu.structs import Allocation, Node
+
+
+class ServerChannel(Protocol):
+    def register_node(self, node: Node) -> float: ...
+    def heartbeat(self, node_id: str) -> float: ...
+    def update_node_status(self, node_id: str, status: str) -> float: ...
+    def get_client_allocs(self, node_id: str, min_index: int,
+                          max_wait: float) -> Tuple[Dict[str, int], int]: ...
+    def get_allocs(self, alloc_ids: List[str]) -> List[Allocation]: ...
+    def update_allocs(self, allocs: List[Allocation]) -> None: ...
+
+
+class InProcServerChannel:
+    """Direct in-process channel to a Server (dev mode, reference: the
+    agent's server-embedded RPC shortcut, command/agent/agent.go:597)."""
+
+    def __init__(self, server):
+        self.server = server
+
+    def register_node(self, node: Node) -> float:
+        ttl, _ = self.server.node_register(node)
+        return ttl
+
+    def heartbeat(self, node_id: str) -> float:
+        return self.server.node_heartbeat(node_id)
+
+    def update_node_status(self, node_id: str, status: str) -> float:
+        ttl, _ = self.server.node_update_status(node_id, status)
+        return ttl
+
+    def get_client_allocs(self, node_id: str, min_index: int,
+                          max_wait: float) -> Tuple[Dict[str, int], int]:
+        """Blocking query: alloc_id -> AllocModifyIndex for the node
+        (reference: node_endpoint.go:474-528 GetClientAllocs)."""
+        state = self.server.state
+        event = threading.Event()
+        items = [Item(alloc_node=node_id)]
+        state.watch(items, event)
+        try:
+            while True:
+                allocs = state.allocs_by_node(node_id)
+                index = max((a.AllocModifyIndex for a in allocs),
+                            default=state.get_index("allocs"))
+                if index > min_index or max_wait <= 0:
+                    return ({a.ID: a.AllocModifyIndex for a in allocs}, index)
+                event.clear()
+                if not event.wait(max_wait):
+                    return ({a.ID: a.AllocModifyIndex for a in allocs}, index)
+        finally:
+            state.stop_watch(items, event)
+
+    def get_allocs(self, alloc_ids: List[str]) -> List[Allocation]:
+        out = []
+        for aid in alloc_ids:
+            alloc = self.server.state.alloc_by_id(aid)
+            if alloc is not None:
+                out.append(alloc)
+        return out
+
+    def update_allocs(self, allocs: List[Allocation]) -> None:
+        self.server.node_update_allocs(allocs)

@@ -1,0 +1,175 @@
+"""Agent + HTTP API + api client + jobspec tests (shaped after reference
+command/agent/*_test.go and api/*_test.go — black-box dev-mode agent)."""
+
+import threading
+import time
+
+import pytest
+
+from nomad_tpu.agent import Agent, AgentConfig
+from nomad_tpu.api import APIError, Client as APIClient, QueryOptions
+from nomad_tpu.jobspec import parse_duration, parse_job
+from nomad_tpu.structs.structs import SECOND, MINUTE
+
+
+def wait_for(cond, timeout=30.0, interval=0.1):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if cond():
+            return True
+        time.sleep(interval)
+    return False
+
+
+@pytest.fixture(scope="module")
+def dev_agent(tmp_path_factory):
+    config = AgentConfig.dev()
+    config.http_port = 0  # ephemeral
+    config.data_dir = str(tmp_path_factory.mktemp("agent"))
+    agent = Agent(config)
+    agent.start()
+    api = APIClient(address=f"http://127.0.0.1:{agent.http.port}")
+    yield agent, api
+    agent.shutdown()
+
+
+BATCH_JOB = '''
+job "httpjob" {
+  datacenters = ["dc1"]
+  type = "batch"
+  group "g" {
+    task "t" {
+      driver = "raw_exec"
+      config { command = "/bin/sh" args = ["-c", "echo api > ${NOMAD_TASK_DIR}/api.txt; sleep 1"] }
+      resources { cpu = 50 memory = 32 disk = 300 }
+    }
+  }
+}
+'''
+
+
+class TestHTTPAPI:
+    def test_agent_self_and_members(self, dev_agent):
+        agent, api = dev_agent
+        self_info = api.agent.self()
+        assert self_info["config"]["Server"] is True
+        assert self_info["config"]["Client"] is True
+        members = api.agent.members()
+        assert members[0]["Status"] == "alive"
+        assert api.regions.list() == ["global"]
+
+    def test_nodes_listed(self, dev_agent):
+        agent, api = dev_agent
+        assert wait_for(lambda: len(api.nodes.list()[0]) == 1)
+        nodes, meta = api.nodes.list()
+        assert meta.last_index > 0
+        node, _ = api.nodes.info(nodes[0]["ID"])
+        assert node["Status"] == "ready"
+        assert node["Attributes"]["driver.raw_exec"] == "1"
+
+    def test_job_lifecycle_over_http(self, dev_agent):
+        agent, api = dev_agent
+        job = parse_job(BATCH_JOB)
+        job.init_fields()
+        eval_id, meta = api.jobs.register(job)
+        assert eval_id
+        # Eval completes.
+        assert wait_for(lambda: api.evaluations.info(eval_id)[0]["Status"]
+                        == "complete")
+        # Allocation visible via job + eval + node queries.
+        allocs, _ = api.jobs.allocations("httpjob")
+        assert len(allocs) == 1
+        assert wait_for(lambda: api.jobs.allocations("httpjob")[0][0]
+                        ["ClientStatus"] == "complete", timeout=40)
+        alloc_id = allocs[0]["ID"]
+        full, _ = api.allocations.info(alloc_id)
+        assert full["Job"]["ID"] == "httpjob"
+        # fs API reads the task output through the agent.
+        content = api.alloc_fs.cat(alloc_id, "t/local/api.txt")
+        assert content.strip() == "api"
+        listing = api.alloc_fs.list(alloc_id, "alloc/logs")
+        assert any(f["Name"].startswith("t.stdout") for f in listing)
+        # Job listing + info.
+        jobs, _ = api.jobs.list()
+        assert any(j["ID"] == "httpjob" for j in jobs)
+        info, _ = api.jobs.info("httpjob")
+        assert info.TaskGroups[0].Tasks[0].Driver == "raw_exec"
+        # Stop.
+        api.jobs.deregister("httpjob")
+        with pytest.raises(APIError) as exc:
+            api.jobs.info("httpjob")
+        assert exc.value.code == 404
+
+    def test_blocking_query_wakes_on_change(self, dev_agent):
+        agent, api = dev_agent
+        _, meta = api.jobs.list()
+        result = {}
+
+        def blocked():
+            jobs, m = api.jobs.list(QueryOptions(wait_index=meta.last_index,
+                                                 wait_time=10))
+            result["jobs"] = jobs
+            result["index"] = m.last_index
+
+        t = threading.Thread(target=blocked)
+        t.start()
+        time.sleep(0.3)
+        job = parse_job(BATCH_JOB)
+        job.ID = job.Name = "blocker"
+        job.TaskGroups[0].Tasks[0].Config = {"command": "/bin/true"}
+        api.jobs.register(job)
+        t.join(timeout=10)
+        assert not t.is_alive(), "blocking query never woke"
+        assert result["index"] > meta.last_index
+        api.jobs.deregister("blocker")
+
+    def test_error_codes(self, dev_agent):
+        agent, api = dev_agent
+        with pytest.raises(APIError) as exc:
+            api.jobs.info("nonexistent-job")
+        assert exc.value.code == 404
+        with pytest.raises(APIError) as exc:
+            api.request("GET", "/v1/bogus/path")
+        assert exc.value.code == 404
+
+    def test_system_gc(self, dev_agent):
+        agent, api = dev_agent
+        api.system.garbage_collect()  # must not error
+
+
+class TestJobspec:
+    def test_parse_duration(self):
+        assert parse_duration("30s") == 30 * SECOND
+        assert parse_duration("5m") == 5 * MINUTE
+        assert parse_duration("1h30m") == 90 * MINUTE
+        assert parse_duration("250ms") == 250 * 1_000_000
+        with pytest.raises(ValueError):
+            parse_duration("banana")
+
+    def test_constraint_sugar(self):
+        job = parse_job('''
+job "x" {
+  datacenters = ["dc1"]
+  constraint { attribute = "${attr.nomad.version}" version = ">= 0.1" }
+  constraint { attribute = "${attr.arch}" regexp = "x86.*" }
+  constraint { distinct_hosts = true }
+  group "g" { task "t" { driver = "raw_exec"
+    config { command = "/bin/true" } } }
+}''')
+        ops = [c.Operand for c in job.Constraints]
+        assert ops == ["version", "regexp", "distinct_hosts"]
+
+    def test_multiple_groups_and_tasks(self):
+        job = parse_job('''
+job "multi" {
+  datacenters = ["dc1"]
+  group "a" {
+    count = 2
+    task "t1" { driver = "raw_exec" config { command = "/bin/true" } }
+    task "t2" { driver = "raw_exec" config { command = "/bin/true" } }
+  }
+  group "b" { task "t3" { driver = "raw_exec" config { command = "/bin/true" } } }
+}''')
+        assert [g.Name for g in job.TaskGroups] == ["a", "b"]
+        assert [t.Name for t in job.TaskGroups[0].Tasks] == ["t1", "t2"]
+        assert job.TaskGroups[0].Count == 2

@@ -1,0 +1,224 @@
+"""Client agent tests (shaped after reference client/*_test.go)."""
+
+import os
+import tempfile
+import time
+
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.client import Client, ClientConfig, InProcServerChannel
+from nomad_tpu.client.allocdir import AllocDir
+from nomad_tpu.client.env import TaskEnv
+from nomad_tpu.client.fingerprint import fingerprint_node
+from nomad_tpu.client.logs import FileRotator
+from nomad_tpu.client.restarts import NO_RESTART, RESTART_WAIT, RestartTracker
+from nomad_tpu.jobspec import parse_job
+from nomad_tpu.server import Server, ServerConfig
+from nomad_tpu.structs import Node, Resources, RestartPolicy
+from nomad_tpu.structs.structs import (
+    SECOND,
+    JobTypeBatch,
+    JobTypeService,
+    NodeStatusReady,
+    RestartPolicyModeFail,
+)
+
+
+def wait_for(cond, timeout=30.0, interval=0.1):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if cond():
+            return True
+        time.sleep(interval)
+    return False
+
+
+class TestFingerprint:
+    def test_basics(self):
+        node = Node(Resources=Resources())
+        results = fingerprint_node(node, None)
+        assert results["arch"] and results["cpu"] and results["memory"]
+        assert node.Attributes["kernel.name"]
+        assert int(node.Attributes["cpu.numcores"]) >= 1
+        assert node.Resources.CPU > 0
+        assert node.Resources.MemoryMB > 0
+        assert "unique.hostname" in node.Attributes
+
+
+class TestAllocDir:
+    def test_build_and_fs(self):
+        with tempfile.TemporaryDirectory() as tmp:
+            ad = AllocDir(os.path.join(tmp, "a1"))
+            ad.build(["web", "db"])
+            assert os.path.isdir(os.path.join(ad.shared_dir, "logs"))
+            assert os.path.isdir(os.path.join(ad.task_dirs["web"], "local"))
+            with open(os.path.join(ad.shared_dir, "data", "x.txt"), "w") as f:
+                f.write("hello")
+            infos = ad.list_dir("alloc/data")
+            assert infos[0].Name == "x.txt" and infos[0].Size == 5
+            assert ad.read_at("alloc/data/x.txt", 1, 3) == b"ell"
+            with pytest.raises(PermissionError):
+                ad.read_at("../../etc/passwd")
+            ad.destroy()
+            assert not os.path.exists(ad.alloc_dir)
+
+
+class TestTaskEnv:
+    def test_env_and_interpolation(self):
+        node = mock.node()
+        alloc = mock.alloc()
+        task = alloc.Job.TaskGroups[0].Tasks[0]
+        env = TaskEnv(node=node, task=task, alloc=alloc,
+                      alloc_dir="/alloc", task_dir="/task")
+        built = env.build_env()
+        assert built["NOMAD_ALLOC_ID"] == alloc.ID
+        assert built["NOMAD_TASK_DIR"] == "/task"
+        assert built["NOMAD_MEMORY_LIMIT"] == "256"
+        assert built["FOO"] == "bar"
+        # Port env vars from assigned resources.
+        assert built["NOMAD_PORT_MAIN"] == "5000"
+        assert built["NOMAD_IP_MAIN"] == "192.168.0.100"
+        # Interpolation of node attrs/meta.
+        assert env.replace("${attr.kernel.name}") == "linux"
+        assert env.replace("${meta.pci-dss}") == "true"
+        assert env.replace("${node.datacenter}") == "dc1"
+        assert env.replace("no vars here") == "no vars here"
+
+
+class TestFileRotator:
+    def test_rotation(self):
+        with tempfile.TemporaryDirectory() as tmp:
+            r = FileRotator(tmp, "task.stdout", max_files=2, max_size_mb=1)
+            chunk = b"x" * (512 * 1024)
+            for _ in range(6):  # 3MB total -> rotates twice, keeps 2 files
+                r.write(chunk)
+            r.close()
+            files = sorted(os.listdir(tmp))
+            assert len(files) == 2
+            assert files[-1].startswith("task.stdout.")
+
+
+class TestRestartTracker:
+    def test_batch_success_no_restart(self):
+        rt = RestartTracker(RestartPolicy(Attempts=3, Interval=60 * SECOND,
+                                          Delay=1 * SECOND, Mode="delay"),
+                            JobTypeBatch)
+        assert rt.next_restart(0)[0] == NO_RESTART
+
+    def test_service_restarts_with_delay(self):
+        rt = RestartTracker(RestartPolicy(Attempts=2, Interval=3600 * SECOND,
+                                          Delay=1 * SECOND, Mode="delay"),
+                            JobTypeService)
+        decision, wait = rt.next_restart(1)
+        assert decision == RESTART_WAIT
+        assert 1.0 <= wait <= 1.3
+
+    def test_fail_mode_stops(self):
+        rt = RestartTracker(RestartPolicy(Attempts=1, Interval=3600 * SECOND,
+                                          Delay=1 * SECOND,
+                                          Mode=RestartPolicyModeFail),
+                            JobTypeService)
+        assert rt.next_restart(1)[0] == RESTART_WAIT
+        assert rt.next_restart(1)[0] == NO_RESTART
+
+
+@pytest.fixture
+def dev_cluster(tmp_path):
+    srv = Server(ServerConfig(num_schedulers=2))
+    srv.establish_leadership()
+    cfg = ClientConfig(state_dir=str(tmp_path / "state"),
+                       alloc_dir=str(tmp_path / "alloc"),
+                       options={"driver.raw_exec.enable": "true"})
+    client = Client(cfg, InProcServerChannel(srv))
+    client.start()
+    yield srv, client, cfg
+    client.shutdown()
+    srv.shutdown()
+
+
+class TestClientEndToEnd:
+    def test_node_registration(self, dev_cluster):
+        srv, client, cfg = dev_cluster
+        node = srv.state.node_by_id(client.node.ID)
+        assert node is not None
+        assert node.Status == NodeStatusReady
+        assert node.Attributes["driver.raw_exec"] == "1"
+        assert node.ComputedClass
+
+    def test_batch_job_runs_to_completion(self, dev_cluster):
+        srv, client, cfg = dev_cluster
+        job = parse_job('''
+job "write" {
+  datacenters = ["dc1"]
+  type = "batch"
+  group "g" {
+    count = 2
+    task "t" {
+      driver = "raw_exec"
+      config {
+        command = "/bin/sh"
+        args = ["-c", "echo done > ${NOMAD_TASK_DIR}/out.txt"]
+      }
+      resources { cpu = 50 memory = 32 disk = 300 }
+    }
+  }
+}''')
+        srv.job_register(job)
+        assert wait_for(lambda: (
+            (allocs := srv.state.allocs_by_job("write"))
+            and all(a.ClientStatus == "complete" for a in allocs)))
+        allocs = srv.state.allocs_by_job("write")
+        for a in allocs:
+            out = os.path.join(cfg.alloc_dir, a.ID, "t", "local", "out.txt")
+            assert os.path.exists(out)
+            assert a.TaskStates["t"].State == "dead"
+            assert a.TaskStates["t"].successful()
+        assert srv.state.job_by_id("write").Status == "dead"
+
+    def test_service_task_restarts_on_failure(self, dev_cluster):
+        srv, client, cfg = dev_cluster
+        job = parse_job('''
+job "flaky" {
+  datacenters = ["dc1"]
+  type = "batch"
+  group "g" {
+    restart { attempts = 1 interval = "5m" delay = "1s" mode = "fail" }
+    task "t" {
+      driver = "raw_exec"
+      config { command = "/bin/false" }
+      resources { cpu = 50 memory = 32 disk = 300 }
+    }
+  }
+}''')
+        srv.job_register(job)
+        assert wait_for(lambda: (
+            (allocs := srv.state.allocs_by_job("flaky"))
+            and any(a.ClientStatus == "failed" for a in allocs)), timeout=40)
+        alloc = srv.state.allocs_by_job("flaky")[0]
+        events = [e.Type for e in alloc.TaskStates["t"].Events]
+        assert "Restarting" in events  # one restart attempt
+        assert "Terminated" in events
+
+    def test_stop_kills_running_task(self, dev_cluster):
+        srv, client, cfg = dev_cluster
+        job = parse_job('''
+job "sleeper" {
+  datacenters = ["dc1"]
+  type = "service"
+  group "g" {
+    task "t" {
+      driver = "raw_exec"
+      config { command = "/bin/sleep" args = ["300"] }
+      resources { cpu = 50 memory = 32 disk = 300 }
+    }
+  }
+}''')
+        srv.job_register(job)
+        assert wait_for(lambda: any(
+            a.ClientStatus == "running"
+            for a in srv.state.allocs_by_job("sleeper")))
+        srv.job_deregister("sleeper")
+        assert wait_for(lambda: all(
+            a.ClientStatus in ("complete", "failed")
+            for a in srv.state.allocs_by_job("sleeper")), timeout=30)

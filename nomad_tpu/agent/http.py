@@ -226,6 +226,22 @@ def route(agent, method: str, path: str, query, get_body):
             return ({"EvalID": eval_id, "Index": index}, index)
         raise CodedError(405, "method not allowed")
 
+    m = re.match(r"^/v1/job/([^/]+)/plan$", path)
+    if m:
+        need_server()
+        _require_write(method)
+        payload = get_body()
+        job = from_dict(Job, payload.get("Job"))
+        if job is None:
+            raise CodedError(400, "Job must be specified")
+        path_id = urllib.parse.unquote(m.group(1))
+        if job.ID != path_id:
+            raise CodedError(400, "Job ID does not match")
+        want_diff = bool(payload.get("Diff"))
+        resp = server.job_plan(job, want_diff=want_diff)
+        index = resp.JobModifyIndex
+        return (to_dict(resp), index)
+
     m = re.match(r"^/v1/job/([^/]+)/allocations$", path)
     if m:
         need_server()

@@ -139,6 +139,20 @@ class Jobs:
         out, meta = self.c.delete(f"/v1/job/{urllib.parse.quote(job_id)}")
         return out.get("EvalID", ""), meta
 
+    def plan(self, job: Job, diff: bool = True
+             ) -> Tuple["JobPlanResponse", QueryMeta]:
+        """Dry-run scheduling (reference: api/jobs.go:144-160 Jobs.Plan)."""
+        from nomad_tpu.structs import JobPlanResponse
+        from nomad_tpu.structs.diff import JobDiff
+
+        body = {"Job": to_dict(job), "Diff": diff}
+        out, meta = self.c.put(
+            f"/v1/job/{urllib.parse.quote(job.ID)}/plan", body)
+        resp = from_dict(JobPlanResponse, out)
+        if resp.Diff is not None:
+            resp.Diff = from_dict(JobDiff, resp.Diff)
+        return resp, meta
+
     def allocations(self, job_id: str, q: Optional[QueryOptions] = None):
         return self.c.get(f"/v1/job/{urllib.parse.quote(job_id)}/allocations", q)
 

@@ -222,7 +222,67 @@ def _monitor_eval(client: Client, eval_id: str) -> int:
     return 1
 
 
+_DIFF_MARK = {"Added": "+", "Deleted": "-", "Edited": "+/-", "None": ""}
+
+
+def _mark(t: str) -> str:
+    m = _DIFF_MARK.get(t, "")
+    return f"{m} " if m else ""
+
+
+def _render_fields(fields, indent: int, out) -> None:
+    pad = " " * indent
+    for f in fields:
+        if f.Type == "None":
+            continue
+        note = f" ({', '.join(f.Annotations)})" if f.Annotations else ""
+        if f.Type == "Added":
+            out.append(f'{pad}+ {f.Name}: "{f.New}"{note}')
+        elif f.Type == "Deleted":
+            out.append(f'{pad}- {f.Name}: "{f.Old}"{note}')
+        else:
+            out.append(f'{pad}+/- {f.Name}: "{f.Old}" => "{f.New}"{note}')
+
+
+def _render_objects(objects, indent: int, out) -> None:
+    pad = " " * indent
+    for o in objects:
+        if o.Type == "None":
+            continue
+        out.append(f"{pad}{_mark(o.Type)}{o.Name} {{")
+        _render_fields(o.Fields, indent + 2, out)
+        _render_objects(o.Objects, indent + 2, out)
+        out.append(f"{pad}}}")
+
+
+def format_job_diff(diff) -> str:
+    """Render a JobDiff the way `nomad plan` does (reference:
+    command/plan.go formatJobDiff)."""
+    out: list = []
+    out.append(f'{_mark(diff.Type)}Job: "{diff.ID}"')
+    _render_fields(diff.Fields, 2, out)
+    _render_objects(diff.Objects, 2, out)
+    for tg in diff.TaskGroups:
+        if tg.Type == "None" and not tg.Updates:
+            continue
+        counts = ", ".join(f"{v} {k}" for k, v in sorted(tg.Updates.items()))
+        suffix = f" ({counts})" if counts else ""
+        out.append(f'{_mark(tg.Type)}Task Group: "{tg.Name}"{suffix}')
+        _render_fields(tg.Fields, 2, out)
+        _render_objects(tg.Objects, 2, out)
+        for t in tg.Tasks:
+            if t.Type == "None":
+                continue
+            note = f" ({', '.join(t.Annotations)})" if t.Annotations else ""
+            out.append(f'  {_mark(t.Type)}Task: "{t.Name}"{note}')
+            _render_fields(t.Fields, 4, out)
+            _render_objects(t.Objects, 4, out)
+    return "\n".join(out)
+
+
 def cmd_plan(args) -> int:
+    """Dry-run a job: show the diff + what the scheduler would do
+    (reference: command/plan.go)."""
     from nomad_tpu.jobspec import parse_job_file
 
     job = parse_job_file(args.jobfile)
@@ -234,18 +294,36 @@ def cmd_plan(args) -> int:
         return 255
     client = _client(args)
     try:
-        existing, _ = client.jobs.info(job.ID)
-        print(f'+/- Job: "{job.ID}" (update)')
-        print(f"    Job Modify Index: {existing.JobModifyIndex}")
-        print(f'    Run with -check-index {existing.JobModifyIndex} to '
-              "enforce this state")
+        resp, _ = client.jobs.plan(job, diff=True)
     except APIError as e:
-        if e.code == 404:
-            print(f'+ Job: "{job.ID}" (new)')
-            print("    Job Modify Index: 0")
-        else:
-            raise
-    return 0
+        print(f"Error during plan: {e}", file=sys.stderr)
+        return 255
+
+    if resp.Diff is not None:
+        print(format_job_diff(resp.Diff))
+        print()
+
+    print("Scheduler dry-run:")
+    if not resp.FailedTGAllocs:
+        print("- All tasks successfully allocated.")
+    else:
+        for tg, metric in sorted(resp.FailedTGAllocs.items()):
+            print(f'- WARNING: Failed to place all allocations for task '
+                  f'group "{tg}".')
+            if getattr(metric, "DimensionExhausted", None):
+                for dim, count in sorted(metric.DimensionExhausted.items()):
+                    print(f'    * Resources exhausted on {count} nodes: {dim}')
+    if resp.NextPeriodicLaunch:
+        import datetime
+
+        when = datetime.datetime.fromtimestamp(resp.NextPeriodicLaunch)
+        print(f"- If submitted now, next periodic launch would be at {when}.")
+    print()
+    print(f"Job Modify Index: {resp.JobModifyIndex}")
+    print(f"To submit the job with version verification run:")
+    print(f"\n  nomad run -check-index {resp.JobModifyIndex} {args.jobfile}")
+    changes = resp.Diff is not None and resp.Diff.Type != "None"
+    return 1 if changes else 0
 
 
 def cmd_validate(args) -> int:

@@ -20,6 +20,7 @@ from nomad_tpu.structs import (
     Allocation,
     Evaluation,
     Job,
+    JobPlanResponse,
     Node,
     PeriodicLaunch,
     generate_uuid,
@@ -338,6 +339,100 @@ class Server:
         )
         self.raft.apply(MessageType.EvalUpdate, {"Evals": [ev]})
         return ev.ID, index, index
+
+    def job_plan(self, job: Job, want_diff: bool = True):
+        """Dry-run scheduling: what would registering this job do?
+        (reference: job_endpoint.go:422-526 Job.Plan)
+
+        Runs the real scheduler against a scratch copy of current state with
+        the submitted job inserted, a Harness planner capturing the plan, and
+        returns the annotated structural diff plus per-TG failures. No Raft
+        writes happen. The scratch build is O(cluster) per call; a
+        copy-on-write store fork would let plan reuse the snapshot directly.
+        """
+        from nomad_tpu.scheduler.annotate import annotate
+        from nomad_tpu.scheduler.testing import Harness
+        from nomad_tpu.structs.diff import job_diff
+
+        job.init_fields()
+        if not job.Region:
+            job.Region = self.config.region
+        errs = job.validate()
+        if errs:
+            raise ValueError("; ".join(errs))
+
+        snap = self.state.snapshot()
+        old_job = snap.job_by_id(job.ID)
+        index = old_job.JobModifyIndex if old_job is not None else 0
+        updated_index = index + 1 if old_job is not None else 1
+
+        # Periodic parents are never evaluated by register — the dispatcher
+        # launches children. Report the diff + next launch only.
+        if job.is_periodic():
+            diff = None
+            if want_diff:
+                from nomad_tpu.structs.diff import job_diff as _job_diff
+
+                diff = _job_diff(old_job, job, contextual=True)
+            next_launch = (job.Periodic.next(time.time())
+                           if job.Periodic.Enabled else 0.0)
+            return JobPlanResponse(Diff=diff, JobModifyIndex=index,
+                                   NextPeriodicLaunch=next_launch)
+
+        # Scratch world: current nodes/allocs/evals + the proposed job.
+        harness = Harness()
+        scratch = harness.state
+        # Copies only: store upserts stamp indexes/status on the objects they
+        # are handed, and live snapshot reads return the stored references.
+        for node in snap.nodes():
+            scratch.upsert_node(harness._next_index(), node.copy())
+        for other in snap.jobs():
+            if other.ID != job.ID:
+                scratch.upsert_job(harness._next_index(), other.copy())
+        allocs = [a.copy() for a in snap.allocs()]
+        if allocs:
+            scratch.upsert_allocs(harness._next_index(), allocs)
+        # The upsert stamps JobModifyIndex from the index passed; make the
+        # scratch indexes land at updated_index so the eval's
+        # JobModifyIndex matches the planned job's.
+        harness.next_index = max(harness.next_index, updated_index)
+        scratch.upsert_job(harness._next_index(), job.copy())
+
+        ev = Evaluation(
+            ID=generate_uuid(),
+            Priority=job.Priority,
+            Type=job.Type,
+            TriggeredBy=EvalTriggerJobRegister,
+            JobID=job.ID,
+            JobModifyIndex=updated_index,
+            Status=EvalStatusPending,
+            AnnotatePlan=True,
+        )
+        harness.process(ev.Type, ev)
+
+        if len(harness.plans) != 1:
+            raise RuntimeError(
+                f"scheduler resulted in {len(harness.plans)} plans, want 1")
+        annotations = harness.plans[0].Annotations
+
+        diff = None
+        if want_diff:
+            diff = job_diff(old_job, job, contextual=True)
+            annotate(diff, annotations)
+
+        updated_eval = harness.evals[0] if harness.evals else ev
+        next_launch = 0.0
+        if job.is_periodic() and job.Periodic.Enabled:
+            next_launch = job.Periodic.next(time.time())
+
+        return JobPlanResponse(
+            Diff=diff,
+            Annotations=annotations,
+            FailedTGAllocs=updated_eval.FailedTGAllocs,
+            NextPeriodicLaunch=next_launch,
+            JobModifyIndex=index,
+            CreatedEvals=list(harness.creates),
+        )
 
     def job_deregister(self, job_id: str) -> Tuple[str, int]:
         """(reference: job_endpoint.go:155-207)"""

@@ -2,7 +2,7 @@
 
 from .structs import (  # explicit re-exports for the commonly used names
     Allocation, AllocListStub, AllocMetric, Constraint, DesiredUpdates,
-    Evaluation, Job, JobListStub, LogConfig, NetworkResource, Node,
+    Evaluation, Job, JobListStub, JobPlanResponse, LogConfig, NetworkResource, Node,
     NodeListStub, PeriodicConfig, PeriodicLaunch, Plan, PlanAnnotations,
     PlanResult, Port, Resources, RestartPolicy, Service, ServiceCheck, Task,
     TaskArtifact, TaskEvent, TaskGroup, TaskState, UpdateStrategy,

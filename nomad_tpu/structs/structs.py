@@ -1136,6 +1136,19 @@ class PlanAnnotations:
 
 
 @dataclass
+class JobPlanResponse:
+    """Dry-run plan reply (reference: structs.go JobPlanResponse,
+    job_endpoint.go:422-526)."""
+
+    Diff: Optional[Any] = None  # structs.diff.JobDiff
+    Annotations: Optional["PlanAnnotations"] = None
+    FailedTGAllocs: Dict[str, "AllocMetric"] = field(default_factory=dict)
+    NextPeriodicLaunch: float = 0.0
+    JobModifyIndex: int = 0
+    CreatedEvals: List["Evaluation"] = field(default_factory=list)
+
+
+@dataclass
 class PeriodicLaunch:
     """Last launch time of a periodic job (reference: structs.go:1270-1278)."""
 

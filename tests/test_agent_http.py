@@ -123,6 +123,19 @@ class TestHTTPAPI:
         assert result["index"] > meta.last_index
         api.jobs.deregister("blocker")
 
+    def test_job_plan_over_http(self, dev_agent):
+        agent, api = dev_agent
+        job = parse_job(BATCH_JOB.replace("httpjob", "planjob"))
+        job.init_fields()
+        resp, _ = api.jobs.plan(job, diff=True)
+        assert resp.Diff is not None and resp.Diff.Type == "Added"
+        assert resp.JobModifyIndex == 0
+        # Dry run must not register the job.
+        with pytest.raises(APIError):
+            api.jobs.info("planjob")
+        updates = resp.Annotations.DesiredTGUpdates["g"]
+        assert updates.Place == 1
+
     def test_error_codes(self, dev_agent):
         agent, api = dev_agent
         with pytest.raises(APIError) as exc:

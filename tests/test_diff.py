@@ -1,0 +1,321 @@
+"""Job diff + plan annotations + Job.Plan dry-run (reference:
+nomad/structs/diff_test.go, scheduler/annotate_test.go,
+nomad/job_endpoint.go:422 Job.Plan)."""
+
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.scheduler.annotate import (
+    AnnotationForcesCreate,
+    AnnotationForcesDestroy,
+    AnnotationForcesDestructiveUpdate,
+    AnnotationForcesInplaceUpdate,
+    UpdateTypeCreate,
+    annotate,
+)
+from nomad_tpu.structs import Constraint, DesiredUpdates, PlanAnnotations
+from nomad_tpu.structs.diff import (
+    DiffTypeAdded,
+    DiffTypeDeleted,
+    DiffTypeEdited,
+    DiffTypeNone,
+    job_diff,
+    task_diff,
+    task_group_diff,
+)
+
+
+def _field(diff, name):
+    return next((f for f in diff.Fields if f.Name == name), None)
+
+
+class TestJobDiff:
+    def test_identical_jobs_none(self):
+        j = mock.job()
+        assert job_diff(j, j.copy()).Type == DiffTypeNone
+
+    def test_added_and_deleted(self):
+        j = mock.job()
+        added = job_diff(None, j)
+        assert added.Type == DiffTypeAdded
+        assert added.ID == j.ID
+        assert _field(added, "Priority").New == str(j.Priority)
+
+        deleted = job_diff(j, None)
+        assert deleted.Type == DiffTypeDeleted
+        assert _field(deleted, "Priority").Old == str(j.Priority)
+
+    def test_mismatched_ids_raise(self):
+        a, b = mock.job(), mock.job()
+        with pytest.raises(ValueError):
+            job_diff(a, b)
+
+    def test_primitive_field_edit(self):
+        old = mock.job()
+        new = old.copy()
+        new.Priority = old.Priority + 10
+        d = job_diff(old, new)
+        assert d.Type == DiffTypeEdited
+        f = _field(d, "Priority")
+        assert f.Type == DiffTypeEdited
+        assert (f.Old, f.New) == (str(old.Priority), str(new.Priority))
+
+    def test_meta_map_diff(self):
+        old = mock.job()
+        new = old.copy()
+        new.Meta["team"] = "team-x"
+        d = job_diff(old, new)
+        f = _field(d, "Meta[team]")
+        assert f.Type == DiffTypeAdded and f.New == "team-x"
+
+    def test_datacenter_list_diff(self):
+        old = mock.job()
+        new = old.copy()
+        new.Datacenters = list(old.Datacenters) + ["dc2"]
+        d = job_diff(old, new)
+        idx = len(old.Datacenters)
+        f = _field(d, f"Datacenters[{idx}]")
+        assert f is not None and f.Type == DiffTypeAdded
+
+    def test_constraint_added(self):
+        old = mock.job()
+        new = old.copy()
+        new.Constraints.append(
+            Constraint(LTarget="${attr.cpu.arch}", RTarget="amd64",
+                       Operand="="))
+        d = job_diff(old, new)
+        cons = [o for o in d.Objects if o.Name == "Constraint"]
+        assert any(o.Type == DiffTypeAdded for o in cons)
+
+    def test_filtered_bookkeeping_fields_ignored(self):
+        old = mock.job()
+        new = old.copy()
+        new.Status = "dead"
+        new.ModifyIndex = 999
+        new.JobModifyIndex = 999
+        assert job_diff(old, new).Type == DiffTypeNone
+
+    def test_contextual_includes_unchanged(self):
+        old = mock.job()
+        new = old.copy()
+        new.Priority += 1
+        d = job_diff(old, new, contextual=True)
+        f = _field(d, "Type")
+        assert f is not None and f.Type == DiffTypeNone
+
+
+class TestTaskGroupDiff:
+    def test_count_change(self):
+        old = mock.job().TaskGroups[0]
+        new = old.copy()
+        new.Count = old.Count + 3
+        d = task_group_diff(old, new)
+        assert d.Type == DiffTypeEdited
+        assert _field(d, "Count").Type == DiffTypeEdited
+
+    def test_task_added_bubbles_up(self):
+        old = mock.job().TaskGroups[0]
+        new = old.copy()
+        extra = new.Tasks[0].copy()
+        extra.Name = "sidecar"
+        new.Tasks.append(extra)
+        d = task_group_diff(old, new)
+        assert d.Type == DiffTypeEdited
+        added = [t for t in d.Tasks if t.Type == DiffTypeAdded]
+        assert [t.Name for t in added] == ["sidecar"]
+
+
+class TestTaskDiff:
+    def test_resources_diff(self):
+        old = mock.job().TaskGroups[0].Tasks[0]
+        new = old.copy()
+        new.Resources.CPU += 100
+        d = task_diff(old, new)
+        assert d.Type == DiffTypeEdited
+        res = next(o for o in d.Objects if o.Name == "Resources")
+        cpu = next(f for f in res.Fields if f.Name == "CPU")
+        assert cpu.Type == DiffTypeEdited
+
+    def test_service_check_diff(self):
+        old = mock.job().TaskGroups[0].Tasks[0]
+        if not old.Services or not old.Services[0].Checks:
+            pytest.skip("mock task has no service checks")
+        new = old.copy()
+        new.Services[0].Checks[0].Interval += 5_000_000_000
+        d = task_diff(old, new)
+        svc = next(o for o in d.Objects if o.Name == "Service")
+        chk = next(o for o in svc.Objects if o.Name == "Check")
+        assert chk.Type == DiffTypeEdited
+
+    def test_port_only_change_visible_noncontextual(self):
+        from nomad_tpu.structs import Port
+
+        old = mock.job().TaskGroups[0].Tasks[0]
+        new = old.copy()
+        new.Resources.Networks[0].ReservedPorts.append(Port("db", 5432))
+        d = task_diff(old, new)  # contextual=False default
+        assert d.Type == DiffTypeEdited
+        res = next(o for o in d.Objects if o.Name == "Resources")
+        net = next(o for o in res.Objects if o.Name == "Network")
+        port = next(o for o in net.Objects if o.Name == "Static Port")
+        assert port.Type == DiffTypeAdded
+
+    def test_duplicate_key_artifacts_not_collapsed(self):
+        from nomad_tpu.structs import TaskArtifact
+
+        old = mock.job().TaskGroups[0].Tasks[0]
+        old.Artifacts = [
+            TaskArtifact(GetterSource="http://x/a.tgz", RelativeDest="a/"),
+            TaskArtifact(GetterSource="http://x/a.tgz", RelativeDest="b/"),
+        ]
+        new = old.copy()
+        del new.Artifacts[0]
+        d = task_diff(old, new)
+        assert d.Type == DiffTypeEdited
+        deleted = [o for o in d.Objects
+                   if o.Name == "Artifact" and o.Type == DiffTypeDeleted]
+        assert len(deleted) == 1
+
+    def test_env_edit(self):
+        old = mock.job().TaskGroups[0].Tasks[0]
+        new = old.copy()
+        new.Env["NEW_VAR"] = "1"
+        d = task_diff(old, new)
+        f = _field(d, "Env[NEW_VAR]")
+        assert f.Type == DiffTypeAdded
+
+
+class TestAnnotate:
+    def _diff(self, mutate):
+        old = mock.job()
+        new = old.copy()
+        mutate(new)
+        return job_diff(old, new, contextual=True)
+
+    def test_count_up_forces_create(self):
+        d = self._diff(lambda j: setattr(j.TaskGroups[0], "Count",
+                                         j.TaskGroups[0].Count + 5))
+        annotate(d, None)
+        count = _field(d.TaskGroups[0], "Count")
+        assert AnnotationForcesCreate in count.Annotations
+
+    def test_count_down_forces_destroy(self):
+        old = mock.job()
+        old.TaskGroups[0].Count = 5
+        new = old.copy()
+        new.TaskGroups[0].Count = 2
+        d = job_diff(old, new, contextual=True)
+        annotate(d, None)
+        count = _field(d.TaskGroups[0], "Count")
+        assert AnnotationForcesDestroy in count.Annotations
+
+    def test_desired_updates_copied(self):
+        d = self._diff(lambda j: setattr(j.TaskGroups[0], "Count",
+                                         j.TaskGroups[0].Count + 1))
+        ann = PlanAnnotations(DesiredTGUpdates={
+            d.TaskGroups[0].Name: DesiredUpdates(Place=1, Ignore=2)})
+        annotate(d, ann)
+        assert d.TaskGroups[0].Updates[UpdateTypeCreate] == 1
+        assert d.TaskGroups[0].Updates["ignore"] == 2
+
+    def test_driver_change_is_destructive(self):
+        d = self._diff(lambda j: setattr(j.TaskGroups[0].Tasks[0],
+                                         "Driver", "other"))
+        annotate(d, None)
+        task = d.TaskGroups[0].Tasks[0]
+        assert AnnotationForcesDestructiveUpdate in task.Annotations
+
+    def test_kill_timeout_change_is_inplace(self):
+        d = self._diff(lambda j: setattr(j.TaskGroups[0].Tasks[0],
+                                         "KillTimeout", 99_000_000_000))
+        annotate(d, None)
+        task = d.TaskGroups[0].Tasks[0]
+        assert AnnotationForcesInplaceUpdate in task.Annotations
+
+    def test_task_meta_change_is_destructive(self):
+        # Must match the reconciler: tasks_updated treats Meta edits as
+        # destructive (scheduler/util.py).
+        d = self._diff(
+            lambda j: j.TaskGroups[0].Tasks[0].Meta.update(x="1"))
+        annotate(d, None)
+        task = d.TaskGroups[0].Tasks[0]
+        assert AnnotationForcesDestructiveUpdate in task.Annotations
+
+
+class TestJobPlanEndpoint:
+    """Server-side dry run (reference: job_endpoint.go:422-526)."""
+
+    @pytest.fixture()
+    def server(self):
+        from nomad_tpu.server.server import Server, ServerConfig
+
+        srv = Server(ServerConfig(num_schedulers=1))
+        yield srv
+        srv.shutdown()
+
+    def test_plan_new_job(self, server):
+        for _ in range(3):
+            node = mock.node()
+            server.node_register(node)
+            server.node_update_status(node.ID, "ready")
+        job = mock.job()
+        resp = server.job_plan(job, want_diff=True)
+        assert resp.Diff.Type == DiffTypeAdded
+        assert resp.JobModifyIndex == 0
+        # No state was mutated by the dry run.
+        assert server.state.job_by_id(job.ID) is None
+        assert server.state.allocs_by_job(job.ID) == []
+        ann = resp.Annotations.DesiredTGUpdates[job.TaskGroups[0].Name]
+        assert ann.Place == job.TaskGroups[0].Count
+
+    def test_plan_update_reports_diff_and_index(self, server):
+        for _ in range(3):
+            node = mock.node()
+            server.node_register(node)
+            server.node_update_status(node.ID, "ready")
+        job = mock.job()
+        server.job_register(job.copy())
+        existing = server.state.job_by_id(job.ID)
+
+        updated = job.copy()
+        updated.TaskGroups[0].Count += 2
+        resp = server.job_plan(updated, want_diff=True)
+        assert resp.JobModifyIndex == existing.JobModifyIndex
+        assert resp.Diff.Type == DiffTypeEdited
+        count = _field(resp.Diff.TaskGroups[0], "Count")
+        assert AnnotationForcesCreate in count.Annotations
+
+    def test_plan_does_not_corrupt_live_state(self, server):
+        # Dry-run upserts into the scratch store must not restamp indexes
+        # on live objects shared via snapshot reads.
+        node = mock.node()
+        server.node_register(node)
+        server.node_update_status(node.ID, "ready")
+        other = mock.job()
+        server.job_register(other.copy())
+        live = server.state.job_by_id(other.ID)
+        jmi_before = live.JobModifyIndex
+        node_mi_before = server.state.node_by_id(node.ID).ModifyIndex
+
+        server.job_plan(mock.job(), want_diff=False)
+
+        assert server.state.job_by_id(other.ID).JobModifyIndex == jmi_before
+        assert server.state.node_by_id(node.ID).ModifyIndex == node_mi_before
+
+    def test_plan_periodic_skips_scheduler(self, server):
+        # Register never evaluates periodic parents; plan must not claim
+        # placements that submission would not perform.
+        job = mock.periodic_job()
+        resp = server.job_plan(job, want_diff=True)
+        assert resp.Annotations is None
+        assert not resp.FailedTGAllocs
+        assert resp.Diff.Type == DiffTypeAdded
+        assert resp.NextPeriodicLaunch > 0
+
+    def test_plan_no_nodes_reports_failures(self, server):
+        job = mock.job()
+        resp = server.job_plan(job, want_diff=False)
+        assert resp.Diff is None
+        assert resp.FailedTGAllocs
+        tg_name = job.TaskGroups[0].Name
+        assert tg_name in resp.FailedTGAllocs

@@ -11,6 +11,17 @@ from nomad_tpu.structs import Node, Task
 from .base import Driver, DriverHandle, ExecContext, WaitResult
 
 
+def _seconds(value: Any) -> float:
+    """Accept plain seconds or HCL duration strings ("2s", "500ms") — the
+    jobspec hands driver config through verbatim (reference: the mock
+    driver's time.ParseDuration of run_for)."""
+    if isinstance(value, (int, float)):
+        return float(value)
+    from nomad_tpu.jobspec.parse import parse_duration
+
+    return parse_duration(value) / 1e9
+
+
 class MockHandle(DriverHandle):
     def __init__(self, run_for: float, exit_code: int):
         self._exit_code = exit_code
@@ -47,7 +58,7 @@ class MockDriver(Driver):
         cfg = task.Config
         if cfg.get("start_error"):
             raise RuntimeError(str(cfg["start_error"]))
-        return MockHandle(float(cfg.get("run_for", 0.1)),
+        return MockHandle(_seconds(cfg.get("run_for", 0.1)),
                           int(cfg.get("exit_code", 0)))
 
     def open(self, ctx: ExecContext, handle_id: str) -> DriverHandle:

@@ -1,0 +1,31 @@
+"""Raft consensus: the consistency plane of the distributed backend
+(reference: hashicorp/raft as wired in nomad/server.go:608-712 setupRaft,
+nomad/fsm.go, nomad/raft_rpc.go).
+
+The reference replicates every state mutation through a Raft log into the
+FSM; leadership transitions drive the server's leader-singleton services
+(reference: nomad/leader.go:24-170). This package is an original Raft
+implementation with the same observable behavior: leader election with
+randomized timeouts, log replication with consistency checks, commit
+advancement over the majority match index, FSM snapshots with log
+truncation, InstallSnapshot for lagging followers, and single-server
+membership changes.
+
+Layering:
+  log.py       — LogEntry + LogStore (in-memory, file-backed, C++ mmap)
+  transport.py — Transport protocol; in-memory loopback + TCP (via rpc plane)
+  node.py      — RaftNode state machine (follower/candidate/leader)
+  backend.py   — RaftBackend: the `raft.apply(msg_type, payload)` seam the
+                 Server uses (drop-in for fsm.DevRaft)
+"""
+
+from .log import LogEntry, InMemLogStore, FileLogStore, EntryType
+from .node import RaftNode, RaftConfig, NotLeaderError
+from .transport import InMemTransport
+from .backend import RaftBackend
+
+__all__ = [
+    "LogEntry", "InMemLogStore", "FileLogStore", "EntryType",
+    "RaftNode", "RaftConfig", "NotLeaderError",
+    "InMemTransport", "RaftBackend",
+]

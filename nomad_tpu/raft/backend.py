@@ -1,0 +1,90 @@
+"""RaftBackend: adapts a RaftNode to the `raft.apply(msg_type, payload)`
+seam the Server writes through (reference: Server.raftApply nomad/rpc.go:262
+— msgpack-encode a typed message, feed it through raft, return the index).
+
+Drop-in replacement for fsm.DevRaft: same apply()/last_index surface, plus
+leadership notification and barrier/snapshot passthrough.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional
+
+import msgpack
+
+from nomad_tpu.structs import to_dict
+
+from .log import EntryType, InMemLogStore
+from .node import NotLeaderError, RaftConfig, RaftNode
+
+
+class RaftBackend:
+    """Owns a RaftNode wired to an FSM. The Server calls apply(); followers
+    receive the same entries through replication and apply them to their own
+    FSM/state store replica."""
+
+    def __init__(self, node_id: str, fsm, peers: List[str],
+                 transport, log_store=None,
+                 config: Optional[RaftConfig] = None,
+                 on_leader_change: Optional[Callable[[bool], None]] = None):
+        self.fsm = fsm
+        self.node = RaftNode(
+            node_id=node_id,
+            peers=peers,
+            log_store=log_store or InMemLogStore(),
+            transport=transport,
+            apply_fn=self._fsm_apply,
+            snapshot_fn=self._fsm_snapshot,
+            restore_fn=self._fsm_restore,
+            config=config,
+            on_leader_change=on_leader_change,
+        )
+
+    def start(self) -> None:
+        self.node.start()
+
+    def shutdown(self) -> None:
+        self.node.shutdown()
+
+    # ------------------------------------------------------------- fsm glue
+    def _fsm_apply(self, index: int, etype: int, data: bytes) -> Any:
+        """(reference: nomadFSM.Apply dispatch by MessageType, fsm.go:99-144)"""
+        from nomad_tpu.server.fsm import MessageType  # avoid import cycle
+        msg_type, payload = msgpack.unpackb(data, raw=False)
+        return self.fsm.apply(index, MessageType(msg_type), payload)
+
+    def _fsm_snapshot(self) -> bytes:
+        return msgpack.packb(self.fsm.snapshot(), use_bin_type=True)
+
+    def _fsm_restore(self, blob: bytes) -> None:
+        self.fsm.restore(msgpack.unpackb(blob, raw=False))
+
+    # ----------------------------------------------------------- apply seam
+    def apply(self, msg_type, payload: Dict[str, Any]) -> int:
+        """Replicate + apply one mutation; returns its raft index. Raises
+        NotLeaderError on non-leaders so RPC endpoints can forward
+        (reference: rpc.go:177-242 forward + structs.ErrNoLeader)."""
+        data = msgpack.packb((int(msg_type), to_dict(payload)),
+                             use_bin_type=True)
+        index, result = self.node.apply_command(data)
+        if isinstance(result, Exception):
+            raise result
+        return index
+
+    @property
+    def last_index(self) -> int:
+        return self.node.last_index
+
+    # ------------------------------------------------------------- exposure
+    def is_leader(self) -> bool:
+        return self.node.is_leader()
+
+    @property
+    def leader_id(self) -> Optional[str]:
+        return self.node.leader_id
+
+    def barrier(self, timeout: Optional[float] = None) -> int:
+        return self.node.barrier(timeout)
+
+    def stats(self) -> Dict[str, Any]:
+        return self.node.stats()

@@ -1,0 +1,214 @@
+"""Raft log + stable storage (reference: the raft-boltdb LogStore/StableStore
+pair wired in nomad/server.go:640-663, and the two retained FSM snapshots,
+server.go:50 snapshotsRetained).
+
+Three backends behind one interface:
+  InMemLogStore  — tests and dev mode
+  FileLogStore   — append-only msgpack segment file + snapshot files
+  (native)       — C++ mmap segment log, see nomad_tpu/native/loglib
+"""
+
+from __future__ import annotations
+
+import enum
+import os
+import struct
+import threading
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+import msgpack
+
+
+class EntryType(enum.IntEnum):
+    Command = 0
+    Noop = 1        # barrier entry appended on leadership (leader.go:60)
+    Config = 2      # membership change (single-server-at-a-time)
+
+
+@dataclass
+class LogEntry:
+    Index: int
+    Term: int
+    Type: int = EntryType.Command
+    Data: bytes = b""
+
+    def pack(self) -> bytes:
+        return msgpack.packb((self.Index, self.Term, self.Type, self.Data),
+                             use_bin_type=True)
+
+    @staticmethod
+    def unpack(raw: bytes) -> "LogEntry":
+        i, t, ty, d = msgpack.unpackb(raw, raw=False)
+        return LogEntry(Index=i, Term=t, Type=ty, Data=d)
+
+
+class InMemLogStore:
+    """Log + stable store kept in memory (reference: raft.NewInmemStore used
+    by DevMode, nomad/server.go:612-616)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._entries: Dict[int, LogEntry] = {}
+        self._first = 0
+        self._last = 0
+        self._stable: Dict[str, Any] = {}
+        self._snapshot: Optional[Tuple[int, int, bytes]] = None
+
+    # ------------------------------------------------------------- log part
+    def first_index(self) -> int:
+        with self._lock:
+            return self._first
+
+    def last_index(self) -> int:
+        with self._lock:
+            return self._last
+
+    def get_entry(self, index: int) -> Optional[LogEntry]:
+        with self._lock:
+            return self._entries.get(index)
+
+    def get_range(self, lo: int, hi: int) -> List[LogEntry]:
+        """Entries with lo <= index <= hi, in order; missing ones skipped."""
+        with self._lock:
+            return [self._entries[i] for i in range(lo, hi + 1)
+                    if i in self._entries]
+
+    def store_entries(self, entries: List[LogEntry]) -> None:
+        with self._lock:
+            for e in entries:
+                self._entries[e.Index] = e
+                if self._first == 0 or e.Index < self._first:
+                    self._first = e.Index
+                if e.Index > self._last:
+                    self._last = e.Index
+
+    def delete_range(self, lo: int, hi: int) -> None:
+        with self._lock:
+            for i in range(lo, hi + 1):
+                self._entries.pop(i, None)
+            if lo <= self._first:
+                self._first = hi + 1 if self._entries else 0
+            if hi >= self._last:
+                self._last = lo - 1 if self._entries else 0
+            if not self._entries:
+                self._first = self._last = 0
+
+    # ---------------------------------------------------------- stable part
+    def set_stable(self, key: str, value: Any) -> None:
+        with self._lock:
+            self._stable[key] = value
+
+    def get_stable(self, key: str, default: Any = None) -> Any:
+        with self._lock:
+            return self._stable.get(key, default)
+
+    # -------------------------------------------------------- snapshot part
+    def store_snapshot(self, index: int, term: int, data: bytes) -> None:
+        with self._lock:
+            self._snapshot = (index, term, data)
+
+    def latest_snapshot(self) -> Optional[Tuple[int, int, bytes]]:
+        with self._lock:
+            return self._snapshot
+
+    def close(self) -> None:
+        pass
+
+
+_FRAME = struct.Struct("<I")  # little-endian u32 length prefix
+
+
+class FileLogStore(InMemLogStore):
+    """Durable log store: an append-only length-prefixed msgpack segment file
+    plus side files for stable kv and snapshots. The in-memory index is the
+    read path; the file is the write-ahead durability path (reference role:
+    raft-boltdb, nomad/server.go:640-650).
+
+    Compaction happens at snapshot time: delete_range(prefix) rewrites the
+    segment with only the retained suffix.
+    """
+
+    def __init__(self, directory: str):
+        super().__init__()
+        self.dir = directory
+        os.makedirs(directory, exist_ok=True)
+        self._log_path = os.path.join(directory, "raft.log")
+        self._stable_path = os.path.join(directory, "stable.mp")
+        self._snap_path = os.path.join(directory, "snapshot.mp")
+        self._replay()
+        self._fh = open(self._log_path, "ab")
+
+    # ----------------------------------------------------------- durability
+    def _replay(self) -> None:
+        if os.path.exists(self._stable_path):
+            with open(self._stable_path, "rb") as fh:
+                self._stable = msgpack.unpackb(fh.read(), raw=False)
+        if os.path.exists(self._snap_path):
+            with open(self._snap_path, "rb") as fh:
+                idx, term, data = msgpack.unpackb(fh.read(), raw=False)
+                self._snapshot = (idx, term, data)
+        if not os.path.exists(self._log_path):
+            return
+        with open(self._log_path, "rb") as fh:
+            raw = fh.read()
+        off, n = 0, len(raw)
+        entries = []
+        while off + 4 <= n:
+            (length,) = _FRAME.unpack_from(raw, off)
+            if off + 4 + length > n:  # torn tail write: drop it
+                break
+            entries.append(LogEntry.unpack(raw[off + 4:off + 4 + length]))
+            off += 4 + length
+        super().store_entries(entries)
+
+    def _append_file(self, entries: List[LogEntry]) -> None:
+        buf = bytearray()
+        for e in entries:
+            rec = e.pack()
+            buf += _FRAME.pack(len(rec)) + rec
+        self._fh.write(bytes(buf))
+        self._fh.flush()
+        os.fsync(self._fh.fileno())
+
+    def _rewrite_file(self) -> None:
+        tmp = self._log_path + ".tmp"
+        with open(tmp, "wb") as fh:
+            for i in sorted(self._entries):
+                rec = self._entries[i].pack()
+                fh.write(_FRAME.pack(len(rec)) + rec)
+            fh.flush()
+            os.fsync(fh.fileno())
+        self._fh.close()
+        os.replace(tmp, self._log_path)
+        self._fh = open(self._log_path, "ab")
+
+    # ------------------------------------------------------------ overrides
+    def store_entries(self, entries: List[LogEntry]) -> None:
+        super().store_entries(entries)
+        self._append_file(entries)
+
+    def delete_range(self, lo: int, hi: int) -> None:
+        super().delete_range(lo, hi)
+        self._rewrite_file()
+
+    def set_stable(self, key: str, value: Any) -> None:
+        super().set_stable(key, value)
+        tmp = self._stable_path + ".tmp"
+        with open(tmp, "wb") as fh:
+            fh.write(msgpack.packb(self._stable, use_bin_type=True))
+            fh.flush()
+            os.fsync(fh.fileno())
+        os.replace(tmp, self._stable_path)
+
+    def store_snapshot(self, index: int, term: int, data: bytes) -> None:
+        super().store_snapshot(index, term, data)
+        tmp = self._snap_path + ".tmp"
+        with open(tmp, "wb") as fh:
+            fh.write(msgpack.packb((index, term, data), use_bin_type=True))
+            fh.flush()
+            os.fsync(fh.fileno())
+        os.replace(tmp, self._snap_path)
+
+    def close(self) -> None:
+        self._fh.close()

@@ -1,0 +1,729 @@
+"""RaftNode: leader election, log replication, commit, snapshots
+(reference behavior: hashicorp/raft as consumed by nomad/server.go:608-712 —
+leader election feeding leaderCh, raftApply in nomad/rpc.go:262, snapshot
+restore + peer membership changes in nomad/leader.go:421-459).
+
+Threading model: one ticker thread (election timeouts + leader heartbeat
+pacing), one replicator thread per peer (woken by appends, paced by the
+heartbeat interval), one apply thread (feeds committed entries to the FSM and
+resolves apply futures). All shared state behind a single RLock; FSM applies
+run outside the lock.
+"""
+
+from __future__ import annotations
+
+import logging
+import queue
+import random
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import msgpack
+
+from .log import EntryType, LogEntry
+from .transport import TransportError
+
+LOG = logging.getLogger("nomad_tpu.raft")
+
+FOLLOWER = "follower"
+CANDIDATE = "candidate"
+LEADER = "leader"
+SHUTDOWN = "shutdown"
+
+
+class NotLeaderError(Exception):
+    def __init__(self, leader_hint: Optional[str] = None):
+        super().__init__(f"node is not the leader (leader={leader_hint})")
+        self.leader_hint = leader_hint
+
+
+class ApplyTimeout(Exception):
+    pass
+
+
+@dataclass
+class RaftConfig:
+    """(reference: raft.Config tightened the same way the reference's tests
+    tighten it, nomad/server_test.go:46-52 — 50ms election in tests)"""
+    heartbeat_interval: float = 0.05
+    election_timeout_min: float = 0.15
+    election_timeout_max: float = 0.30
+    apply_timeout: float = 10.0
+    snapshot_threshold: int = 8192   # entries applied since last snapshot
+    trailing_logs: int = 128         # kept after compaction for catch-up
+    max_append_entries: int = 64
+
+
+@dataclass
+class _Future:
+    event: threading.Event = field(default_factory=threading.Event)
+    result: Any = None
+    error: Optional[Exception] = None
+
+
+class RaftNode:
+    def __init__(self, node_id: str, peers: List[str], log_store,
+                 transport,
+                 apply_fn: Callable[[int, int, bytes], Any],
+                 snapshot_fn: Optional[Callable[[], bytes]] = None,
+                 restore_fn: Optional[Callable[[bytes], None]] = None,
+                 config: Optional[RaftConfig] = None,
+                 on_leader_change: Optional[Callable[[bool], None]] = None):
+        self.id = node_id
+        self.config = config or RaftConfig()
+        self.log = log_store
+        self.transport = transport
+        self.apply_fn = apply_fn            # (index, entry_type, data) -> Any
+        self.snapshot_fn = snapshot_fn
+        self.restore_fn = restore_fn
+        self.on_leader_change = on_leader_change
+
+        self._lock = threading.RLock()
+        self._role = FOLLOWER
+        self._term = int(self.log.get_stable("term", 0))
+        self._voted_for = self.log.get_stable("voted_for")
+        self._leader_id: Optional[str] = None
+        self._peers: List[str] = list(peers)
+        if node_id not in self._peers:
+            self._peers.append(node_id)
+
+        self._commit_index = 0
+        self._last_applied = 0
+        self._snap_index = 0
+        self._snap_term = 0
+        self._applied_since_snap = 0
+
+        self._next_index: Dict[str, int] = {}
+        self._match_index: Dict[str, int] = {}
+        self._futures: Dict[int, _Future] = {}
+
+        self._election_deadline = 0.0
+        self._leader_events: "queue.Queue[Optional[bool]]" = queue.Queue()
+        self._fsm_lock = threading.Lock()  # serializes apply_fn vs restore_fn
+        self._apply_cond = threading.Condition(self._lock)
+        self._repl_conds: Dict[str, threading.Condition] = {}
+        self._threads: List[threading.Thread] = []
+        self._shutdown = False
+
+        self._restore_from_disk()
+        self.transport.register(node_id, self._handle_rpc)
+
+    # ------------------------------------------------------------ lifecycle
+    def start(self) -> None:
+        self._reset_election_timer()
+        t = threading.Thread(target=self._ticker, daemon=True,
+                             name=f"raft-tick-{self.id}")
+        t.start()
+        self._threads.append(t)
+        t = threading.Thread(target=self._apply_loop, daemon=True,
+                             name=f"raft-apply-{self.id}")
+        t.start()
+        self._threads.append(t)
+        t = threading.Thread(target=self._notify_loop, daemon=True,
+                             name=f"raft-notify-{self.id}")
+        t.start()
+        self._threads.append(t)
+
+    def _notify_loop(self) -> None:
+        """Delivers leadership transitions serially, in order (reference:
+        the leaderCh consumed by monitorLeadership, nomad/leader.go:24-56)."""
+        while True:
+            ev = self._leader_events.get()
+            if ev is None:
+                return
+            if self.on_leader_change:
+                try:
+                    self.on_leader_change(ev)
+                except Exception:
+                    LOG.exception("leader-change callback failed")
+
+    def shutdown(self) -> None:
+        with self._lock:
+            self._shutdown = True
+            was_leader = self._role == LEADER
+            self._role = SHUTDOWN
+            self._apply_cond.notify_all()
+            for c in self._repl_conds.values():
+                c.notify_all()
+            for fut in self._futures.values():
+                fut.error = NotLeaderError(None)
+                fut.event.set()
+            self._futures.clear()
+        self.transport.deregister(self.id)
+        if was_leader:
+            self._leader_events.put(False)
+        self._leader_events.put(None)
+
+    def _restore_from_disk(self) -> None:
+        snap = self.log.latest_snapshot()
+        if snap is not None:
+            index, term, blob = snap
+            meta = msgpack.unpackb(blob, raw=False)
+            self._snap_index, self._snap_term = index, term
+            self._commit_index = self._last_applied = index
+            if meta.get("peers"):
+                self._peers = list(meta["peers"])
+                if self.id not in self._peers:
+                    self._peers.append(self.id)
+            if self.restore_fn is not None:
+                self.restore_fn(meta["data"])
+        # Config entries in the retained log tail may supersede the snapshot.
+        for e in self.log.get_range(self.log.first_index(),
+                                    self.log.last_index()):
+            if e.Type == EntryType.Config:
+                self._set_peers_locked(msgpack.unpackb(e.Data, raw=False))
+
+    # ----------------------------------------------------------- properties
+    @property
+    def role(self) -> str:
+        with self._lock:
+            return self._role
+
+    @property
+    def term(self) -> int:
+        with self._lock:
+            return self._term
+
+    @property
+    def leader_id(self) -> Optional[str]:
+        with self._lock:
+            return self._leader_id if self._role != LEADER else self.id
+
+    def is_leader(self) -> bool:
+        with self._lock:
+            return self._role == LEADER
+
+    @property
+    def last_index(self) -> int:
+        return max(self.log.last_index(), self._snap_index)
+
+    @property
+    def applied_index(self) -> int:
+        with self._lock:
+            return self._last_applied
+
+    @property
+    def commit_index(self) -> int:
+        with self._lock:
+            return self._commit_index
+
+    def peers(self) -> List[str]:
+        with self._lock:
+            return list(self._peers)
+
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                "state": self._role, "term": self._term,
+                "leader": self.leader_id, "commit_index": self._commit_index,
+                "applied_index": self._last_applied,
+                "last_log_index": self.last_index,
+                "num_peers": len(self._peers),
+                "snapshot_index": self._snap_index,
+            }
+
+    # -------------------------------------------------------------- helpers
+    def _last_log_info(self) -> Tuple[int, int]:
+        last = self.log.last_index()
+        if last == 0:
+            return self._snap_index, self._snap_term
+        e = self.log.get_entry(last)
+        return last, e.Term if e else self._snap_term
+
+    def _term_at(self, index: int) -> Optional[int]:
+        if index == 0:
+            return 0
+        if index == self._snap_index:
+            return self._snap_term
+        e = self.log.get_entry(index)
+        return e.Term if e else None
+
+    def _reset_election_timer(self) -> None:
+        spread = (self.config.election_timeout_max
+                  - self.config.election_timeout_min)
+        self._election_deadline = (time.monotonic()
+                                   + self.config.election_timeout_min
+                                   + random.random() * spread)
+
+    def _save_term_vote(self) -> None:
+        self.log.set_stable("term", self._term)
+        self.log.set_stable("voted_for", self._voted_for)
+
+    def _step_down(self, term: int, leader: Optional[str] = None) -> None:
+        """Caller holds the lock."""
+        was_leader = self._role == LEADER
+        if term > self._term:
+            self._term = term
+            self._voted_for = None
+            self._save_term_vote()
+        self._role = FOLLOWER
+        if leader is not None:
+            self._leader_id = leader
+        self._reset_election_timer()
+        if was_leader:
+            for fut in self._futures.values():
+                fut.error = NotLeaderError(self._leader_id)
+                fut.event.set()
+            self._futures.clear()
+            self._leader_events.put(False)
+
+    def _set_peers_locked(self, peers: List[str]) -> None:
+        self._peers = list(peers)
+        if self.id not in self._peers and self._role == LEADER:
+            # Removed ourselves: step down after the entry commits.
+            pass
+        for p in self._peers:
+            if p != self.id and p not in self._next_index:
+                self._next_index[p] = self.last_index + 1
+                self._match_index[p] = 0
+                if self._role == LEADER:
+                    self._start_replicator(p)
+
+    # ---------------------------------------------------------------- tick
+    def _ticker(self) -> None:
+        while True:
+            with self._lock:
+                if self._shutdown:
+                    return
+                role = self._role
+                deadline = self._election_deadline
+            now = time.monotonic()
+            if role in (FOLLOWER, CANDIDATE) and now >= deadline:
+                self._run_election()
+            time.sleep(0.01)
+
+    # ------------------------------------------------------------- election
+    def _run_election(self) -> None:
+        with self._lock:
+            if self._shutdown or self._role == LEADER:
+                return
+            self._role = CANDIDATE
+            self._term += 1
+            self._voted_for = self.id
+            self._save_term_vote()
+            self._reset_election_timer()
+            term = self._term
+            last_idx, last_term = self._last_log_info()
+            peers = [p for p in self._peers if p != self.id]
+            votes_needed = len(self._peers) // 2 + 1
+        LOG.debug("%s starting election term=%d", self.id, term)
+
+        votes = [1]  # our own
+        vote_lock = threading.Lock()
+        done = threading.Event()
+
+        def ask(peer: str):
+            try:
+                resp = self.transport.send(peer, "raft.request_vote", {
+                    "Term": term, "Candidate": self.id,
+                    "LastLogIndex": last_idx, "LastLogTerm": last_term,
+                })
+            except TransportError:
+                return
+            with self._lock:
+                if resp["Term"] > self._term:
+                    self._step_down(resp["Term"])
+                    done.set()
+                    return
+            if resp.get("Granted"):
+                with vote_lock:
+                    votes[0] += 1
+                    if votes[0] >= votes_needed:
+                        done.set()
+
+        threads = [threading.Thread(target=ask, args=(p,), daemon=True)
+                   for p in peers]
+        for t in threads:
+            t.start()
+        if not peers:
+            done.set()
+        done.wait(timeout=self.config.election_timeout_min)
+        with vote_lock:
+            won = votes[0] >= votes_needed
+        with self._lock:
+            if won and self._role == CANDIDATE and self._term == term:
+                self._become_leader()
+
+    def _become_leader(self) -> None:
+        """Caller holds the lock."""
+        LOG.info("%s became leader term=%d", self.id, self._term)
+        self._role = LEADER
+        self._leader_id = self.id
+        last = self.last_index
+        for p in self._peers:
+            if p == self.id:
+                continue
+            self._next_index[p] = last + 1
+            self._match_index[p] = 0
+        # Barrier noop commits everything from prior terms (leader.go:60).
+        self._append_locked(EntryType.Noop, b"")
+        for p in self._peers:
+            if p != self.id:
+                self._start_replicator(p)
+        self._leader_events.put(True)
+
+    # ---------------------------------------------------------- replication
+    def _start_replicator(self, peer: str) -> None:
+        cond = self._repl_conds.get(peer)
+        if cond is None:
+            cond = threading.Condition(self._lock)
+            self._repl_conds[peer] = cond
+        t = threading.Thread(target=self._replicate_loop, args=(peer,),
+                             daemon=True, name=f"raft-repl-{self.id}-{peer}")
+        t.start()
+        self._threads.append(t)
+
+    def _replicate_loop(self, peer: str) -> None:
+        cond = self._repl_conds[peer]
+        term_started = self.term
+        while True:
+            with self._lock:
+                if (self._shutdown or self._role != LEADER
+                        or self._term != term_started
+                        or peer not in self._peers):
+                    return
+            try:
+                self._replicate_once(peer)
+            except TransportError:
+                pass
+            with self._lock:
+                if self._shutdown or self._role != LEADER:
+                    return
+                behind = self._next_index.get(peer, 1) <= self.last_index
+                if not behind:
+                    cond.wait(timeout=self.config.heartbeat_interval)
+
+    def _replicate_once(self, peer: str) -> None:
+        with self._lock:
+            if self._role != LEADER:
+                return
+            term = self._term
+            next_idx = self._next_index.get(peer, self.last_index + 1)
+            first = self.log.first_index()
+            need_snapshot = (self._snap_index > 0 and next_idx <= self._snap_index
+                             and (first == 0 or next_idx < first))
+            if need_snapshot:
+                snap = self.log.latest_snapshot()
+            else:
+                prev_idx = next_idx - 1
+                prev_term = self._term_at(prev_idx)
+                if prev_term is None:
+                    snap = self.log.latest_snapshot()
+                    need_snapshot = snap is not None
+                    if not need_snapshot:
+                        return
+                else:
+                    hi = min(self.log.last_index(),
+                             next_idx + self.config.max_append_entries - 1)
+                    entries = self.log.get_range(next_idx, hi)
+                    commit = self._commit_index
+
+        if need_snapshot and snap is not None:
+            s_index, s_term, blob = snap
+            resp = self.transport.send(peer, "raft.install_snapshot", {
+                "Term": term, "Leader": self.id,
+                "LastIndex": s_index, "LastTerm": s_term, "Data": blob,
+            })
+            with self._lock:
+                if resp["Term"] > self._term:
+                    self._step_down(resp["Term"])
+                    return
+                self._next_index[peer] = s_index + 1
+                self._match_index[peer] = s_index
+            return
+
+        payload = {
+            "Term": term, "Leader": self.id,
+            "PrevLogIndex": prev_idx, "PrevLogTerm": prev_term,
+            "Entries": [(e.Index, e.Term, e.Type, e.Data) for e in entries],
+            "LeaderCommit": commit,
+        }
+        resp = self.transport.send(peer, "raft.append_entries", payload)
+        with self._lock:
+            if resp["Term"] > self._term:
+                self._step_down(resp["Term"])
+                return
+            if self._role != LEADER or self._term != term:
+                return
+            if resp.get("Success"):
+                if entries:
+                    self._match_index[peer] = entries[-1].Index
+                    self._next_index[peer] = entries[-1].Index + 1
+                else:
+                    self._match_index[peer] = max(
+                        self._match_index.get(peer, 0), prev_idx)
+                self._leader_advance_commit()
+            else:
+                hint = resp.get("LastIndex")
+                if hint is not None:
+                    self._next_index[peer] = max(1, min(next_idx - 1, hint + 1))
+                else:
+                    self._next_index[peer] = max(1, next_idx - 1)
+
+    def _leader_advance_commit(self) -> None:
+        """Caller holds the lock. Advance commit to the majority match index,
+        but only over entries from the current term (Raft §5.4.2)."""
+        matches = sorted(
+            [self.last_index]
+            + [self._match_index.get(p, 0) for p in self._peers
+               if p != self.id])
+        majority_idx = matches[(len(matches) - 1) // 2]
+        if majority_idx <= self._commit_index:
+            return
+        t = self._term_at(majority_idx)
+        if t == self._term:
+            self._commit_index = majority_idx
+            self._apply_cond.notify_all()
+
+    # -------------------------------------------------------------- appends
+    def _append_locked(self, etype: int, data: bytes) -> int:
+        index = self.last_index + 1
+        entry = LogEntry(Index=index, Term=self._term, Type=etype, Data=data)
+        self.log.store_entries([entry])
+        if etype == EntryType.Config:
+            self._set_peers_locked(msgpack.unpackb(data, raw=False))
+        for cond in self._repl_conds.values():
+            cond.notify_all()
+        self._leader_advance_commit()
+        return index
+
+    def apply_command(self, data: bytes,
+                      timeout: Optional[float] = None) -> Tuple[int, Any]:
+        """Replicate one command; block until it is applied to the local FSM.
+        Returns (index, fsm_result). Raises NotLeaderError on non-leaders
+        (reference: Server.raftApply, nomad/rpc.go:262-276)."""
+        fut = _Future()
+        with self._lock:
+            if self._role != LEADER:
+                raise NotLeaderError(self._leader_id)
+            index = self._append_locked(EntryType.Command, data)
+            self._futures[index] = fut
+        if not fut.event.wait(timeout or self.config.apply_timeout):
+            with self._lock:
+                self._futures.pop(index, None)
+            raise ApplyTimeout(f"apply of index {index} timed out")
+        if fut.error is not None:
+            raise fut.error
+        return index, fut.result
+
+    def barrier(self, timeout: Optional[float] = None) -> int:
+        """Append + commit a noop; returns its index once applied
+        (reference: raft.Barrier in nomad/leader.go:60)."""
+        fut = _Future()
+        with self._lock:
+            if self._role != LEADER:
+                raise NotLeaderError(self._leader_id)
+            index = self._append_locked(EntryType.Noop, b"")
+            self._futures[index] = fut
+        if not fut.event.wait(timeout or self.config.apply_timeout):
+            raise ApplyTimeout("barrier timed out")
+        if fut.error is not None:  # lost leadership mid-barrier
+            raise fut.error
+        return index
+
+    # ----------------------------------------------------------- membership
+    def add_peer(self, peer_id: str, timeout: Optional[float] = None) -> None:
+        """Single-server membership change (reference: raft.AddPeer driven by
+        Serf reconciliation, nomad/leader.go:421-447)."""
+        with self._lock:
+            if self._role != LEADER:
+                raise NotLeaderError(self._leader_id)
+            if peer_id in self._peers:
+                return
+            peers = self._peers + [peer_id]
+        self._config_change(peers, timeout)
+
+    def remove_peer(self, peer_id: str,
+                    timeout: Optional[float] = None) -> None:
+        """(reference: raft.RemovePeer, nomad/leader.go:449-459)"""
+        with self._lock:
+            if self._role != LEADER:
+                raise NotLeaderError(self._leader_id)
+            if peer_id not in self._peers:
+                return
+            peers = [p for p in self._peers if p != peer_id]
+        self._config_change(peers, timeout)
+
+    def _config_change(self, peers: List[str],
+                       timeout: Optional[float]) -> None:
+        fut = _Future()
+        data = msgpack.packb(peers, use_bin_type=True)
+        with self._lock:
+            index = self._append_locked(EntryType.Config, data)
+            self._futures[index] = fut
+        fut.event.wait(timeout or self.config.apply_timeout)
+
+    # ------------------------------------------------------------ RPC sides
+    def _handle_rpc(self, method: str, payload: Dict[str, Any]
+                    ) -> Dict[str, Any]:
+        if method == "raft.request_vote":
+            return self._on_request_vote(payload)
+        if method == "raft.append_entries":
+            return self._on_append_entries(payload)
+        if method == "raft.install_snapshot":
+            return self._on_install_snapshot(payload)
+        raise ValueError(f"unknown raft rpc {method}")
+
+    def _on_request_vote(self, req: Dict[str, Any]) -> Dict[str, Any]:
+        with self._lock:
+            if req["Term"] > self._term:
+                self._step_down(req["Term"])
+            granted = False
+            if req["Term"] == self._term and self._role != LEADER:
+                up_to_date = False
+                last_idx, last_term = self._last_log_info()
+                if (req["LastLogTerm"], req["LastLogIndex"]) >= (last_term,
+                                                                 last_idx):
+                    up_to_date = True
+                if up_to_date and self._voted_for in (None, req["Candidate"]):
+                    granted = True
+                    self._voted_for = req["Candidate"]
+                    self._save_term_vote()
+                    self._reset_election_timer()
+            return {"Term": self._term, "Granted": granted}
+
+    def _on_append_entries(self, req: Dict[str, Any]) -> Dict[str, Any]:
+        with self._lock:
+            if req["Term"] < self._term:
+                return {"Term": self._term, "Success": False,
+                        "LastIndex": self.last_index}
+            if req["Term"] > self._term or self._role != FOLLOWER:
+                self._step_down(req["Term"], leader=req["Leader"])
+            self._leader_id = req["Leader"]
+            self._reset_election_timer()
+
+            prev_idx, prev_term = req["PrevLogIndex"], req["PrevLogTerm"]
+            if prev_idx > 0:
+                local_term = self._term_at(prev_idx)
+                if local_term is None or local_term != prev_term:
+                    return {"Term": self._term, "Success": False,
+                            "LastIndex": min(self.last_index, prev_idx - 1)}
+
+            entries = [LogEntry(Index=i, Term=t, Type=ty, Data=d)
+                       for (i, t, ty, d) in req["Entries"]]
+            to_store = []
+            for e in entries:
+                local = self.log.get_entry(e.Index)
+                if local is not None and local.Term != e.Term:
+                    # Conflict: truncate our suffix, drop stale futures.
+                    self.log.delete_range(e.Index, self.log.last_index())
+                    to_store.append(e)
+                elif local is None and e.Index > self._snap_index:
+                    to_store.append(e)
+            if to_store:
+                self.log.store_entries(to_store)
+                for e in to_store:
+                    if e.Type == EntryType.Config:
+                        self._set_peers_locked(
+                            msgpack.unpackb(e.Data, raw=False))
+            if req["LeaderCommit"] > self._commit_index:
+                self._commit_index = min(req["LeaderCommit"], self.last_index)
+                self._apply_cond.notify_all()
+            return {"Term": self._term, "Success": True,
+                    "LastIndex": self.last_index}
+
+    def _on_install_snapshot(self, req: Dict[str, Any]) -> Dict[str, Any]:
+        with self._lock:
+            if req["Term"] < self._term:
+                return {"Term": self._term}
+            if req["Term"] > self._term or self._role != FOLLOWER:
+                self._step_down(req["Term"], leader=req["Leader"])
+            self._leader_id = req["Leader"]
+            self._reset_election_timer()
+        # _fsm_lock first (same order as the apply loop) so restore_fn can't
+        # interleave with an in-flight apply_fn on the same FSM.
+        with self._fsm_lock:
+            with self._lock:
+                index, term = req["LastIndex"], req["LastTerm"]
+                if index <= self._last_applied:
+                    return {"Term": self._term}
+                blob = req["Data"]
+                self.log.store_snapshot(index, term, blob)
+                self.log.delete_range(self.log.first_index(),
+                                      self.log.last_index())
+                meta = msgpack.unpackb(blob, raw=False)
+                self._snap_index, self._snap_term = index, term
+                self._commit_index = self._last_applied = index
+                self._applied_since_snap = 0
+                if meta.get("peers"):
+                    self._set_peers_locked(meta["peers"])
+                restore = self.restore_fn
+            if restore is not None:
+                restore(meta["data"])
+        return {"Term": self.term}
+
+    # ----------------------------------------------------------- apply loop
+    def _apply_loop(self) -> None:
+        while True:
+            with self._lock:
+                while (not self._shutdown
+                       and self._last_applied >= self._commit_index):
+                    self._apply_cond.wait(timeout=0.5)
+                if self._shutdown:
+                    return
+                lo = self._last_applied + 1
+                hi = self._commit_index
+                entries = self.log.get_range(lo, hi)
+            for e in entries:
+                # _fsm_lock serializes apply_fn with InstallSnapshot's
+                # restore_fn; the index recheck discards batch entries a
+                # concurrent snapshot restore already covers.
+                with self._fsm_lock:
+                    with self._lock:
+                        stale = (self._shutdown
+                                 or e.Index != self._last_applied + 1)
+                    if stale:
+                        break
+                    result: Any = None
+                    error: Optional[Exception] = None
+                    if e.Type == EntryType.Command:
+                        try:
+                            result = self.apply_fn(e.Index, EntryType.Command,
+                                                   e.Data)
+                        except Exception as exc:  # surface to caller
+                            error = exc
+                            LOG.exception("fsm apply failed at %d", e.Index)
+                    with self._lock:
+                        self._last_applied = e.Index
+                        self._applied_since_snap += 1
+                        fut = self._futures.pop(e.Index, None)
+                        if fut is not None:
+                            fut.result = result
+                            fut.error = error
+                            fut.event.set()
+                        if (e.Type == EntryType.Config
+                                and self.id not in self._peers
+                                and self._role == LEADER):
+                            self._step_down(self._term)
+            self._maybe_snapshot()
+
+    # ------------------------------------------------------------ snapshots
+    def _maybe_snapshot(self) -> None:
+        with self._lock:
+            if (self.snapshot_fn is None
+                    or self._applied_since_snap < self.config.snapshot_threshold):
+                return
+            index = self._last_applied
+            term = self._term_at(index) or self._term
+            peers = list(self._peers)
+            self._applied_since_snap = 0
+        data = self.snapshot_fn()
+        blob = msgpack.packb({"data": data, "peers": peers},
+                             use_bin_type=True)
+        with self._lock:
+            self.log.store_snapshot(index, term, blob)
+            self._snap_index, self._snap_term = index, term
+            keep_from = max(self.log.first_index(),
+                            index - self.config.trailing_logs + 1)
+            if keep_from > self.log.first_index():
+                self.log.delete_range(self.log.first_index(), keep_from - 1)
+
+    def take_snapshot(self) -> int:
+        """Force a snapshot now; returns its index (reference: the snapshot
+        path exercised by fsm tests, nomad/fsm.go:430)."""
+        with self._lock:
+            self._applied_since_snap = self.config.snapshot_threshold
+        self._maybe_snapshot()
+        return self._snap_index

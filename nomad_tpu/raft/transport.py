@@ -1,0 +1,109 @@
+"""Raft transport abstraction (reference: nomad/raft_rpc.go RaftLayer — a
+byte-prefixed stream carved out of the shared RPC port, and the in-memory
+transport used by DevMode, server.go:618-626).
+
+Two implementations:
+  InMemTransport — loopback registry for in-process multi-node tests, with
+                   fault injection (partitions, drops) for failover suites.
+  (TCP)          — provided by nomad_tpu.rpc: Raft messages ride the shared
+                   multiplexed RPC port under a dedicated stream prefix.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, Dict, Optional, Protocol
+
+
+class TransportError(Exception):
+    """Peer unreachable / partitioned / dropped."""
+
+
+class Transport(Protocol):
+    def send(self, target: str, method: str, payload: Dict[str, Any]
+             ) -> Dict[str, Any]: ...
+    def register(self, node_id: str,
+                 handler: Callable[[str, Dict[str, Any]], Dict[str, Any]]
+                 ) -> None: ...
+    def deregister(self, node_id: str) -> None: ...
+
+
+class InMemTransport:
+    """Shared loopback registry. Construct one per test cluster and hand the
+    same instance to every RaftNode (reference test shape:
+    nomad/server_test.go:82-93 testJoin over loopback)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._handlers: Dict[str, Callable] = {}
+        self._partitions: Dict[str, set] = {}   # node -> set of blocked peers
+        self._down: set = set()
+
+    def register(self, node_id: str, handler) -> None:
+        with self._lock:
+            self._handlers[node_id] = handler
+
+    def deregister(self, node_id: str) -> None:
+        with self._lock:
+            self._handlers.pop(node_id, None)
+
+    # -------------------------------------------------------- fault control
+    def partition(self, a: str, b: str) -> None:
+        """Symmetric partition between a and b."""
+        with self._lock:
+            self._partitions.setdefault(a, set()).add(b)
+            self._partitions.setdefault(b, set()).add(a)
+
+    def heal(self, a: Optional[str] = None, b: Optional[str] = None) -> None:
+        with self._lock:
+            if a is None:
+                self._partitions.clear()
+                self._down.clear()
+            elif b is None:
+                self._partitions.pop(a, None)
+                for s in self._partitions.values():
+                    s.discard(a)
+                self._down.discard(a)
+            else:
+                self._partitions.get(a, set()).discard(b)
+                self._partitions.get(b, set()).discard(a)
+
+    def take_down(self, node_id: str) -> None:
+        with self._lock:
+            self._down.add(node_id)
+
+    def bring_up(self, node_id: str) -> None:
+        with self._lock:
+            self._down.discard(node_id)
+
+    # -------------------------------------------------------------- sending
+    def send(self, target: str, method: str, payload: Dict[str, Any],
+             source: Optional[str] = None) -> Dict[str, Any]:
+        with self._lock:
+            handler = self._handlers.get(target)
+            blocked = (target in self._down
+                       or (source is not None and source in self._down)
+                       or (source is not None
+                           and target in self._partitions.get(source, ())))
+        if handler is None or blocked:
+            raise TransportError(f"peer {target} unreachable")
+        return handler(method, payload)
+
+
+class BoundTransport:
+    """A per-node view of a shared transport that stamps the source id, so
+    partitions affect both directions."""
+
+    def __init__(self, inner: InMemTransport, node_id: str):
+        self.inner = inner
+        self.node_id = node_id
+
+    def register(self, node_id: str, handler) -> None:
+        self.inner.register(node_id, handler)
+
+    def deregister(self, node_id: str) -> None:
+        self.inner.deregister(node_id)
+
+    def send(self, target: str, method: str, payload: Dict[str, Any]
+             ) -> Dict[str, Any]:
+        return self.inner.send(target, method, payload, source=self.node_id)

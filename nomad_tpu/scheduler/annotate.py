@@ -92,26 +92,17 @@ def _annotate_task(diff: TaskDiff, parent: TaskGroupDiff) -> None:
         diff.Annotations.append(AnnotationForcesDestructiveUpdate)
         return
 
-    # Edited: only some field changes can be applied in place — the same
-    # field sensitivity the reconciler uses (reference: scheduler/util.go:291
-    # tasksUpdated; annotate.go:168-184).
-    destructive = False
-    for f in diff.Fields:
-        if f.Type != DiffTypeNone and not _inplace_field(f.Name):
-            destructive = True
-            break
+    # Edited: every primitive-field change is destructive; only LogConfig,
+    # Service, and Constraint object edits go in place (reference:
+    # annotate.go:161-183 — note the reference is deliberately more
+    # conservative here than tasksUpdated, util.go:291).
+    destructive = any(f.Type != DiffTypeNone for f in diff.Fields)
     if not destructive:
         for o in diff.Objects:
-            if o.Type != DiffTypeNone and o.Name != "LogConfig":
+            if (o.Type != DiffTypeNone
+                    and o.Name not in ("LogConfig", "Service", "Constraint")):
                 destructive = True
                 break
     diff.Annotations.append(
         AnnotationForcesDestructiveUpdate if destructive
         else AnnotationForcesInplaceUpdate)
-
-
-def _inplace_field(name: str) -> bool:
-    """Field paths whose edits the reconciler applies in place — must stay
-    the exact inverse of what tasks_updated treats as destructive
-    (reference: util.go:291-330 tasksUpdated; our scheduler/util.py)."""
-    return name == "KillTimeout" or name.startswith("LogConfig")

@@ -85,13 +85,36 @@ class ServerConfig:
     node_gc_threshold: float = 24 * 3600.0
     failed_eval_unblock_interval: float = 60.0
     dev_mode: bool = False
+    # Replicated deployment (reference: nomad/config.go RaftConfig +
+    # BootstrapExpect). node_id doubles as the raft/transport address.
+    node_id: str = ""
+    bootstrap_expect: int = 1
 
 
 class Server:
-    def __init__(self, config: Optional[ServerConfig] = None):
+    def __init__(self, config: Optional[ServerConfig] = None,
+                 transport=None, log_store=None,
+                 peers: Optional[List[str]] = None, raft_config=None):
+        """With no transport this is a dev-mode single-node control plane
+        (DevRaft, reference: server.go:612-616 DevMode). With a transport it
+        boots a replicated server: a RaftNode over the given peers whose
+        leadership transitions drive establish/revoke (reference:
+        monitorLeadership, nomad/leader.go:24-56)."""
         self.config = config or ServerConfig()
         self.fsm = FSM()
-        self.raft = DevRaft(self.fsm)
+        self._leadership_lock = threading.Lock()
+        if transport is not None:
+            from nomad_tpu.raft import RaftBackend
+            self.raft = RaftBackend(
+                node_id=self.config.node_id or generate_uuid(),
+                fsm=self.fsm,
+                peers=peers or [],
+                transport=transport,
+                log_store=log_store,
+                config=raft_config,
+                on_leader_change=self._leadership_transition)
+        else:
+            self.raft = DevRaft(self.fsm)
         self.state: StateStore = self.fsm.state
         self.tindex = TensorIndex.attach(self.state)
 
@@ -119,6 +142,33 @@ class Server:
         self._reapers: List[threading.Thread] = []
 
     # ------------------------------------------------------------ leadership
+    def start(self) -> None:
+        """Start the consensus backend (replicated mode). Dev mode needs no
+        start; callers invoke establish_leadership directly."""
+        if hasattr(self.raft, "start"):
+            self.raft.start()
+
+    def is_leader(self) -> bool:
+        if hasattr(self.raft, "is_leader"):
+            return self.raft.is_leader()
+        return self._leader
+
+    def _leadership_transition(self, is_leader: bool) -> None:
+        """(reference: monitorLeadership consuming leaderCh,
+        nomad/leader.go:24-56)"""
+        with self._leadership_lock:
+            if is_leader and not self._leader:
+                # Barrier: apply everything from prior terms before
+                # rehydrating leader state (reference: leader.go:60-68).
+                try:
+                    self.raft.barrier()
+                except Exception:
+                    logger.exception("leadership barrier failed")
+                    return
+                self.establish_leadership()
+            elif not is_leader and self._leader:
+                self.revoke_leadership()
+
     def establish_leadership(self) -> None:
         """(reference: leader.go:107-170)"""
         self._leader = True
@@ -180,6 +230,8 @@ class Server:
     def shutdown(self) -> None:
         self._shutdown.set()
         self.revoke_leadership()
+        if hasattr(self.raft, "shutdown"):
+            self.raft.shutdown()
 
     def _start_loop(self, fn, interval: float) -> None:
         def loop():
@@ -371,9 +423,7 @@ class Server:
         if job.is_periodic():
             diff = None
             if want_diff:
-                from nomad_tpu.structs.diff import job_diff as _job_diff
-
-                diff = _job_diff(old_job, job, contextual=True)
+                diff = job_diff(old_job, job, contextual=True)
             next_launch = (job.Periodic.next(time.time())
                            if job.Periodic.Enabled else 0.0)
             return JobPlanResponse(Diff=diff, JobModifyIndex=index,
@@ -421,15 +471,11 @@ class Server:
             annotate(diff, annotations)
 
         updated_eval = harness.evals[0] if harness.evals else ev
-        next_launch = 0.0
-        if job.is_periodic() and job.Periodic.Enabled:
-            next_launch = job.Periodic.next(time.time())
 
         return JobPlanResponse(
             Diff=diff,
             Annotations=annotations,
             FailedTGAllocs=updated_eval.FailedTGAllocs,
-            NextPeriodicLaunch=next_launch,
             JobModifyIndex=index,
             CreatedEvals=list(harness.creates),
         )

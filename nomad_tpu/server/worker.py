@@ -88,6 +88,12 @@ class Worker:
                 self._wait_for_index(ev.ModifyIndex)
                 self._invoke_scheduler(ev, token)
             except Exception:
+                # Leadership loss tears down the plan queue / broker under a
+                # mid-flight eval; drop quietly, redelivery handles the rest
+                # (reference: worker pause on leadership, worker.go:88-99).
+                if self._stop.is_set() or not self.eval_broker.enabled():
+                    logger.debug("worker: dropping eval %s on shutdown", ev.ID)
+                    continue
                 logger.exception("worker: failed to process eval %s", ev.ID)
                 self._send_nack(ev.ID, token)
                 continue

@@ -176,6 +176,28 @@ job "write" {
             assert a.TaskStates["t"].successful()
         assert srv.state.job_by_id("write").Status == "dead"
 
+    def test_mock_driver_accepts_hcl_duration_config(self, dev_cluster):
+        """Regression: HCL hands duration strings ("2s") through to driver
+        config; the mock driver must parse them, not crash in restart
+        backoff forever."""
+        srv, client, cfg = dev_cluster
+        job = parse_job('''
+job "mocked" {
+  datacenters = ["dc1"]
+  type = "batch"
+  group "g" {
+    task "t" {
+      driver = "mock_driver"
+      config { run_for = "100ms" }
+      resources { cpu = 50 memory = 32 disk = 300 }
+    }
+  }
+}''')
+        srv.job_register(job)
+        assert wait_for(lambda: (
+            (allocs := srv.state.allocs_by_job("mocked"))
+            and all(a.ClientStatus == "complete" for a in allocs)))
+
     def test_service_task_restarts_on_failure(self, dev_cluster):
         srv, client, cfg = dev_cluster
         job = parse_job('''

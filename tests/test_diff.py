@@ -225,9 +225,23 @@ class TestAnnotate:
         task = d.TaskGroups[0].Tasks[0]
         assert AnnotationForcesDestructiveUpdate in task.Annotations
 
-    def test_kill_timeout_change_is_inplace(self):
+    def test_kill_timeout_change_is_destructive(self):
+        # Every primitive-field edit is destructive in plan annotations
+        # (reference: annotate.go:161-165).
         d = self._diff(lambda j: setattr(j.TaskGroups[0].Tasks[0],
                                          "KillTimeout", 99_000_000_000))
+        annotate(d, None)
+        task = d.TaskGroups[0].Tasks[0]
+        assert AnnotationForcesDestructiveUpdate in task.Annotations
+
+    def test_constraint_change_is_inplace(self):
+        # LogConfig/Service/Constraint object edits go in place
+        # (reference: annotate.go:168-177).
+        from nomad_tpu.structs import Constraint
+
+        d = self._diff(lambda j: j.TaskGroups[0].Tasks[0].Constraints.append(
+            Constraint(LTarget="${attr.kernel.name}", RTarget="linux",
+                       Operand="=")))
         annotate(d, None)
         task = d.TaskGroups[0].Tasks[0]
         assert AnnotationForcesInplaceUpdate in task.Annotations

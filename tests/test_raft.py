@@ -1,0 +1,347 @@
+"""Raft consensus tests (reference shapes: nomad/leader_test.go:14-288 —
+election, failover, singleton enable/disable across leadership;
+nomad/fsm.go snapshot/restore; raft log persistence).
+
+All clusters are in-process over the loopback InMemTransport with tightened
+timeouts (reference: server_test.go:46-52 tightens Raft the same way).
+"""
+
+import threading
+import time
+
+import msgpack
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.raft import (
+    FileLogStore,
+    InMemLogStore,
+    InMemTransport,
+    LogEntry,
+    NotLeaderError,
+    RaftConfig,
+    RaftNode,
+)
+from nomad_tpu.raft.log import EntryType
+from nomad_tpu.raft.transport import BoundTransport
+
+
+def wait_for(cond, timeout=10.0, interval=0.01):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(interval)
+    return False
+
+
+FAST = RaftConfig(heartbeat_interval=0.02, election_timeout_min=0.06,
+                  election_timeout_max=0.12, apply_timeout=5.0)
+
+
+class AppendFSM:
+    """Toy FSM: appends (index, decoded payload) pairs."""
+
+    def __init__(self):
+        self.applied = []
+        self.lock = threading.Lock()
+
+    def apply(self, index, etype, data):
+        val = msgpack.unpackb(data, raw=False)
+        with self.lock:
+            self.applied.append((index, val))
+        return val
+
+    def snapshot(self):
+        with self.lock:
+            return msgpack.packb(self.applied, use_bin_type=True)
+
+    def restore(self, blob):
+        with self.lock:
+            self.applied = [tuple(x) for x in msgpack.unpackb(blob, raw=False)]
+
+
+def make_cluster(n, transport=None, configs=None, stores=None):
+    transport = transport or InMemTransport()
+    ids = [f"s{i}" for i in range(n)]
+    nodes, fsms = [], []
+    for i, nid in enumerate(ids):
+        fsm = AppendFSM()
+        node = RaftNode(
+            node_id=nid, peers=list(ids),
+            log_store=(stores[i] if stores else InMemLogStore()),
+            transport=BoundTransport(transport, nid),
+            apply_fn=fsm.apply, snapshot_fn=fsm.snapshot,
+            restore_fn=fsm.restore,
+            config=(configs[i] if configs else FAST))
+        nodes.append(node)
+        fsms.append(fsm)
+    for node in nodes:
+        node.start()
+    return transport, nodes, fsms
+
+
+def leader_of(nodes):
+    leaders = [n for n in nodes if n.is_leader() and n.role == "leader"]
+    return leaders[0] if len(leaders) == 1 else None
+
+
+def cmd(value):
+    return msgpack.packb(value, use_bin_type=True)
+
+
+def shutdown_all(nodes):
+    for n in nodes:
+        n.shutdown()
+
+
+class TestSingleNode:
+    def test_self_elects_and_applies(self):
+        _, nodes, fsms = make_cluster(1)
+        try:
+            assert wait_for(lambda: nodes[0].is_leader())
+            index, result = nodes[0].apply_command(cmd({"op": 1}))
+            assert result == {"op": 1}
+            assert fsms[0].applied[-1] == (index, {"op": 1})
+        finally:
+            shutdown_all(nodes)
+
+    def test_restart_recovers_from_file_log(self, tmp_path):
+        store = FileLogStore(str(tmp_path / "raft"))
+        transport = InMemTransport()
+        _, nodes, fsms = make_cluster(1, transport=transport, stores=[store])
+        try:
+            assert wait_for(lambda: nodes[0].is_leader())
+            for i in range(5):
+                nodes[0].apply_command(cmd(i))
+            applied = list(fsms[0].applied)
+        finally:
+            shutdown_all(nodes)
+        store.close()
+
+        store2 = FileLogStore(str(tmp_path / "raft"))
+        _, nodes2, fsms2 = make_cluster(1, stores=[store2])
+        try:
+            assert wait_for(lambda: nodes2[0].is_leader())
+            # Replay happens via commit advancement after the noop barrier.
+            assert wait_for(
+                lambda: [v for _, v in fsms2[0].applied] == [v for _, v in
+                                                             applied])
+        finally:
+            shutdown_all(nodes2)
+
+
+class TestElection:
+    def test_three_node_single_leader(self):
+        _, nodes, _ = make_cluster(3)
+        try:
+            assert wait_for(lambda: leader_of(nodes) is not None)
+            leader = leader_of(nodes)
+            followers = [n for n in nodes if n is not leader]
+            assert all(n.role == "follower" for n in followers)
+            # Followers learn the leader id through heartbeats.
+            assert wait_for(lambda: all(
+                n.leader_id == leader.id for n in followers))
+        finally:
+            shutdown_all(nodes)
+
+    def test_leader_loss_triggers_failover(self):
+        """(reference: nomad/leader_test.go:14-139 leader loss/rejoin)"""
+        transport, nodes, _ = make_cluster(3)
+        try:
+            assert wait_for(lambda: leader_of(nodes) is not None)
+            leader = leader_of(nodes)
+            transport.take_down(leader.id)
+            rest = [n for n in nodes if n is not leader]
+            assert wait_for(lambda: any(n.is_leader() for n in rest))
+            # Old leader rejoins as follower once it sees the higher term.
+            transport.bring_up(leader.id)
+            assert wait_for(lambda: leader_of(nodes) is not None)
+            new_leader = leader_of(nodes)
+            assert wait_for(
+                lambda: leader.role == "follower" or leader is new_leader)
+        finally:
+            shutdown_all(nodes)
+
+    def test_partitioned_candidate_rejoin(self):
+        transport, nodes, fsms = make_cluster(3)
+        try:
+            assert wait_for(lambda: leader_of(nodes) is not None)
+            leader = leader_of(nodes)
+            isolated = [n for n in nodes if n is not leader][0]
+            for other in nodes:
+                if other is not isolated:
+                    transport.partition(isolated.id, other.id)
+            # Majority side keeps working.
+            index, _ = leader.apply_command(cmd("during-partition"))
+            transport.heal()
+            # Isolated node converges to the committed log.
+            fsm = fsms[nodes.index(isolated)]
+            assert wait_for(lambda: any(
+                v == "during-partition" for _, v in fsm.applied))
+        finally:
+            shutdown_all(nodes)
+
+
+class TestReplication:
+    def test_commands_replicate_to_all(self):
+        _, nodes, fsms = make_cluster(3)
+        try:
+            assert wait_for(lambda: leader_of(nodes) is not None)
+            leader = leader_of(nodes)
+            for i in range(10):
+                leader.apply_command(cmd(i))
+            for fsm in fsms:
+                assert wait_for(
+                    lambda f=fsm: [v for _, v in f.applied] == list(range(10)))
+        finally:
+            shutdown_all(nodes)
+
+    def test_apply_on_follower_raises_not_leader(self):
+        _, nodes, _ = make_cluster(3)
+        try:
+            assert wait_for(lambda: leader_of(nodes) is not None)
+            leader = leader_of(nodes)
+            follower = [n for n in nodes if n is not leader][0]
+            with pytest.raises(NotLeaderError) as exc:
+                follower.apply_command(cmd("nope"))
+            assert exc.value.leader_hint == leader.id
+        finally:
+            shutdown_all(nodes)
+
+    def test_lagging_follower_catches_up(self):
+        transport, nodes, fsms = make_cluster(3)
+        try:
+            assert wait_for(lambda: leader_of(nodes) is not None)
+            leader = leader_of(nodes)
+            lag = [n for n in nodes if n is not leader][0]
+            transport.take_down(lag.id)
+            for i in range(20):
+                leader.apply_command(cmd(i))
+            transport.bring_up(lag.id)
+            fsm = fsms[nodes.index(lag)]
+            assert wait_for(
+                lambda: [v for _, v in fsm.applied] == list(range(20)))
+        finally:
+            shutdown_all(nodes)
+
+    def test_barrier_commits_prior_terms(self):
+        _, nodes, _ = make_cluster(3)
+        try:
+            assert wait_for(lambda: leader_of(nodes) is not None)
+            leader = leader_of(nodes)
+            index = leader.barrier()
+            assert index >= 1
+            assert leader.applied_index >= 0
+            assert leader.commit_index >= index
+        finally:
+            shutdown_all(nodes)
+
+
+class TestSnapshot:
+    def test_snapshot_truncates_and_restores_lagger(self):
+        """A follower that falls behind a compacted log gets an
+        InstallSnapshot (reference role: raft snapshot + restore path,
+        fsm.go:430-551)."""
+        cfgs = [RaftConfig(heartbeat_interval=0.02,
+                           election_timeout_min=0.06,
+                           election_timeout_max=0.12,
+                           snapshot_threshold=10, trailing_logs=2)
+                for _ in range(3)]
+        transport, nodes, fsms = make_cluster(3, configs=cfgs)
+        try:
+            assert wait_for(lambda: leader_of(nodes) is not None)
+            leader = leader_of(nodes)
+            lag = [n for n in nodes if n is not leader][0]
+            transport.take_down(lag.id)
+            for i in range(30):
+                leader.apply_command(cmd(i))
+            leader.take_snapshot()
+            assert leader.log.first_index() > 1
+            transport.bring_up(lag.id)
+            fsm = fsms[nodes.index(lag)]
+            assert wait_for(
+                lambda: [v for _, v in fsm.applied][-1:] == [29], timeout=15)
+            # The restored follower state covers every command.
+            vals = [v for _, v in fsm.applied]
+            restored = fsms[nodes.index(lag)]
+            assert vals[-1] == 29
+        finally:
+            shutdown_all(nodes)
+
+
+class TestMembership:
+    def test_add_peer_replicates(self):
+        transport, nodes, fsms = make_cluster(3)
+        try:
+            assert wait_for(lambda: leader_of(nodes) is not None)
+            leader = leader_of(nodes)
+            # Boot a fourth node configured with no peers; it joins via
+            # config change (reference: Serf-driven AddPeer,
+            # leader.go:421-447).
+            fsm = AppendFSM()
+            newbie = RaftNode(
+                node_id="s3", peers=[n.id for n in nodes] + ["s3"],
+                log_store=InMemLogStore(),
+                transport=BoundTransport(transport, "s3"),
+                apply_fn=fsm.apply, snapshot_fn=fsm.snapshot,
+                restore_fn=fsm.restore, config=FAST)
+            newbie.start()
+            nodes.append(newbie)
+            fsms.append(fsm)
+            leader.add_peer("s3")
+            leader.apply_command(cmd("after-join"))
+            assert wait_for(lambda: any(
+                v == "after-join" for _, v in fsm.applied))
+            assert "s3" in leader.peers()
+        finally:
+            shutdown_all(nodes)
+
+    def test_remove_peer(self):
+        _, nodes, _ = make_cluster(3)
+        try:
+            assert wait_for(lambda: leader_of(nodes) is not None)
+            leader = leader_of(nodes)
+            victim = [n for n in nodes if n is not leader][0]
+            leader.remove_peer(victim.id)
+            assert wait_for(lambda: victim.id not in leader.peers())
+            # Two-node majority still commits.
+            leader.apply_command(cmd("post-remove"))
+        finally:
+            shutdown_all(nodes)
+
+
+class TestFileLogStore:
+    def test_roundtrip(self, tmp_path):
+        store = FileLogStore(str(tmp_path))
+        entries = [LogEntry(Index=i, Term=1, Type=EntryType.Command,
+                            Data=msgpack.packb(i)) for i in range(1, 11)]
+        store.store_entries(entries)
+        store.set_stable("term", 7)
+        store.store_snapshot(5, 1, b"snapdata")
+        store.close()
+
+        st2 = FileLogStore(str(tmp_path))
+        assert st2.first_index() == 1
+        assert st2.last_index() == 10
+        assert st2.get_entry(4).Data == msgpack.packb(4)
+        assert st2.get_stable("term") == 7
+        assert st2.latest_snapshot() == (5, 1, b"snapdata")
+        st2.delete_range(1, 5)
+        st2.close()
+
+        st3 = FileLogStore(str(tmp_path))
+        assert st3.first_index() == 6
+        assert st3.get_entry(3) is None
+        st3.close()
+
+    def test_torn_tail_write_dropped(self, tmp_path):
+        store = FileLogStore(str(tmp_path))
+        store.store_entries([LogEntry(Index=1, Term=1, Data=b"ok")])
+        store.close()
+        with open(str(tmp_path / "raft.log"), "ab") as fh:
+            fh.write(b"\xff\xff\xff\x7f partial")
+        st2 = FileLogStore(str(tmp_path))
+        assert st2.last_index() == 1
+        assert st2.get_entry(1).Data == b"ok"
+        st2.close()

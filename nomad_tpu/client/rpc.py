@@ -10,10 +10,11 @@ TCP) plugs in behind the same interface.
 from __future__ import annotations
 
 import threading
+import time
 from typing import Dict, List, Optional, Protocol, Tuple
 
 from nomad_tpu.state.watch import Item
-from nomad_tpu.structs import Allocation, Node
+from nomad_tpu.structs import Allocation, Node, from_dict, to_dict
 
 
 class ServerChannel(Protocol):
@@ -75,3 +76,119 @@ class InProcServerChannel:
 
     def update_allocs(self, allocs: List[Allocation]) -> None:
         self.server.node_update_allocs(allocs)
+
+
+class RpcProxy:
+    """Client-side server list manager: primary servers learned from
+    heartbeats, round-robin failover on error, manual backup seeds
+    (reference: client/rpcproxy/rpcproxy.go:88-135 FindServer /
+    NotifyFailedServer / RebalanceServers)."""
+
+    def __init__(self, servers: Optional[List[str]] = None):
+        self._lock = threading.Lock()
+        self._servers: List[str] = list(servers or [])
+
+    def servers(self) -> List[str]:
+        with self._lock:
+            return list(self._servers)
+
+    def find_server(self) -> Optional[str]:
+        with self._lock:
+            return self._servers[0] if self._servers else None
+
+    def notify_failed(self, addr: str) -> None:
+        """Rotate the failed server to the back (reference:
+        rpcproxy.go:355-377)."""
+        with self._lock:
+            if addr in self._servers:
+                self._servers.remove(addr)
+                self._servers.append(addr)
+
+    def update(self, servers: List[str]) -> None:
+        """Replace the primary list (from heartbeat NodeServerInfo,
+        reference: client.go:720+ / rpcproxy.go RefreshServerLists)."""
+        with self._lock:
+            keep = [s for s in self._servers if s in servers]
+            new = [s for s in servers if s not in keep]
+            self._servers = keep + new
+
+
+class NetServerChannel:
+    """ServerChannel over the wire: msgpack-RPC through a ConnPool with
+    rpcproxy failover (reference: the client's RPC path, client.go:332 +
+    rpcproxy). Works against any server — followers forward writes to the
+    leader server-side. Server membership is learned from register/heartbeat
+    responses (reference: NodeServerInfo, node_endpoint.go:194+)."""
+
+    # Ride out a leader election before surfacing NotLeaderError
+    # (reference: rpc.go ErrNoLeader retry with jitter).
+    NO_LEADER_RETRIES = 10
+    NO_LEADER_BACKOFF = 0.25
+
+    def __init__(self, servers: List[str]):
+        from nomad_tpu.rpc import ConnPool
+
+        self.pool = ConnPool()
+        self.proxy = RpcProxy(servers)
+
+    def _call(self, method: str, body: dict, timeout: Optional[float] = None):
+        from nomad_tpu.rpc.pool import RPCError
+
+        last_exc: Optional[Exception] = None
+        for attempt in range(self.NO_LEADER_RETRIES):
+            for _ in range(max(1, len(self.proxy.servers()))):
+                addr = self.proxy.find_server()
+                if addr is None:
+                    raise ConnectionError("no known servers")
+                try:
+                    return self.pool.call(addr, method, body, timeout=timeout)
+                except RPCError as exc:
+                    if exc.remote_type == "NotLeaderError":
+                        last_exc = exc
+                        break  # election window: back off, retry
+                    raise  # real remote error: failover won't help
+                except Exception as exc:  # transport: try the next server
+                    last_exc = exc
+                    self.proxy.notify_failed(addr)
+            else:
+                raise last_exc  # type: ignore[misc]  # all servers down
+            time.sleep(self.NO_LEADER_BACKOFF)
+        raise last_exc  # type: ignore[misc]
+
+    def _absorb_server_info(self, resp: Dict) -> None:
+        servers = resp.get("Servers") or []
+        if servers:
+            self.proxy.update(servers)
+
+    # ----------------------------------------------------- ServerChannel
+    def register_node(self, node: Node) -> float:
+        resp = self._call("Node.Register", {"Node": to_dict(node)})
+        self._absorb_server_info(resp)
+        return resp["HeartbeatTTL"]
+
+    def heartbeat(self, node_id: str) -> float:
+        resp = self._call("Node.Heartbeat", {"NodeID": node_id})
+        self._absorb_server_info(resp)
+        return resp["HeartbeatTTL"]
+
+    def update_node_status(self, node_id: str, status: str) -> float:
+        resp = self._call("Node.UpdateStatus",
+                          {"NodeID": node_id, "Status": status})
+        self._absorb_server_info(resp)
+        return resp["HeartbeatTTL"]
+
+    def get_client_allocs(self, node_id: str, min_index: int,
+                          max_wait: float) -> Tuple[Dict[str, int], int]:
+        resp = self._call("Node.GetClientAllocs",
+                          {"NodeID": node_id, "MinQueryIndex": min_index,
+                           "MaxQueryTime": max_wait},
+                          timeout=max_wait + 10.0)
+        return resp["Allocs"], resp["Index"]
+
+    def get_allocs(self, alloc_ids: List[str]) -> List[Allocation]:
+        resp = self._call("Alloc.GetAllocs", {"AllocIDs": alloc_ids})
+        return [from_dict(Allocation, a) for a in resp["Allocs"]]
+
+    def update_allocs(self, allocs: List[Allocation]) -> None:
+        self._call("Node.UpdateAlloc",
+                   {"Allocs": [to_dict(a) for a in allocs]})

@@ -1,0 +1,57 @@
+"""ClusterServer: a network-served control-plane node — RPC listener, raft
+over TCP, endpoint dispatch — the composition the reference performs in
+NewServer (nomad/server.go:166-263: setupRPC + setupRaft on one port).
+
+Two-phase boot because raft peers are addresses: bind the listener first
+(learning the port), then `connect(peers)` to build the Server and start
+serving. Gossip-driven joins use the raft membership API afterwards.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+from .endpoints import Endpoints
+from .pool import ConnPool
+from .server import RPCServer
+from .transport import TCPTransport
+
+
+class ClusterServer:
+    def __init__(self, config, bind_addr: str = "127.0.0.1", port: int = 0):
+        self.config = config
+        self.rpc_server = RPCServer(bind_addr, port)
+        self.addr = self.rpc_server.addr
+        config.node_id = self.addr
+        self.server = None
+        self.endpoints: Optional[Endpoints] = None
+        self.transport: Optional[TCPTransport] = None
+
+    def connect(self, peers: List[str], log_store=None, raft_config=None,
+                region_router=None, region_lister=None) -> None:
+        from nomad_tpu.server.server import Server
+
+        self.transport = TCPTransport()
+        self.server = Server(self.config, transport=self.transport,
+                             peers=list(peers), log_store=log_store,
+                             raft_config=raft_config)
+        self.endpoints = Endpoints(self.server,
+                                   region_router=region_router,
+                                   region_lister=region_lister)
+        self.rpc_server.rpc_handler = self.endpoints.handle
+        self.rpc_server.raft_handler = self.transport.handle
+
+    def start(self) -> None:
+        if self.server is None:
+            raise RuntimeError("connect() before start()")
+        self.rpc_server.start()
+        self.server.start()
+
+    def shutdown(self) -> None:
+        if self.server is not None:
+            self.server.shutdown()
+        self.rpc_server.shutdown()
+        if self.endpoints is not None:
+            self.endpoints.pool.close()
+        if self.transport is not None:
+            self.transport.pool.close()

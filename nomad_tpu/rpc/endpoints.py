@@ -1,0 +1,406 @@
+"""RPC endpoints: the wire surface of a Server (reference:
+nomad/*_endpoint.go services registered in server.go:152-162, with region +
+leader forwarding from rpc.go:177-242 and watch-based blocking queries from
+rpc.go:294-349).
+
+All bodies are plain msgpack-able data; structs cross as their codec dicts.
+Every handler runs on the receiving server; writes hit the raft seam and
+raise NotLeaderError on followers, which `handle` turns into one forwarding
+hop to the current leader (node ids are "host:port" addresses).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from nomad_tpu.raft.node import NotLeaderError
+from nomad_tpu.state.watch import Item
+from nomad_tpu.structs import (
+    Allocation,
+    Evaluation,
+    Job,
+    Node,
+    from_dict,
+    to_dict,
+)
+
+from .pool import ConnPool, RPCError
+
+MAX_BLOCK_TIME = 300.0  # reference: rpc.go:33-47 maxQueryTime
+
+
+class NoRegionPathError(Exception):
+    pass
+
+
+def blocking_query(state, items: List[Item], min_index: int,
+                   max_wait: float,
+                   run: Callable[[], Tuple[Any, int]]) -> Tuple[Any, int]:
+    """Run `run` until its index passes min_index or the wait expires
+    (reference: blockingRPC, rpc.go:294-349). `run` returns (result, index).
+    """
+    max_wait = min(max_wait, MAX_BLOCK_TIME)
+    deadline = time.monotonic() + max_wait
+    if min_index <= 0:
+        return run()
+    event = threading.Event()
+    state.watch(items, event)
+    try:
+        while True:
+            result, index = run()
+            if index > min_index:
+                return result, index
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                return result, index
+            event.clear()
+            event.wait(remaining)
+    finally:
+        state.stop_watch(items, event)
+
+
+class Endpoints:
+    """Dispatch table + forwarding wrapper around one Server."""
+
+    def __init__(self, server, pool: Optional[ConnPool] = None,
+                 region_router: Optional[Callable[[str], Optional[str]]] = None,
+                 region_lister: Optional[Callable[[], List[str]]] = None):
+        self.server = server
+        self.pool = pool or ConnPool()
+        # region -> a server address in that region (gossip fills this in;
+        # reference: Server.peers map fed by Serf, server.go:100-104).
+        self.region_router = region_router
+        self.region_lister = region_lister
+        self._methods: Dict[str, Callable[[Dict[str, Any]], Any]] = {
+            "Status.Ping": self.status_ping,
+            "Status.Leader": self.status_leader,
+            "Status.Peers": self.status_peers,
+            "Job.Register": self.job_register,
+            "Job.Deregister": self.job_deregister,
+            "Job.GetJob": self.job_get,
+            "Job.List": self.job_list,
+            "Job.Allocations": self.job_allocations,
+            "Job.Evaluations": self.job_evaluations,
+            "Job.Evaluate": self.job_evaluate,
+            "Job.Plan": self.job_plan,
+            "Periodic.Force": self.periodic_force,
+            "Node.Register": self.node_register,
+            "Node.Heartbeat": self.node_heartbeat,
+            "Node.UpdateStatus": self.node_update_status,
+            "Node.UpdateDrain": self.node_update_drain,
+            "Node.Deregister": self.node_deregister,
+            "Node.Evaluate": self.node_evaluate,
+            "Node.GetNode": self.node_get,
+            "Node.List": self.node_list,
+            "Node.GetAllocs": self.node_get_allocs,
+            "Node.GetClientAllocs": self.node_get_client_allocs,
+            "Node.UpdateAlloc": self.node_update_alloc,
+            "Eval.GetEval": self.eval_get,
+            "Eval.List": self.eval_list,
+            "Eval.Allocations": self.eval_allocations,
+            "Eval.Dequeue": self.eval_dequeue,
+            "Eval.Ack": self.eval_ack,
+            "Eval.Nack": self.eval_nack,
+            "Alloc.List": self.alloc_list,
+            "Alloc.GetAlloc": self.alloc_get,
+            "Alloc.GetAllocs": self.alloc_get_many,
+            "Region.List": self.region_list,
+            "System.GC": self.system_gc,
+        }
+
+    # ------------------------------------------------------------- dispatch
+    def handle(self, method: str, body: Any) -> Any:
+        body = dict(body or {})
+        region = body.get("Region") or self.server.config.region
+        if region != self.server.config.region:
+            return self._forward_region(region, method, body)
+        try:
+            return self._methods[method](body)
+        except NotLeaderError as exc:
+            return self._forward_leader(method, body, exc)
+
+    def _forward_region(self, region: str, method: str,
+                        body: Dict[str, Any]) -> Any:
+        """(reference: forwardRegion, rpc.go:223-242)"""
+        addr = self.region_router(region) if self.region_router else None
+        if addr is None:
+            raise NoRegionPathError(f"no path to region {region}")
+        return self.pool.call(addr, method, body)
+
+    def _forward_leader(self, method: str, body: Dict[str, Any],
+                        exc: NotLeaderError) -> Any:
+        """(reference: forward leader hop, rpc.go:177-221)"""
+        if body.get("Forwarded"):
+            raise exc
+        leader = exc.leader_hint or getattr(self.server.raft, "leader_id",
+                                            None)
+        if not leader or leader == getattr(self.server.config, "node_id", ""):
+            raise exc
+        body = dict(body)
+        body["Forwarded"] = True
+        return self.pool.call(leader, method, body)
+
+    # --------------------------------------------------------------- status
+    def status_ping(self, body) -> bool:
+        return True
+
+    def status_leader(self, body) -> str:
+        raft = self.server.raft
+        return getattr(raft, "leader_id", None) or ""
+
+    def status_peers(self, body) -> List[str]:
+        raft = self.server.raft
+        if hasattr(raft, "node"):
+            return raft.node.peers()
+        return [self.server.config.node_id or "dev"]
+
+    # ------------------------------------------------------------------ job
+    def job_register(self, body) -> Dict[str, Any]:
+        job = from_dict(Job, body["Job"])
+        enforce = body.get("EnforceIndex")
+        eval_id, jmi, index = self.server.job_register(
+            job, enforce_index=enforce)
+        return {"EvalID": eval_id, "JobModifyIndex": jmi, "Index": index}
+
+    def job_deregister(self, body) -> Dict[str, Any]:
+        eval_id, index = self.server.job_deregister(body["JobID"])
+        return {"EvalID": eval_id, "Index": index}
+
+    def job_get(self, body) -> Dict[str, Any]:
+        state = self.server.state
+
+        def run():
+            job = state.job_by_id(body["JobID"])
+            return (to_dict(job) if job else None,
+                    state.get_index("jobs"))
+
+        result, index = blocking_query(
+            state, [Item(job=body["JobID"])],
+            body.get("MinQueryIndex", 0), body.get("MaxQueryTime", 0), run)
+        return {"Job": result, "Index": index}
+
+    def job_list(self, body) -> Dict[str, Any]:
+        state = self.server.state
+
+        def run():
+            jobs = [to_dict(j) for j in state.jobs()]
+            return jobs, state.get_index("jobs")
+
+        result, index = blocking_query(
+            state, [Item(table="jobs")],
+            body.get("MinQueryIndex", 0), body.get("MaxQueryTime", 0), run)
+        return {"Jobs": result, "Index": index}
+
+    def job_allocations(self, body) -> Dict[str, Any]:
+        state = self.server.state
+
+        def run():
+            allocs = state.allocs_by_job(body["JobID"])
+            idx = max([a.ModifyIndex for a in allocs],
+                      default=state.get_index("allocs"))
+            return [to_dict(a) for a in allocs], idx
+
+        result, index = blocking_query(
+            state, [Item(alloc_job=body["JobID"])],
+            body.get("MinQueryIndex", 0), body.get("MaxQueryTime", 0), run)
+        return {"Allocations": result, "Index": index}
+
+    def job_evaluations(self, body) -> Dict[str, Any]:
+        state = self.server.state
+        evals = state.evals_by_job(body["JobID"])
+        return {"Evaluations": [to_dict(e) for e in evals],
+                "Index": state.get_index("evals")}
+
+    def job_evaluate(self, body) -> Dict[str, Any]:
+        eval_id, index = self.server.job_evaluate(body["JobID"])
+        return {"EvalID": eval_id, "Index": index}
+
+    def job_plan(self, body) -> Dict[str, Any]:
+        job = from_dict(Job, body["Job"])
+        resp = self.server.job_plan(job, want_diff=body.get("Diff", True))
+        return to_dict(resp)
+
+    def periodic_force(self, body) -> Dict[str, Any]:
+        self.server.periodic_force(body["JobID"])
+        return {}
+
+    # ----------------------------------------------------------------- node
+    def _server_info(self) -> Dict[str, Any]:
+        """Server list piggybacked on heartbeat responses so clients track
+        cluster membership (reference: NodeServerInfo in UpdateStatus
+        replies, node_endpoint.go:194+)."""
+        return {"LeaderRPCAddr": self.status_leader({}),
+                "Servers": self.status_peers({})}
+
+    def node_register(self, body) -> Dict[str, Any]:
+        node = from_dict(Node, body["Node"])
+        ttl, index = self.server.node_register(node)
+        return {"HeartbeatTTL": ttl, "Index": index, **self._server_info()}
+
+    def node_heartbeat(self, body) -> Dict[str, Any]:
+        """TTL refresh only — no raft write (reference: UpdateStatus with
+        unchanged status skips the raft apply, node_endpoint.go:194-235).
+        Heartbeat timers live on the leader (heartbeat.go), so forward."""
+        if not self.server.is_leader():
+            raise NotLeaderError(self.status_leader(body) or None)
+        ttl = self.server.node_heartbeat(body["NodeID"])
+        return {"HeartbeatTTL": ttl, **self._server_info()}
+
+    def node_update_status(self, body) -> Dict[str, Any]:
+        ttl, index = self.server.node_update_status(
+            body["NodeID"], body["Status"])
+        return {"HeartbeatTTL": ttl, "Index": index, **self._server_info()}
+
+    def node_update_drain(self, body) -> Dict[str, Any]:
+        index = self.server.node_update_drain(body["NodeID"], body["Drain"])
+        return {"Index": index}
+
+    def node_deregister(self, body) -> Dict[str, Any]:
+        index = self.server.node_deregister(body["NodeID"])
+        return {"Index": index}
+
+    def node_evaluate(self, body) -> Dict[str, Any]:
+        eval_ids = self.server.node_evaluate(body["NodeID"])
+        return {"EvalIDs": eval_ids}
+
+    def node_get(self, body) -> Dict[str, Any]:
+        state = self.server.state
+
+        def run():
+            node = state.node_by_id(body["NodeID"])
+            return (to_dict(node) if node else None,
+                    state.get_index("nodes"))
+
+        result, index = blocking_query(
+            state, [Item(node=body["NodeID"])],
+            body.get("MinQueryIndex", 0), body.get("MaxQueryTime", 0), run)
+        return {"Node": result, "Index": index}
+
+    def node_list(self, body) -> Dict[str, Any]:
+        state = self.server.state
+
+        def run():
+            nodes = [to_dict(n) for n in state.nodes()]
+            return nodes, state.get_index("nodes")
+
+        result, index = blocking_query(
+            state, [Item(table="nodes")],
+            body.get("MinQueryIndex", 0), body.get("MaxQueryTime", 0), run)
+        return {"Nodes": result, "Index": index}
+
+    def node_get_allocs(self, body) -> Dict[str, Any]:
+        """Full allocations for a node, blocking (reference:
+        node_endpoint.go:416-472 GetAllocs)."""
+        state = self.server.state
+
+        def run():
+            allocs = state.allocs_by_node(body["NodeID"])
+            idx = max([a.ModifyIndex for a in allocs],
+                      default=state.get_index("allocs"))
+            return [to_dict(a) for a in allocs], idx
+
+        result, index = blocking_query(
+            state, [Item(alloc_node=body["NodeID"])],
+            body.get("MinQueryIndex", 0), body.get("MaxQueryTime", 0), run)
+        return {"Allocs": result, "Index": index}
+
+    def node_get_client_allocs(self, body) -> Dict[str, Any]:
+        """alloc_id -> AllocModifyIndex map, blocking — the client's cheap
+        pull signal (reference: node_endpoint.go:474-528)."""
+        state = self.server.state
+        node_id = body["NodeID"]
+
+        def run():
+            allocs = state.allocs_by_node(node_id)
+            index = max([a.AllocModifyIndex for a in allocs],
+                        default=state.get_index("allocs"))
+            return {a.ID: a.AllocModifyIndex for a in allocs}, index
+
+        result, index = blocking_query(
+            state, [Item(alloc_node=node_id)],
+            body.get("MinQueryIndex", 0), body.get("MaxQueryTime", 0), run)
+        return {"Allocs": result, "Index": index}
+
+    def node_update_alloc(self, body) -> Dict[str, Any]:
+        allocs = [from_dict(Allocation, a) for a in body["Allocs"]]
+        index = self.server.node_update_allocs(allocs)
+        return {"Index": index}
+
+    # ----------------------------------------------------------------- eval
+    def eval_get(self, body) -> Dict[str, Any]:
+        state = self.server.state
+
+        def run():
+            ev = state.eval_by_id(body["EvalID"])
+            return (to_dict(ev) if ev else None,
+                    state.get_index("evals"))
+
+        result, index = blocking_query(
+            state, [Item(eval=body["EvalID"])],
+            body.get("MinQueryIndex", 0), body.get("MaxQueryTime", 0), run)
+        return {"Eval": result, "Index": index}
+
+    def eval_list(self, body) -> Dict[str, Any]:
+        state = self.server.state
+        return {"Evaluations": [to_dict(e) for e in state.evals()],
+                "Index": state.get_index("evals")}
+
+    def eval_allocations(self, body) -> Dict[str, Any]:
+        state = self.server.state
+        allocs = state.allocs_by_eval(body["EvalID"])
+        return {"Allocations": [to_dict(a) for a in allocs],
+                "Index": state.get_index("allocs")}
+
+    def eval_dequeue(self, body) -> Dict[str, Any]:
+        """(reference: eval_endpoint.go:68 — leader-brokered dequeue)"""
+        if not self.server.eval_broker.enabled():
+            raise NotLeaderError(self.status_leader(body) or None)
+        ev, token = self.server.eval_broker.dequeue(
+            body["Schedulers"], body.get("Timeout", 0.5))
+        return {"Eval": to_dict(ev) if ev else None, "Token": token}
+
+    def eval_ack(self, body) -> Dict[str, Any]:
+        self.server.eval_broker.ack(body["EvalID"], body["Token"])
+        return {}
+
+    def eval_nack(self, body) -> Dict[str, Any]:
+        self.server.eval_broker.nack(body["EvalID"], body["Token"])
+        return {}
+
+    # ---------------------------------------------------------------- alloc
+    def alloc_list(self, body) -> Dict[str, Any]:
+        state = self.server.state
+
+        def run():
+            allocs = [to_dict(a) for a in state.allocs()]
+            return allocs, state.get_index("allocs")
+
+        result, index = blocking_query(
+            state, [Item(table="allocs")],
+            body.get("MinQueryIndex", 0), body.get("MaxQueryTime", 0), run)
+        return {"Allocations": result, "Index": index}
+
+    def alloc_get(self, body) -> Dict[str, Any]:
+        state = self.server.state
+        alloc = state.alloc_by_id(body["AllocID"])
+        return {"Alloc": to_dict(alloc) if alloc else None,
+                "Index": state.get_index("allocs")}
+
+    def alloc_get_many(self, body) -> Dict[str, Any]:
+        state = self.server.state
+        allocs = [state.alloc_by_id(aid) for aid in body["AllocIDs"]]
+        return {"Allocs": [to_dict(a) for a in allocs if a is not None],
+                "Index": state.get_index("allocs")}
+
+    # --------------------------------------------------------------- region
+    def region_list(self, body) -> List[str]:
+        if self.region_lister is not None:
+            return sorted(self.region_lister())
+        return [self.server.config.region]
+
+    # --------------------------------------------------------------- system
+    def system_gc(self, body) -> Dict[str, Any]:
+        self.server.force_gc()
+        return {}

@@ -388,6 +388,10 @@ class RaftNode:
                 self._replicate_once(peer)
             except TransportError:
                 pass
+            except Exception:
+                # A replicator thread must never die permanently; log and
+                # retry on the next pacing tick.
+                LOG.exception("replication to %s failed", peer)
             with self._lock:
                 if self._shutdown or self._role != LEADER:
                     return
@@ -406,14 +410,18 @@ class RaftNode:
                              and (first == 0 or next_idx < first))
             if need_snapshot:
                 snap = self.log.latest_snapshot()
+                if snap is None:
+                    # Log compacted past next_idx but no snapshot on disk yet
+                    # (store_snapshot in flight): retry on the next tick.
+                    return
             else:
                 prev_idx = next_idx - 1
                 prev_term = self._term_at(prev_idx)
                 if prev_term is None:
                     snap = self.log.latest_snapshot()
-                    need_snapshot = snap is not None
-                    if not need_snapshot:
+                    if snap is None:
                         return
+                    need_snapshot = True
                 else:
                     hi = min(self.log.last_index(),
                              next_idx + self.config.max_append_entries - 1)
@@ -500,13 +508,19 @@ class RaftNode:
                 raise NotLeaderError(self._leader_id)
             index = self._append_locked(EntryType.Command, data)
             self._futures[index] = fut
+        self._wait_applied(index, fut, timeout, "apply")
+        return index, fut.result
+
+    def _wait_applied(self, index: int, fut: _Future,
+                      timeout: Optional[float], what: str) -> None:
+        """Block until the entry at `index` is applied (future resolved);
+        drop the future on timeout so it cannot leak."""
         if not fut.event.wait(timeout or self.config.apply_timeout):
             with self._lock:
                 self._futures.pop(index, None)
-            raise ApplyTimeout(f"apply of index {index} timed out")
+            raise ApplyTimeout(f"{what} at index {index} timed out")
         if fut.error is not None:
             raise fut.error
-        return index, fut.result
 
     def barrier(self, timeout: Optional[float] = None) -> int:
         """Append + commit a noop; returns its index once applied
@@ -517,43 +531,43 @@ class RaftNode:
                 raise NotLeaderError(self._leader_id)
             index = self._append_locked(EntryType.Noop, b"")
             self._futures[index] = fut
-        if not fut.event.wait(timeout or self.config.apply_timeout):
-            raise ApplyTimeout("barrier timed out")
-        if fut.error is not None:  # lost leadership mid-barrier
-            raise fut.error
+        self._wait_applied(index, fut, timeout, "barrier")
         return index
 
     # ----------------------------------------------------------- membership
     def add_peer(self, peer_id: str, timeout: Optional[float] = None) -> None:
         """Single-server membership change (reference: raft.AddPeer driven by
         Serf reconciliation, nomad/leader.go:421-447)."""
-        with self._lock:
-            if self._role != LEADER:
-                raise NotLeaderError(self._leader_id)
-            if peer_id in self._peers:
-                return
-            peers = self._peers + [peer_id]
-        self._config_change(peers, timeout)
+        self._config_change(
+            lambda peers: peers + [peer_id] if peer_id not in peers else None,
+            timeout)
 
     def remove_peer(self, peer_id: str,
                     timeout: Optional[float] = None) -> None:
         """(reference: raft.RemovePeer, nomad/leader.go:449-459)"""
-        with self._lock:
-            if self._role != LEADER:
-                raise NotLeaderError(self._leader_id)
-            if peer_id not in self._peers:
-                return
-            peers = [p for p in self._peers if p != peer_id]
-        self._config_change(peers, timeout)
+        self._config_change(
+            lambda peers: [p for p in peers if p != peer_id]
+            if peer_id in peers else None,
+            timeout)
 
-    def _config_change(self, peers: List[str],
+    def _config_change(self, mutate: Callable[[List[str]],
+                                              Optional[List[str]]],
                        timeout: Optional[float]) -> None:
         fut = _Future()
-        data = msgpack.packb(peers, use_bin_type=True)
         with self._lock:
+            # Leadership check, peer-base read, and append all happen in one
+            # critical section: a stale base would let two concurrent
+            # membership changes silently drop one, and a now-follower must
+            # not write an entry the consistency check would never truncate.
+            if self._role != LEADER:
+                raise NotLeaderError(self._leader_id)
+            peers = mutate(list(self._peers))
+            if peers is None:  # already in the desired state
+                return
+            data = msgpack.packb(peers, use_bin_type=True)
             index = self._append_locked(EntryType.Config, data)
             self._futures[index] = fut
-        fut.event.wait(timeout or self.config.apply_timeout)
+        self._wait_applied(index, fut, timeout, "config change")
 
     # ------------------------------------------------------------ RPC sides
     def _handle_rpc(self, method: str, payload: Dict[str, Any]
@@ -645,7 +659,10 @@ class RaftNode:
                                       self.log.last_index())
                 meta = msgpack.unpackb(blob, raw=False)
                 self._snap_index, self._snap_term = index, term
-                self._commit_index = self._last_applied = index
+                # Never regress a commit index that is already ahead of the
+                # snapshot (possible when AppendEntries advanced it first).
+                self._commit_index = max(self._commit_index, index)
+                self._last_applied = index
                 self._applied_since_snap = 0
                 if meta.get("peers"):
                     self._set_peers_locked(meta["peers"])
@@ -666,6 +683,12 @@ class RaftNode:
                 lo = self._last_applied + 1
                 hi = self._commit_index
                 entries = self.log.get_range(lo, hi)
+                if not entries:
+                    # commit_index can run ahead of the local log right after
+                    # an InstallSnapshot wiped it; wait for replication to
+                    # refill instead of busy-spinning.
+                    self._apply_cond.wait(timeout=0.05)
+                    continue
             for e in entries:
                 # _fsm_lock serializes apply_fn with InstallSnapshot's
                 # restore_fn; the index recheck discards batch entries a
@@ -705,14 +728,26 @@ class RaftNode:
             if (self.snapshot_fn is None
                     or self._applied_since_snap < self.config.snapshot_threshold):
                 return
-            index = self._last_applied
-            term = self._term_at(index) or self._term
-            peers = list(self._peers)
-            self._applied_since_snap = 0
-        data = self.snapshot_fn()
+        # _fsm_lock first (same order as the apply loop / InstallSnapshot) so
+        # the snapshot blob and its recorded index cannot tear across a
+        # concurrent apply_fn/restore_fn — restore would otherwise re-apply
+        # entries the blob already contains.
+        with self._fsm_lock:
+            with self._lock:
+                if (self.snapshot_fn is None
+                        or self._applied_since_snap
+                        < self.config.snapshot_threshold):
+                    return
+                index = self._last_applied
+                term = self._term_at(index) or self._term
+                peers = list(self._peers)
+                self._applied_since_snap = 0
+            data = self.snapshot_fn()
         blob = msgpack.packb({"data": data, "peers": peers},
                              use_bin_type=True)
         with self._lock:
+            if index <= self._snap_index:
+                return
             self.log.store_snapshot(index, term, blob)
             self._snap_index, self._snap_term = index, term
             keep_from = max(self.log.first_index(),

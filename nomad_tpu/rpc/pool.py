@@ -120,14 +120,25 @@ class ConnPool:
         self.call_timeout = call_timeout
         self._conns: Dict[str, _Conn] = {}
         self._lock = threading.Lock()
+        self._addr_locks: Dict[str, threading.Lock] = {}
 
     def _get(self, addr: str) -> _Conn:
         with self._lock:
             conn = self._conns.get(addr)
             if conn is not None and not conn._dead:
                 return conn
+            addr_lock = self._addr_locks.setdefault(addr, threading.Lock())
+        # Connect outside the pool-wide lock: raft heartbeats to every peer
+        # share this pool, so a hung connect to one partitioned address must
+        # not stall calls to healthy peers for connect_timeout seconds.
+        with addr_lock:
+            with self._lock:
+                conn = self._conns.get(addr)
+                if conn is not None and not conn._dead:
+                    return conn
             conn = _Conn(addr, self.stream_type, self.connect_timeout)
-            self._conns[addr] = conn
+            with self._lock:
+                self._conns[addr] = conn
             return conn
 
     def call(self, addr: str, method: str, body: Any = None,

@@ -12,8 +12,10 @@ from __future__ import annotations
 
 import enum
 import threading
+import time
 from typing import Any, Callable, Dict, List, Optional
 
+from nomad_tpu.server.timetable import TimeTable
 from nomad_tpu.state.state_store import StateStore
 from nomad_tpu.structs import (
     Allocation,
@@ -53,6 +55,10 @@ class FSM:
 
     def __init__(self, state: Optional[StateStore] = None):
         self.state = state or StateStore()
+        # Every replica witnesses (index, time) on apply so a new leader has
+        # a populated index<->time map after failover (reference: fsm.go:147
+        # witnesses in Apply; fsm.go:430-551 persists it in the snapshot).
+        self.timetable = TimeTable()
         # Leader-side observers (broker, blocked evals, periodic dispatch)
         # registered by the server when it holds leadership.
         self.on_eval_update: Optional[Callable[[Evaluation], None]] = None
@@ -63,6 +69,7 @@ class FSM:
 
     def apply(self, index: int, msg_type: MessageType, payload: Dict[str, Any]) -> Any:
         """(reference: fsm.go:99-144 Apply dispatch)"""
+        self.timetable.witness(index, time.time())
         handler = _HANDLERS[msg_type]
         return handler(self, index, payload)
 
@@ -174,6 +181,7 @@ class FSM:
             "indexes": {t: snap.get_index(t)
                         for t in ("nodes", "jobs", "evals", "allocs",
                                   "periodic_launch")},
+            "timetable": self.timetable.serialize(),
         }
 
     def restore(self, data: Dict[str, Any]) -> None:
@@ -192,6 +200,8 @@ class FSM:
         for t, idx in data.get("indexes", {}).items():
             r.index_restore(t, idx)
         r.commit()
+        if data.get("timetable"):
+            self.timetable.deserialize(data["timetable"])
 
 
 _HANDLERS = {

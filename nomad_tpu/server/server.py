@@ -57,7 +57,6 @@ from .heartbeat import HeartbeatTimers
 from .periodic import PeriodicDispatch, derive_job, derived_job_id
 from .plan_apply import PlanApplier
 from .plan_queue import PlanQueue
-from .timetable import TimeTable
 from .worker import Worker
 
 logger = logging.getLogger("nomad.server")
@@ -124,7 +123,9 @@ class Server:
         self.plan_queue = PlanQueue()
         self.plan_applier = PlanApplier(self.plan_queue, self.raft,
                                         self.eval_broker)
-        self.timetable = TimeTable()
+        # Owned by the FSM so it is persisted in snapshots and rebuilt from
+        # apply on every replica (survives leader failover).
+        self.timetable = self.fsm.timetable
         self.core_sched = CoreScheduler(
             self.raft, self.timetable,
             eval_gc_threshold=self.config.eval_gc_threshold,
@@ -260,7 +261,6 @@ class Server:
                 self.blocked_evals.reblock(ev, token)
             else:
                 self.blocked_evals.block(ev)
-        self.timetable.witness(ev.ModifyIndex, time.time())
 
     def _on_node_ready(self, node: Node) -> None:
         self.blocked_evals.unblock(node.ComputedClass, node.ModifyIndex)

@@ -287,6 +287,35 @@ class TestTimeTable:
         assert tt.nearest_index(2500.0) == 200
         assert tt.nearest_index(500.0) == 0
 
+    def test_fsm_apply_witnesses_timetable(self):
+        fsm = FSM()
+        raft = DevRaft(fsm)
+        raft.apply(MessageType.NodeRegister, {"Node": mock.node()})
+        assert fsm.timetable.nearest_index(time.time() + 1) > 0
+
+    def test_timetable_survives_snapshot_restore(self):
+        """GC thresholds depend on the index<->time map; after a failover
+        restore the new leader must still translate times to indexes
+        (reference: fsm.go:430-551 persists the timetable)."""
+        now = time.time()
+        fsm = FSM()
+        fsm.timetable.witness(100, now - 2000.0)
+        fsm.timetable.witness(200, now - 1000.0)
+        raft = DevRaft(fsm)
+        raft.apply(MessageType.NodeRegister, {"Node": mock.node()})
+        snap = fsm.snapshot()
+
+        fsm2 = FSM()
+        fsm2.restore(snap)
+        assert fsm2.timetable.nearest_index(now - 1500.0) == 100
+        assert fsm2.timetable.nearest_index(now - 500.0) == 200
+        # And it round-trips through msgpack like the raft snapshot path.
+        import msgpack
+        blob = msgpack.packb(snap, use_bin_type=True)
+        fsm3 = FSM()
+        fsm3.restore(msgpack.unpackb(blob, raw=False))
+        assert fsm3.timetable.nearest_index(now - 1500.0) == 100
+
     def test_granularity_dedupe(self):
         tt = TimeTable(granularity=10.0)
         tt.witness(1, 100.0)

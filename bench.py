@@ -1,13 +1,18 @@
 #!/usr/bin/env python
-"""Benchmark: scheduling throughput, TPU placement path vs CPU reference.
+"""Benchmark: end-to-end scheduling throughput on the SERVED path.
 
-BASELINE.json config 3: 10k nodes x 5k task-group placements with driver +
-attribute constraint checkers, 64 node-meta partitions (the reference's
-computed-class benchmark shape, scheduler/stack_test.go:13-53). Measures
-end-to-end evaluations/sec through the TPU placement path (eligibility
-assembly + place_batch scan + host result handling) against the reference
-algorithm (iterator chain with class memoization + log2 limit) running
-host-side, at identical workloads.
+Headline (BASELINE.json config 3): 10k nodes x 5k task-group placements with
+driver + attribute constraint checkers, 64 node-meta partitions — measured
+END-TO-END through a live server: job_register -> raft apply -> eval broker ->
+pipelined worker (device-chained placement windows, server/pipelined_worker.py)
+-> plan applier re-verification -> committed allocations in the state store.
+
+Detail additionally reports:
+  - the placer-only device-pipeline number (scheduler/pipeline.py) — the
+    ceiling the served path is converging to
+  - BASELINE.json config 5: 50k nodes x 20k task groups, multi-DC, through
+    the placement pipeline
+  - the CPU reference (iterator-chain re-implementation) for vs_baseline
 
 Prints ONE json line: {"metric", "value", "unit", "vs_baseline"}.
 """
@@ -27,9 +32,12 @@ N_PLACEMENTS = int(os.environ.get("BENCH_PLACEMENTS", 5_000))
 PER_EVAL = int(os.environ.get("BENCH_PER_EVAL", 50))
 N_PARTITIONS = 64
 CPU_REF_EVALS = int(os.environ.get("BENCH_CPU_EVALS", 8))
+C5_NODES = int(os.environ.get("BENCH_C5_NODES", 50_000))
+C5_PLACEMENTS = int(os.environ.get("BENCH_C5_PLACEMENTS", 20_000))
+RUN_C5 = os.environ.get("BENCH_C5", "1") != "0"
 
 
-def build_nodes(n):
+def build_nodes(n, n_dcs=1):
     from nomad_tpu import mock
     from nomad_tpu.structs import compute_node_class
 
@@ -37,34 +45,92 @@ def build_nodes(n):
     for i in range(n):
         node = mock.node()
         node.Meta["rack"] = f"r{i % N_PARTITIONS}"  # 64 computed classes
+        if n_dcs > 1:
+            node.Datacenter = f"dc{i % n_dcs + 1}"
         compute_node_class(node)
         nodes.append(node)
     return nodes
 
 
-def build_job():
+def build_job(per_eval=PER_EVAL, dcs=None):
     from nomad_tpu import mock
     from nomad_tpu.structs import Constraint
 
     job = mock.job()
+    if dcs:
+        job.Datacenters = list(dcs)
     tg = job.TaskGroups[0]
-    tg.Count = PER_EVAL
+    tg.Count = per_eval
     # Driver checker (exec) is already on the mock task; add an attribute
     # constraint so the full checker chain runs (BASELINE config 3).
     job.Constraints.append(
         Constraint(LTarget="${attr.arch}", RTarget="x86", Operand="="))
-    # Small asks so 10k nodes absorb 5k placements without exhaustion.
+    # Small asks so the node pool absorbs the placements without exhaustion.
     task = tg.Tasks[0]
     task.Resources.CPU = 20
     task.Resources.MemoryMB = 32
     task.Resources.DiskMB = 10
     task.Resources.Networks = []
+    task.Services = []
+    # Keep per-task log storage under the small disk ask (validation:
+    # LogConfig total must fit DiskMB).
+    if task.LogConfig is not None:
+        task.LogConfig.MaxFiles = 1
+        task.LogConfig.MaxFileSizeMB = 1
     return job
 
 
-def bench_tpu(nodes, n_evals):
-    """TPU throughput path: device-resident usage chaining + streamed
-    readbacks (nomad_tpu/scheduler/pipeline.py)."""
+def bench_server_e2e(nodes, n_evals):
+    """The SERVED path: a live dev-mode server with the pipelined worker.
+    Clock runs from first job_register to the last eval completing with its
+    allocations committed in the state store."""
+    from nomad_tpu.server import Server, ServerConfig
+    from nomad_tpu.structs.structs import EvalStatusComplete
+
+    # Benchmark nodes never heartbeat: park the TTLs out past the run.
+    srv = Server(ServerConfig(num_schedulers=1, pipelined_scheduling=True,
+                              scheduler_window=64,
+                              min_heartbeat_ttl=24 * 3600.0,
+                              heartbeat_grace=24 * 3600.0))
+    srv.establish_leadership()
+    try:
+        for node in nodes:
+            srv.node_register(node)
+
+        def run(count):
+            eval_ids = [srv.job_register(build_job())[0]
+                        for _ in range(count)]
+            deadline = time.monotonic() + 600
+            pending = set(eval_ids)
+            while pending and time.monotonic() < deadline:
+                done = {eid for eid in pending
+                        if (e := srv.state.eval_by_id(eid)) is not None
+                        and e.Status == EvalStatusComplete}
+                pending -= done
+                if pending:
+                    time.sleep(0.005)
+            if pending:
+                raise RuntimeError(f"{len(pending)} evals never completed")
+            return eval_ids
+
+        # Warmup: compile placement kernels for this shape bucket.
+        run(3)
+
+        t0 = time.perf_counter()
+        eval_ids = run(n_evals)
+        elapsed = time.perf_counter() - t0
+
+        placed = sum(
+            1 for eid in eval_ids
+            for a in srv.state.allocs_by_eval(eid))
+        stats = dict(srv.workers[0].stats)
+        return n_evals / elapsed, placed, stats
+    finally:
+        srv.shutdown()
+
+
+def bench_placer(nodes, n_evals, per_eval=PER_EVAL, dcs=None):
+    """Placer-only device pipeline: the ceiling (no raft/plan-apply)."""
     from nomad_tpu.scheduler.pipeline import EvalRequest, PipelinedPlacer
     from nomad_tpu.tensor import TensorIndex
 
@@ -72,25 +138,21 @@ def bench_tpu(nodes, n_evals):
     for node in nodes:
         tindex.nt.upsert_node(node)
 
-    # Window: one readback drains the whole burst (remote-TPU RTT amortizes
-    # across the window); sized to the workload, capped at 128.
     window = min(max(n_evals, 1), 128)
 
-    # Warmup: compile the placement kernel AND the window-stack readback op
-    # for this shape bucket (same window size as the measured run).
     warm = PipelinedPlacer(tindex, nodes, rng=random.Random(1), window=window)
     for _ in range(window + 1):
-        job = build_job()
-        warm.submit(EvalRequest(job=job, tgs=[job.TaskGroups[0]] * PER_EVAL))
+        job = build_job(per_eval, dcs)
+        warm.submit(EvalRequest(job=job, tgs=[job.TaskGroups[0]] * per_eval))
     warm.flush()
 
     placer = PipelinedPlacer(tindex, nodes, rng=random.Random(42),
                              window=window)
     t0 = time.perf_counter()
     for _ in range(n_evals):
-        job = build_job()
+        job = build_job(per_eval, dcs)
         placer.submit(EvalRequest(job=job,
-                                  tgs=[job.TaskGroups[0]] * PER_EVAL))
+                                  tgs=[job.TaskGroups[0]] * per_eval))
     results = placer.flush()
     elapsed = time.perf_counter() - t0
     total_placed = sum(int((r.chosen_rows >= 0).sum()) for r in results)
@@ -99,10 +161,10 @@ def bench_tpu(nodes, n_evals):
     lat_placer = PipelinedPlacer(tindex, nodes, rng=random.Random(7))
     latencies = []
     for _ in range(5):
-        job = build_job()
+        job = build_job(per_eval, dcs)
         t1 = time.perf_counter()
         lat_placer.submit(EvalRequest(job=job,
-                                      tgs=[job.TaskGroups[0]] * PER_EVAL))
+                                      tgs=[job.TaskGroups[0]] * per_eval))
         lat_placer.flush()
         latencies.append(time.perf_counter() - t1)
     return n_evals / elapsed, total_placed, float(np.percentile(latencies, 50))
@@ -129,22 +191,41 @@ def main():
     nodes = build_nodes(N_NODES)
     n_evals = max(1, N_PLACEMENTS // PER_EVAL)
 
-    tpu_evals_sec, tpu_placed, p50 = bench_tpu(nodes, n_evals)
+    e2e_evals_sec, e2e_placed, worker_stats = bench_server_e2e(nodes, n_evals)
+    placer_evals_sec, _, p50 = bench_placer(nodes, n_evals)
     cpu_evals_sec, _ = bench_cpu_reference(nodes, CPU_REF_EVALS)
 
+    detail = {
+        "placements_per_eval": PER_EVAL,
+        "e2e_placed": e2e_placed,
+        "e2e_worker_stats": worker_stats,
+        "placer_only_evals_sec": round(placer_evals_sec, 2),
+        "placer_p50_eval_latency_ms": round(p50 * 1e3, 2),
+        "cpu_reference_evals_sec": round(cpu_evals_sec, 2),
+        "backend": _backend(),
+    }
+
+    if RUN_C5:
+        c5_nodes = build_nodes(C5_NODES, n_dcs=4)
+        c5_evals = max(1, C5_PLACEMENTS // PER_EVAL)
+        c5_rate, c5_placed, c5_p50 = bench_placer(
+            c5_nodes, c5_evals, dcs=["dc1", "dc2", "dc3", "dc4"])
+        detail["config5_multidc"] = {
+            "nodes": C5_NODES, "placements": C5_PLACEMENTS,
+            "evals_sec": round(c5_rate, 2),
+            "placements_sec": round(c5_rate * PER_EVAL, 2),
+            "placed": c5_placed,
+            "p50_eval_latency_ms": round(c5_p50 * 1e3, 2),
+        }
+
     result = {
-        "metric": f"placement evals/sec @{N_NODES} nodes x {N_PLACEMENTS} "
-                  f"task-groups (driver+attr constraints, {N_PARTITIONS} classes)",
-        "value": round(tpu_evals_sec, 2),
+        "metric": f"end-to-end server evals/sec @{N_NODES} nodes x "
+                  f"{N_PLACEMENTS} task-groups (register->broker->worker->"
+                  f"plan-apply->committed)",
+        "value": round(e2e_evals_sec, 2),
         "unit": "evals/sec",
-        "vs_baseline": round(tpu_evals_sec / cpu_evals_sec, 2),
-        "detail": {
-            "placements_per_eval": PER_EVAL,
-            "tpu_placed": tpu_placed,
-            "tpu_p50_eval_latency_ms": round(p50 * 1e3, 2),
-            "cpu_reference_evals_sec": round(cpu_evals_sec, 2),
-            "backend": _backend(),
-        },
+        "vs_baseline": round(e2e_evals_sec / cpu_evals_sec, 2),
+        "detail": detail,
     }
     print(json.dumps(result))
 

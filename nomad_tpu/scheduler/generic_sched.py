@@ -74,6 +74,91 @@ _HANDLED_TRIGGERS = (
 )
 
 
+def has_escaped(stack: Optional[GenericStack], job: Optional[Job]) -> bool:
+    """True when a constraint escaped computed-class evaluation (reference:
+    the escaped flag threaded through feasible.go checkers). Filters the
+    TG cache to THIS job: a shared window ClassEligibility also holds other
+    jobs' entries."""
+    if stack is None or stack.elig is None or job is None:
+        return False
+    cache = stack.elig._job_cache.get(job.ID)
+    if cache is not None and cache[2]:
+        return True
+    return any(v[2] for k, v in stack.elig._tg_cache.items()
+               if k[0] == job.ID)
+
+
+def class_eligibility(stack: Optional[GenericStack], job: Optional[Job],
+                      tindex: Optional[TensorIndex]) -> Dict[str, bool]:
+    """Per-computed-class eligibility snapshot for blocked evals
+    (reference: generic_sched.go blocked-eval ClassEligibility). Only THIS
+    job's cache entries participate — the eligibility object may be shared
+    across a scheduling window."""
+    if stack is None or stack.elig is None or job is None:
+        return {}
+    elig = stack.elig
+    nt = tindex.nt if tindex else None
+    out: Dict[str, bool] = {}
+    job_cache = elig._job_cache.get(job.ID)
+    tables = []
+    if job_cache is not None:
+        tables.append(job_cache[1])
+    tables.extend(v[1] for k, v in elig._tg_cache.items() if k[0] == job.ID)
+    if not tables or nt is None:
+        return out
+    import numpy as np
+
+    combined = np.logical_and.reduce(tables) if len(tables) > 1 else tables[0]
+    for cid, name in enumerate(nt.class_names):
+        if cid < len(combined):
+            out[name] = bool(combined[cid])
+    return out
+
+
+def filter_complete_allocs(allocs: List[Allocation],
+                           batch: bool) -> List[Allocation]:
+    """(reference: generic_sched.go:267-303)"""
+
+    def keep(a: Allocation) -> bool:
+        if batch:
+            if a.DesiredStatus in (AllocDesiredStatusStop,
+                                   AllocDesiredStatusEvict,
+                                   AllocDesiredStatusFailed):
+                return a.ran_successfully()
+            return a.ClientStatus != AllocClientStatusFailed
+        return not a.terminal_status()
+
+    return [a for a in allocs if keep(a)]
+
+
+def build_placement_allocs(eval: Evaluation, job: Job, ctx: EvalContext,
+                           place, options, plan: Plan,
+                           failed_tg_allocs: Dict[str, AllocMetric]) -> None:
+    """Turn stack selections into plan allocations; coalesce failures per TG
+    (reference per-alloc loop: generic_sched.go:392-443)."""
+    for tup, option in zip(place, options):
+        if option is not None:
+            alloc = Allocation(
+                ID=generate_uuid(),
+                EvalID=eval.ID,
+                Name=tup.Name,
+                JobID=job.ID,
+                TaskGroup=tup.TaskGroup.Name,
+                Metrics=ctx.metrics.copy(),
+                NodeID=option.node.ID,
+                TaskResources=option.task_resources,
+                DesiredStatus=AllocDesiredStatusRun,
+                ClientStatus=AllocClientStatusPending,
+            )
+            plan.append_alloc(alloc)
+        else:
+            metric = failed_tg_allocs.get(tup.TaskGroup.Name)
+            if metric is not None:
+                metric.CoalescedFailures += 1
+            else:
+                failed_tg_allocs[tup.TaskGroup.Name] = ctx.metrics.copy()
+
+
 class GenericScheduler:
     def __init__(self, state: State, planner: Planner,
                  tindex: Optional[TensorIndex], logger: logging.Logger,
@@ -129,33 +214,10 @@ class GenericScheduler:
                    self.failed_tg_allocs, EvalStatusComplete, "")
 
     def _has_escaped(self) -> bool:
-        if self.stack is None or self.stack.elig is None or self.job is None:
-            return False
-        cache = self.stack.elig._job_cache.get(self.job.ID)
-        if cache is not None and cache[2]:
-            return True
-        return any(v[2] for v in self.stack.elig._tg_cache.values())
+        return has_escaped(self.stack, self.job)
 
     def _class_eligibility(self) -> Dict[str, bool]:
-        if self.stack is None or self.stack.elig is None or self.job is None:
-            return {}
-        elig = self.stack.elig
-        nt = self.tindex.nt if self.tindex else None
-        out: Dict[str, bool] = {}
-        job_cache = elig._job_cache.get(self.job.ID)
-        tables = []
-        if job_cache is not None:
-            tables.append(job_cache[1])
-        tables.extend(v[1] for v in elig._tg_cache.values())
-        if not tables or nt is None:
-            return out
-        import numpy as np
-
-        combined = np.logical_and.reduce(tables) if len(tables) > 1 else tables[0]
-        for cid, name in enumerate(nt.class_names):
-            if cid < len(combined):
-                out[name] = bool(combined[cid])
-        return out
+        return class_eligibility(self.stack, self.job, self.tindex)
 
     def _create_blocked_eval(self, plan_failure: bool) -> None:
         """(reference: generic_sched.go:156-177)"""
@@ -215,18 +277,7 @@ class GenericScheduler:
 
     # ----------------------------------------------------------- reconcile
     def _filter_complete_allocs(self, allocs: List[Allocation]) -> List[Allocation]:
-        """(reference: generic_sched.go:267-303)"""
-
-        def keep(a: Allocation) -> bool:
-            if self.batch:
-                if a.DesiredStatus in (AllocDesiredStatusStop,
-                                       AllocDesiredStatusEvict,
-                                       AllocDesiredStatusFailed):
-                    return a.ran_successfully()
-                return a.ClientStatus != AllocClientStatusFailed
-            return not a.terminal_status()
-
-        return [a for a in allocs if keep(a)]
+        return filter_complete_allocs(allocs, self.batch)
 
     def _compute_job_allocs(self) -> None:
         """(reference: generic_sched.go:307-389)"""
@@ -314,24 +365,5 @@ class GenericScheduler:
         options = self.stack.select_batch([t.TaskGroup for t in place])
         self.ctx.metrics.NodesAvailable = by_dc
 
-        for tup, option in zip(place, options):
-            if option is not None:
-                alloc = Allocation(
-                    ID=generate_uuid(),
-                    EvalID=self.eval.ID,
-                    Name=tup.Name,
-                    JobID=self.job.ID,
-                    TaskGroup=tup.TaskGroup.Name,
-                    Metrics=self.ctx.metrics.copy(),
-                    NodeID=option.node.ID,
-                    TaskResources=option.task_resources,
-                    DesiredStatus=AllocDesiredStatusRun,
-                    ClientStatus=AllocClientStatusPending,
-                )
-                self.plan.append_alloc(alloc)
-            else:
-                metric = self.failed_tg_allocs.get(tup.TaskGroup.Name)
-                if metric is not None:
-                    metric.CoalescedFailures += 1
-                else:
-                    self.failed_tg_allocs[tup.TaskGroup.Name] = self.ctx.metrics.copy()
+        build_placement_allocs(self.eval, self.job, self.ctx, place, options,
+                               self.plan, self.failed_tg_allocs)

@@ -32,7 +32,7 @@ from nomad_tpu.tensor.node_table import DIM_NAMES, RES_DIMS
 
 from . import kernels
 from .context import EvalContext
-from .util import TGConstraints, task_group_constraints
+from .util import task_group_constraints
 
 # Anti-affinity penalties (reference: stack.go:10-19)
 SERVICE_JOB_ANTI_AFFINITY_PENALTY = 10.0
@@ -48,6 +48,29 @@ class SelectedOption:
     node: Node
     score: float
     task_resources: Dict[str, Resources] = field(default_factory=dict)
+
+
+@dataclass
+class PreparedBatch:
+    """Host-assembled device inputs for one evaluation's placements.
+
+    Split out of select_batch so the pipelined worker can dispatch many
+    evals' kernels chained on device usage before any readback."""
+
+    tgs: List[TaskGroup]
+    tg_index: Dict[str, int]      # tg name -> row in tg_masks/tg_demands
+    tg_masks: np.ndarray          # [U, N] bool eligibility per unique TG
+    tg_demands: np.ndarray        # [U, R]
+    demands: np.ndarray           # [P_pad, R]
+    tg_ids: np.ndarray            # [P_pad] int32
+    valid: np.ndarray             # [P_pad] bool
+    p_pad: int
+    evict_rows: np.ndarray        # in-plan eviction scatter
+    evict_vecs: np.ndarray
+    job_counts: np.ndarray        # [N] int32 anti-affinity base
+    distinct: bool
+    penalty: float
+    noise_vec: np.ndarray         # [N] f32 tie-break jitter
 
 
 def _pad_pow2(n: int, floor: int = 8) -> int:
@@ -91,6 +114,15 @@ class GenericStack:
         if self.job is not None:
             self.elig = ClassEligibility(nt, nodes)
 
+    def adopt_nodes(self, nodes_by_id: Dict[str, Node], cand_mask: np.ndarray,
+                    elig: ClassEligibility) -> None:
+        """Share a candidate set + eligibility cache built once for a whole
+        scheduling window (pipelined worker): evals against the same snapshot
+        need not re-scan the node list per eval."""
+        self._nodes_by_id = nodes_by_id
+        self._cand_mask = cand_mask
+        self.elig = elig
+
     # ---------------------------------------------------------- selection
     def select(self, tg: TaskGroup) -> Tuple[Optional[SelectedOption], Resources]:
         opts = self.select_batch([tg])
@@ -109,6 +141,46 @@ class GenericStack:
 
         t0 = time.monotonic()
         nt = self.tindex.nt
+        prep = self.prepare_batch(tgs)
+
+        banned_extra = np.zeros(nt.n_rows, dtype=bool)
+        results: List[Optional[SelectedOption]] = [None] * len(tgs)
+        remaining = list(range(len(tgs)))
+        # Effects of winners from earlier attempts of THIS call: their usage,
+        # anti-affinity counts, and distinct-hosts occupancy must be visible
+        # to re-run placements (they aren't in ctx.plan yet).
+        placed_usage = np.zeros((nt.n_rows, RES_DIMS), dtype=np.float32)
+        placed_counts = np.zeros(nt.n_rows, dtype=np.int32)
+        placed_hosts = np.zeros(nt.n_rows, dtype=bool)
+
+        # The port-collision retry loop runs at most a handful of times: a
+        # winner failing host-side network assignment is masked and the
+        # remaining placements re-run.
+        for _attempt in range(8):
+            if not remaining:
+                break
+            res = self.dispatch(prep, banned=banned_extra,
+                                placed_usage=placed_usage,
+                                placed_counts=placed_counts,
+                                placed_hosts=placed_hosts, keep=remaining)
+            # ONE device->host transfer: on remote-attached TPUs a readback
+            # pays a fixed RTT, so results come back packed.
+            packed = np.asarray(res.packed)
+            failed_rows, remaining = self.collect(
+                prep, packed, results, remaining,
+                placed_usage, placed_counts, placed_hosts)
+            if not failed_rows:
+                break
+            for row in failed_rows:
+                banned_extra[row] = True
+
+        self.ctx.metrics.AllocationTime = int((time.monotonic() - t0) * 1e9)
+        return results
+
+    def prepare_batch(self, tgs: Sequence[TaskGroup]) -> PreparedBatch:
+        """Assemble the host-side device inputs for one eval's placements."""
+        assert self.job is not None and self.elig is not None
+        nt = self.tindex.nt
         job = self.job
 
         # Per-unique-TG eligibility masks and demand vectors.
@@ -122,10 +194,8 @@ class GenericStack:
         job_mask, _, _ = self.elig.job_mask(job.ID, job.Constraints)
         tg_masks = np.zeros((len(unique_tgs), nt.n_rows), dtype=bool)
         tg_demands = np.zeros((len(unique_tgs), RES_DIMS), dtype=np.float32)
-        tg_cons: List[TGConstraints] = []
         for i, tg in enumerate(unique_tgs):
             cons = task_group_constraints(tg)
-            tg_cons.append(cons)
             m, _, _ = self.elig.tg_mask(job.ID, tg.Name, cons.constraints,
                                         cons.drivers)
             tg_masks[i] = self._cand_mask & job_mask & m
@@ -155,94 +225,98 @@ class GenericStack:
             np.random.default_rng(int(noise * 2**31)).random(nt.n_rows),
             dtype=np.float32) * _NOISE_SCALE
 
-        banned_extra = np.zeros(nt.n_rows, dtype=bool)
-        results: List[Optional[SelectedOption]] = [None] * len(tgs)
-        remaining = list(range(len(tgs)))
-        # Effects of winners from earlier attempts of THIS call: their usage,
-        # anti-affinity counts, and distinct-hosts occupancy must be visible
-        # to re-run placements (they aren't in ctx.plan yet).
-        placed_usage = np.zeros((nt.n_rows, RES_DIMS), dtype=np.float32)
-        placed_counts = np.zeros(nt.n_rows, dtype=np.int32)
-        placed_hosts = np.zeros(nt.n_rows, dtype=bool)
-        placed_any = False
+        return PreparedBatch(
+            tgs=list(tgs), tg_index=tg_index, tg_masks=tg_masks,
+            tg_demands=tg_demands, demands=demands, tg_ids=tg_ids,
+            valid=valid, p_pad=p_pad, evict_rows=evict_rows,
+            evict_vecs=evict_vecs, job_counts=job_counts, distinct=distinct,
+            penalty=penalty, noise_vec=noise_vec)
 
-        # The port-collision retry loop runs at most a handful of times: a
-        # winner failing host-side network assignment is masked and the
-        # remaining placements re-run.
-        for _attempt in range(8):
-            if not remaining:
-                break
-            d = nt.device_arrays()
-            import jax.numpy as jnp
+    def dispatch(self, prep: PreparedBatch, usage_override=None,
+                 banned: Optional[np.ndarray] = None,
+                 placed_usage: Optional[np.ndarray] = None,
+                 placed_counts: Optional[np.ndarray] = None,
+                 placed_hosts: Optional[np.ndarray] = None,
+                 keep: Optional[Sequence[int]] = None):
+        """Launch the placement kernel; returns the device-side result without
+        forcing a readback. usage_override lets a pipelined caller chain the
+        previous eval's usage_after array device-side."""
+        import jax.numpy as jnp
 
-            usage = d["usage"]
-            if len(evict_rows):
-                usage = usage.at[evict_rows].add(-evict_vecs)
-            if placed_any:
-                usage = usage + jnp.asarray(placed_usage)
-            masks = jnp.asarray(tg_masks & ~banned_extra[None, :])
-            sel_demands = demands.copy()
-            sel_valid = valid.copy()
-            sel_tgids = tg_ids.copy()
-            keep = np.zeros(p_pad, dtype=bool)
-            keep[remaining] = True
-            sel_valid &= keep
+        nt = self.tindex.nt
+        d = nt.device_arrays()
+        usage = usage_override if usage_override is not None else d["usage"]
+        if len(prep.evict_rows):
+            usage = usage.at[prep.evict_rows].add(-prep.evict_vecs)
+        if placed_usage is not None and placed_usage.any():
+            usage = usage + jnp.asarray(placed_usage)
+        masks = prep.tg_masks
+        if banned is not None and banned.any():
+            masks = masks & ~banned[None, :]
+        sel_valid = prep.valid
+        if keep is not None:
+            k = np.zeros(prep.p_pad, dtype=bool)
+            k[list(keep)] = True
+            sel_valid = sel_valid & k
+        counts_now = prep.job_counts
+        if placed_counts is not None:
+            counts_now = counts_now + placed_counts
+        if prep.distinct:
+            hosts = counts_now > 0
+            if placed_hosts is not None:
+                hosts = hosts | placed_hosts
+        else:
+            hosts = np.zeros(nt.n_rows, dtype=bool)
 
-            counts_now = job_counts + placed_counts
-            res = kernels.place_batch(
-                d["capacity"], d["score_cap"], usage, masks,
-                jnp.asarray(counts_now), jnp.asarray(sel_demands),
-                jnp.asarray(sel_tgids), jnp.asarray(sel_valid),
-                jnp.asarray(noise_vec), jnp.float32(penalty),
-                jnp.asarray(distinct), jnp.asarray(
-                    (counts_now > 0) | placed_hosts if distinct
-                    else np.zeros(nt.n_rows, dtype=bool)),
-            )
-            # ONE device->host transfer: on remote-attached TPUs a readback
-            # pays a fixed RTT, so results come back packed.
-            packed = np.asarray(res.packed)
-            chosen = packed[:, 0].astype(np.int32)
-            scores = packed[:, 1]
-            n_feasible = packed[:, 2].astype(np.int32)
+        return kernels.place_batch(
+            d["capacity"], d["score_cap"], usage, jnp.asarray(masks),
+            jnp.asarray(counts_now), jnp.asarray(prep.demands),
+            jnp.asarray(prep.tg_ids), jnp.asarray(sel_valid),
+            jnp.asarray(prep.noise_vec), jnp.float32(prep.penalty),
+            jnp.asarray(prep.distinct), jnp.asarray(hosts))
 
-            failed_rows: set = set()
-            next_remaining = []
-            for p in list(remaining):
-                row = int(chosen[p])
-                self._fill_metrics(tgs[p], tg_masks[tg_index[tgs[p].Name]],
-                                   int(n_feasible[p]))
-                if row < 0:
-                    self._note_exhaustion(tgs[p],
-                                          tg_masks[tg_index[tgs[p].Name]],
-                                          tg_demands[tg_index[tgs[p].Name]])
-                    continue  # infeasible: stays None
-                node_id = nt.node_of[row]
-                node = self._nodes_by_id.get(node_id)
-                if node is None:
-                    failed_rows.add(row)
-                    next_remaining.append(p)
-                    continue
-                option = self._assign_networks(node, tgs[p],
-                                               float(scores[p]))
-                if option is None:
-                    failed_rows.add(row)
-                    next_remaining.append(p)
-                    continue
-                results[p] = option
-                self.ctx.metrics.score_node(node, "binpack", float(scores[p]))
-                placed_usage[row] += demands[p]
-                placed_counts[row] += 1
-                placed_hosts[row] = True
-                placed_any = True
+    def collect(self, prep: PreparedBatch, packed: np.ndarray,
+                results: List[Optional[SelectedOption]],
+                remaining: Sequence[int],
+                placed_usage: np.ndarray, placed_counts: np.ndarray,
+                placed_hosts: np.ndarray) -> Tuple[set, List[int]]:
+        """Materialize winners host-side: node lookup, port assignment,
+        metrics. Returns (rows that failed network assignment, placement
+        indexes to re-run). Mutates results and the placed_* accumulators."""
+        nt = self.tindex.nt
+        chosen = packed[:, 0].astype(np.int32)
+        scores = packed[:, 1]
+        n_feasible = packed[:, 2].astype(np.int32)
 
-            if not failed_rows:
-                break
-            for row in failed_rows:
-                banned_extra[row] = True
-            remaining = next_remaining
-
-        self.ctx.metrics.AllocationTime = int((time.monotonic() - t0) * 1e9)
-        return results
+        failed_rows: set = set()
+        next_remaining: List[int] = []
+        for p in list(remaining):
+            row = int(chosen[p])
+            ti = prep.tg_index[prep.tgs[p].Name]
+            self._fill_metrics(prep.tgs[p], prep.tg_masks[ti],
+                               int(n_feasible[p]))
+            if row < 0:
+                self._note_exhaustion(prep.tgs[p], prep.tg_masks[ti],
+                                      prep.tg_demands[ti], prep, placed_usage)
+                continue  # infeasible: stays None
+            node_id = nt.node_of[row]
+            node = self._nodes_by_id.get(node_id)
+            if node is None:
+                failed_rows.add(row)
+                next_remaining.append(p)
+                continue
+            option = self._assign_networks(node, prep.tgs[p],
+                                           float(scores[p]))
+            if option is None:
+                failed_rows.add(row)
+                next_remaining.append(p)
+                continue
+            results[p] = option
+            self.ctx.metrics.score_node(node, "binpack", float(scores[p]))
+            placed_usage[row] += prep.demands[p]
+            placed_counts[row] += 1
+            placed_hosts[row] = True
+        return failed_rows, next_remaining
 
     # ------------------------------------------------------------- helpers
     def _eviction_deltas(self) -> Tuple[np.ndarray, np.ndarray]:
@@ -321,10 +395,23 @@ class GenericStack:
         m.NodesExhausted = max(0, n_eligible - n_feasible)
 
     def _note_exhaustion(self, tg: TaskGroup, mask: np.ndarray,
-                         demand: np.ndarray) -> None:
-        """Failed placement: record which dimensions were exhausted."""
+                         demand: np.ndarray,
+                         prep: Optional[PreparedBatch] = None,
+                         placed_usage: Optional[np.ndarray] = None) -> None:
+        """Failed placement: record which dimensions were exhausted, against
+        the EFFECTIVE usage the kernel saw (committed usage minus this plan's
+        evictions plus this call's earlier placements) — diffing the stale
+        host mirror can blame the wrong dimension."""
         nt = self.tindex.nt
-        free = nt.capacity - nt.usage
+        usage = nt.usage
+        if (prep is not None and len(prep.evict_rows)) or (
+                placed_usage is not None and placed_usage.any()):
+            usage = usage.copy()
+            if prep is not None and len(prep.evict_rows):
+                np.subtract.at(usage, prep.evict_rows, prep.evict_vecs)
+            if placed_usage is not None:
+                usage += placed_usage
+        free = nt.capacity - usage
         lacking = (free < demand[None, :]) & mask[:, None]
         per_dim = lacking.sum(axis=0)
         for d, count in enumerate(per_dim):

@@ -1,0 +1,357 @@
+"""PipelinedWorker: the TPU-native served scheduling path.
+
+The base Worker processes one evaluation at a time: dispatch the placement
+kernel, BLOCK on the device->host readback, submit the plan, wait, ack. On a
+remote-attached TPU every readback pays a fixed RTT, so throughput is
+RTT-bound, not compute-bound.
+
+This worker batch-dequeues a WINDOW of evaluations and runs the pure-placement
+ones (the common case in registration storms — no evictions, no in-place
+updates) through a device-resident pipeline:
+
+  1. dispatch: each eval's placement kernel is launched with the PREVIOUS
+     eval's usage_after array as its usage input — the chain never leaves the
+     device (reference analogue: optimistic concurrency of N workers against
+     snapshots, nomad/worker.go:45-49; here the "snapshot" is the live chain)
+  2. one readback drains the whole window's packed results
+  3. plans are built host-side (network/port assignment for winners only) and
+     enqueued to the plan applier back-to-back; the applier re-verifies every
+     placement against committed state before commit (plan_apply.py), which
+     makes the optimistic chain safe
+  4. eval status updates for the window are applied through consensus as ONE
+     EvalUpdate batch, then everything acks
+
+Anything not pure-placement — updates, migrations, stops, system jobs, core
+GC, deregisters, annotate requests — falls back to the exact per-eval
+GenericScheduler path (scheduler/generic_sched.py), as does any eval whose
+plan partially commits (stale chain) or whose winner fails host-side port
+assignment. Fallbacks preserve reference semantics bit-for-bit; the fast path
+only accelerates evals whose outcome is provably the same.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from nomad_tpu.scheduler.context import EvalContext
+from nomad_tpu.scheduler.generic_sched import (
+    _HANDLED_TRIGGERS,
+    build_placement_allocs,
+    class_eligibility,
+    filter_complete_allocs,
+    has_escaped,
+)
+from nomad_tpu.scheduler.stack import GenericStack, PreparedBatch
+from nomad_tpu.scheduler.util import (
+    BLOCKED_EVAL_FAILED_PLACEMENTS,
+    diff_allocs,
+    materialize_task_groups,
+    ready_nodes_in_dcs,
+    tainted_nodes,
+)
+from nomad_tpu.structs import AllocMetric, Evaluation, Plan
+from nomad_tpu.tensor.node_table import RES_DIMS
+from nomad_tpu.structs.structs import (
+    EvalStatusBlocked,
+    EvalStatusComplete,
+    JobTypeBatch,
+    JobTypeService,
+)
+
+from .fsm import MessageType
+from .worker import DEQUEUE_TIMEOUT, Worker
+
+logger = logging.getLogger("nomad.worker.pipelined")
+
+# How long to wait for additional evals once one is in hand. Near-zero: the
+# window exists to drain bursts, not to add latency to a lone eval.
+FILL_TIMEOUT = 0.002
+
+
+@dataclass
+class _FastEval:
+    ev: Evaluation
+    token: str
+    plan: Plan
+    ctx: EvalContext
+    stack: GenericStack
+    prep: PreparedBatch
+    place: list                   # diff.place AllocTuples
+    res: object                   # device-side PlacementResult
+    failed_tg_allocs: Dict[str, AllocMetric] = field(default_factory=dict)
+    pending: object = None        # PendingPlan once enqueued
+    fallback: bool = False
+
+
+class PipelinedWorker(Worker):
+    """Drop-in Worker with windowed device-chained placement."""
+
+    def __init__(self, *args, window: int = 32, **kwargs):
+        super().__init__(*args, **kwargs)
+        self.window = max(1, window)
+        # Observability: how evals flowed (fast = device-chained window,
+        # slow = per-eval GenericScheduler, fallback = fast dispatch that
+        # re-ran slow after partial commit / port collision).
+        self.stats = {"fast": 0, "slow": 0, "fallback": 0, "windows": 0}
+
+    # -------------------------------------------------------------- run loop
+    def run(self) -> None:
+        while not self._stop.is_set():
+            if self._paused.is_set():
+                time.sleep(0.05)
+                continue
+            batch = self._dequeue_window()
+            if not batch:
+                continue
+            try:
+                self._process_window(batch)
+            except Exception:
+                # Broker/plan-queue teardown on leadership loss: drop quietly,
+                # redelivery handles the rest (worker.go:88-99).
+                if self._stop.is_set() or not self.eval_broker.enabled():
+                    continue
+                logger.exception("pipelined worker: window failed")
+                for ev, token in batch:
+                    self._send_nack(ev.ID, token)
+
+    def _dequeue_window(self) -> List[Tuple[Evaluation, str]]:
+        got = self._dequeue_evaluation()
+        if got is None:
+            return []
+        batch = [got]
+        while len(batch) < self.window:
+            try:
+                ev, token = self.eval_broker.dequeue(self.schedulers,
+                                                     FILL_TIMEOUT)
+            except RuntimeError:
+                break
+            if ev is None:
+                break
+            batch.append((ev, token))
+        return batch
+
+    # ------------------------------------------------------------ the window
+    def _process_window(self, batch: List[Tuple[Evaluation, str]]) -> None:
+        self._wait_for_index(max(ev.ModifyIndex for ev, _ in batch))
+        snap = self.raft.fsm.state.snapshot()
+
+        fast: List[_FastEval] = []
+        slow: List[Tuple[Evaluation, str]] = []
+        usage_chain = None
+        # Shared per-window: every eval sees the same snapshot, so the ready
+        # node list, candidate mask, and class-eligibility cache are built
+        # once per datacenter set, not once per eval.
+        node_cache: Dict[tuple, tuple] = {}
+        for ev, token in batch:
+            rec = None
+            try:
+                rec = self._try_dispatch_fast(ev, token, snap, usage_chain,
+                                              node_cache)
+            except Exception:
+                logger.exception("fast dispatch failed for eval %s", ev.ID)
+            if rec is None:
+                slow.append((ev, token))
+            else:
+                usage_chain = rec.res.usage_after
+                fast.append(rec)
+
+        self.stats["windows"] += 1
+        self.stats["slow"] += len(slow)
+        if fast:
+            self._finish_fast(fast)
+        for ev, token in slow:
+            self._process_slow(ev, token)
+
+    def _try_dispatch_fast(self, ev: Evaluation, token: str, snap,
+                           usage_chain,
+                           node_cache: Dict[tuple, tuple]
+                           ) -> Optional[_FastEval]:
+        """Launch the eval's placement kernel chained on the window's usage,
+        or return None to route it through the per-eval GenericScheduler."""
+        if ev.Type not in (JobTypeService, JobTypeBatch):
+            return None
+        if ev.TriggeredBy not in _HANDLED_TRIGGERS or ev.AnnotatePlan:
+            return None
+        job = snap.job_by_id(ev.JobID)
+        if job is None:
+            return None
+        batch = ev.Type == JobTypeBatch
+        groups = materialize_task_groups(job)
+        allocs = filter_complete_allocs(
+            list(snap.allocs_by_job(ev.JobID)), batch)
+        tainted = tainted_nodes(snap, allocs)
+        diff = diff_allocs(job, tainted, groups, allocs)
+        # Pure placement only: stops/updates/migrations carry eviction and
+        # rolling-limit semantics the per-eval path owns.
+        if diff.update or diff.migrate or diff.stop or not diff.place:
+            return None
+
+        plan = ev.make_plan(job)
+        ctx = EvalContext(snap, plan, logger)
+        stack = GenericStack(ctx, self.tindex, batch)
+        dc_key = tuple(sorted(job.Datacenters))
+        cached = node_cache.get(dc_key)
+        if cached is None:
+            from nomad_tpu.tensor.constraints import ClassEligibility
+
+            nodes, by_dc = ready_nodes_in_dcs(snap, job.Datacenters)
+            nt = self.tindex.nt
+            nodes_by_id = {n.ID: n for n in nodes}
+            cand_mask = np.zeros(nt.n_rows, dtype=bool)
+            for n in nodes:
+                row = nt.row_of.get(n.ID)
+                if row is not None:
+                    cand_mask[row] = True
+            elig = ClassEligibility(nt, nodes)
+            cached = (nodes_by_id, cand_mask, elig, by_dc)
+            node_cache[dc_key] = cached
+        nodes_by_id, cand_mask, elig, by_dc = cached
+        if not nodes_by_id:
+            return None
+        stack.job = job
+        stack.adopt_nodes(nodes_by_id, cand_mask, elig)
+        ctx.metrics.NodesAvailable = by_dc
+
+        prep = stack.prepare_batch([t.TaskGroup for t in diff.place])
+        res = stack.dispatch(prep, usage_override=usage_chain)
+        return _FastEval(ev=ev, token=token, plan=plan, ctx=ctx, stack=stack,
+                         prep=prep, place=diff.place, res=res)
+
+    def _finish_fast(self, fast: List[_FastEval]) -> None:
+        """Readback once, build + submit plans, wait, batch status updates."""
+        packed = self._drain_window([rec.res for rec in fast])
+
+        # Build and enqueue plans back-to-back: the applier verifies plan i
+        # while we materialize plan i+1's ports host-side.
+        nt = self.tindex.nt
+        # The kernels ran chained: eval k saw evals 1..k-1's placements. The
+        # shared accumulator reproduces that chain host-side so exhaustion
+        # diagnostics diff against the usage the kernel actually saw.
+        window_usage = np.zeros((nt.n_rows, RES_DIMS), dtype=np.float32)
+        for rec, pk in zip(fast, packed):
+            results = [None] * len(rec.prep.tgs)
+            placed_counts = np.zeros(nt.n_rows, dtype=np.int32)
+            placed_hosts = np.zeros(nt.n_rows, dtype=bool)
+            try:
+                failed_rows, _ = rec.stack.collect(
+                    rec.prep, pk, results, range(len(rec.prep.tgs)),
+                    window_usage, placed_counts, placed_hosts)
+            except Exception:
+                logger.exception("collect failed for eval %s", rec.ev.ID)
+                rec.fallback = True
+                continue
+            if failed_rows:
+                # Port collision against the cached index: rare; the sync
+                # path's banned-row retry loop owns it.
+                rec.fallback = True
+                continue
+            build_placement_allocs(rec.ev, rec.plan.Job, rec.ctx,
+                                   rec.place, results, rec.plan,
+                                   rec.failed_tg_allocs)
+            if rec.plan.is_no_op() and not rec.failed_tg_allocs:
+                rec.fallback = True  # nothing placeable; let sync path decide
+                continue
+            rec.plan.EvalToken = rec.token
+            try:
+                self.eval_broker.outstanding_reset(rec.ev.ID, rec.token)
+                if not rec.plan.is_no_op():
+                    rec.pending = self.plan_queue.enqueue(rec.plan)
+            except Exception:
+                logger.exception("plan enqueue failed for eval %s", rec.ev.ID)
+                rec.fallback = True
+
+        # Wait for the applier; anything not fully committed re-runs sync.
+        eval_updates: List[Evaluation] = []
+        done: List[_FastEval] = []
+        for rec in fast:
+            if rec.fallback:
+                continue
+            if rec.pending is not None:
+                try:
+                    # Raises on timeout or applier rejection (stale token):
+                    # only THIS eval falls back, not the whole window.
+                    result = rec.pending.wait(timeout=30.0)
+                except Exception:
+                    logger.debug("plan for eval %s not committed; re-running"
+                                 " per-eval", rec.ev.ID)
+                    rec.fallback = True
+                    continue
+                full_commit, _, _ = result.full_commit(rec.plan)
+                if not full_commit:
+                    rec.fallback = True
+                    continue
+            eval_updates.extend(self._status_evals(rec))
+            done.append(rec)
+
+        if eval_updates:
+            self.raft.apply(MessageType.EvalUpdate, {"Evals": eval_updates})
+        self.stats["fast"] += len(done)
+        for rec in done:
+            self._send_ack(rec.ev.ID, rec.token)
+        for rec in fast:
+            if rec.fallback:
+                self.stats["fallback"] += 1
+                self._process_slow(rec.ev, rec.token)
+
+    def _status_evals(self, rec: _FastEval) -> List[Evaluation]:
+        """Terminal status (+ blocked follow-up) for one fast eval, matching
+        GenericScheduler.process/set_status exactly."""
+        out: List[Evaluation] = []
+        blocked = None
+        if rec.failed_tg_allocs and rec.ev.Status != EvalStatusBlocked:
+            escaped = has_escaped(rec.stack, rec.plan.Job)
+            elig = {} if escaped else class_eligibility(
+                rec.stack, rec.plan.Job, self.tindex)
+            blocked = rec.ev.create_blocked_eval(elig, escaped)
+            blocked.StatusDescription = BLOCKED_EVAL_FAILED_PLACEMENTS
+            blocked.SnapshotIndex = rec.ctx.state.latest_index()
+            out.append(blocked)
+        if rec.ev.Status == EvalStatusBlocked and rec.failed_tg_allocs:
+            # A blocked eval that still couldn't fully place is re-blocked.
+            new_eval = rec.ev.copy()
+            new_eval.EscapedComputedClass = has_escaped(rec.stack,
+                                                        rec.plan.Job)
+            new_eval.ClassEligibility = class_eligibility(
+                rec.stack, rec.plan.Job, self.tindex)
+            new_eval.SnapshotIndex = rec.ctx.state.latest_index()
+            out.append(new_eval)
+            return out
+        new_eval = rec.ev.copy()
+        new_eval.Status = EvalStatusComplete
+        new_eval.StatusDescription = ""
+        new_eval.FailedTGAllocs = rec.failed_tg_allocs or {}
+        if blocked is not None:
+            new_eval.BlockedEval = blocked.ID
+        out.append(new_eval)
+        return out
+
+    def _drain_window(self, results: List[object]) -> List[np.ndarray]:
+        """Overlapped device->host transfers for the whole window: start every
+        copy async first, then materialize — the RTTs overlap instead of
+        serializing (and no stacking op to recompile per window size)."""
+        for res in results:
+            try:
+                res.packed.copy_to_host_async()
+            except AttributeError:
+                pass  # non-jax array (already host-side)
+        return [np.asarray(res.packed) for res in results]
+
+    # ------------------------------------------------------------- slow path
+    def _process_slow(self, ev: Evaluation, token: str) -> None:
+        """Exact per-eval Worker behavior for everything off the fast path."""
+        self._eval, self._token = ev, token
+        try:
+            self._invoke_scheduler(ev, token)
+        except Exception:
+            if self._stop.is_set() or not self.eval_broker.enabled():
+                logger.debug("worker: dropping eval %s on shutdown", ev.ID)
+                return
+            logger.exception("worker: failed to process eval %s", ev.ID)
+            self._send_nack(ev.ID, token)
+            return
+        self._send_ack(ev.ID, token)

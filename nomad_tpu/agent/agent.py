@@ -10,9 +10,10 @@ from __future__ import annotations
 
 import logging
 import os
+import socket
 import tempfile
 from dataclasses import dataclass, field
-from typing import Dict, Optional
+from typing import Dict, List, Optional
 
 from nomad_tpu.client import Client, ClientConfig, InProcServerChannel
 from nomad_tpu.server import Server, ServerConfig
@@ -32,6 +33,14 @@ class AgentConfig:
     data_dir: str = ""
     bind_addr: str = "127.0.0.1"
     http_port: int = 4646
+    # Networked server mode (reference: Ports{RPC: 4647, Serf: 4648})
+    rpc_port: int = 4647
+    serf_port: int = 4648
+    bootstrap_expect: int = 1
+    start_join: List[str] = field(default_factory=list)
+    # Client-only agents dial these RPC addresses (reference:
+    # client/config Servers list)
+    servers: List[str] = field(default_factory=list)
     server_enabled: bool = False
     client_enabled: bool = False
     num_schedulers: int = 2
@@ -54,37 +63,72 @@ class Agent:
     def __init__(self, config: AgentConfig):
         self.config = config
         self.server: Optional[Server] = None
+        self.cluster = None  # ClusterServer in networked mode
         self.client: Optional[Client] = None
         self.http: Optional[HTTPServer] = None
+        self.rpc_endpoints = None
+        self._rpc_pool = None
         if not config.data_dir:
             config.data_dir = tempfile.mkdtemp(prefix="nomad_tpu_")
+        if not config.node_name:
+            config.node_name = socket.gethostname()
 
     def start(self) -> None:
         if self.config.server_enabled:
-            self._setup_server()
+            if self.config.dev_mode:
+                self._setup_dev_server()
+            else:
+                self._setup_cluster_server()
         if self.config.client_enabled:
             self._setup_client()
         self.http = HTTPServer(self, host=self.config.bind_addr,
                                port=self.config.http_port)
         self.http.start()
 
-    def _setup_server(self) -> None:
-        """(reference: agent.go:356 setupServer)"""
+    def _setup_dev_server(self) -> None:
+        """(reference: agent.go:356 setupServer, DevMode branch)"""
+        from nomad_tpu.rpc.endpoints import Endpoints
+
         sconf = ServerConfig(
             region=self.config.region,
             datacenter=self.config.datacenter,
             num_schedulers=self.config.num_schedulers,
-            dev_mode=self.config.dev_mode,
+            dev_mode=True,
         )
         self.server = Server(sconf)
         self.server.establish_leadership()
+        self.rpc_endpoints = Endpoints(self.server)
+
+    def _setup_cluster_server(self) -> None:
+        """Networked server: RPC+raft listener plus the gossip membership
+        plane (reference: agent.go:356 setupServer -> nomad.NewServer with
+        setupRPC/setupRaft/setupSerf, server.go:166-263)."""
+        from nomad_tpu.raft.log import FileLogStore
+        from nomad_tpu.rpc.cluster import ClusterServer
+
+        sconf = ServerConfig(
+            region=self.config.region,
+            datacenter=self.config.datacenter,
+            num_schedulers=self.config.num_schedulers,
+            bootstrap_expect=self.config.bootstrap_expect,
+        )
+        self.cluster = ClusterServer(sconf, bind_addr=self.config.bind_addr,
+                                     port=self.config.rpc_port)
+        # Durable raft log + term/vote (reference: raft-boltdb store,
+        # server.go setupRaft) — a restarted server must not re-vote in a
+        # term it already voted in, nor re-bootstrap a formed cluster.
+        raft_dir = os.path.join(self.config.data_dir, "raft")
+        os.makedirs(raft_dir, exist_ok=True)
+        self.cluster.connect([], log_store=FileLogStore(raft_dir))
+        self.cluster.start()
+        self.cluster.enable_gossip(self.config.node_name,
+                                   gossip_port=self.config.serf_port,
+                                   join=self.config.start_join or None)
+        self.server = self.cluster.server
+        self.rpc_endpoints = self.cluster.endpoints
 
     def _setup_client(self) -> None:
         """(reference: agent.go:428 setupClient)"""
-        if self.server is None:
-            raise ValueError(
-                "client-only agents need a server address; in-process RPC "
-                "requires server_enabled (wire RPC lands with multi-node)")
         cconf = ClientConfig(
             state_dir=os.path.join(self.config.data_dir, "client"),
             alloc_dir=os.path.join(self.config.data_dir, "alloc"),
@@ -95,20 +139,59 @@ class Agent:
             options=dict(self.config.options),
             dev_mode=self.config.dev_mode,
         )
-        self.client = Client(cconf, InProcServerChannel(self.server))
+        if self.server is not None and self.cluster is None:
+            channel = InProcServerChannel(self.server)
+        else:
+            from nomad_tpu.client.rpc import NetServerChannel
+            servers = list(self.config.servers)
+            if self.cluster is not None:
+                servers.append(self.cluster.addr)
+            if not servers:
+                raise ValueError(
+                    "client-only agents need config.servers (RPC addresses)")
+            channel = NetServerChannel(servers)
+        self.client = Client(cconf, channel)
         if self.config.node_name:
             self.client.node.Name = self.config.node_name
         self.client.start()
 
     def shutdown(self) -> None:
+        if self._rpc_pool is not None:
+            self._rpc_pool.close()
         if self.http is not None:
             self.http.shutdown()
         if self.client is not None:
             self.client.shutdown()
-        if self.server is not None:
+        if self.cluster is not None:
+            self.cluster.shutdown()
+        elif self.server is not None:
             self.server.shutdown()
 
     # -------------------------------------------------------- http helpers
+    def rpc(self, method: str, body: dict):
+        """Route a request through the RPC dispatch so NotLeaderError and
+        cross-region bodies forward exactly as wire RPCs do (reference: the
+        HTTP agent always goes through agent.RPC -> Server.forward,
+        command/agent/agent.go:597 + nomad/rpc.go:177). Client-only agents
+        forward over the wire to their configured servers (reference:
+        client.RPC via rpcproxy, client/client.go:332)."""
+        if self.rpc_endpoints is not None:
+            return self.rpc_endpoints.handle(method, body)
+        servers = list(self.config.servers)
+        if not servers:
+            raise ValueError(
+                "no server running on this agent and no servers configured")
+        from nomad_tpu.rpc.pool import ConnError, ConnPool
+        if self._rpc_pool is None:
+            self._rpc_pool = ConnPool()
+        last_exc: Exception = ValueError("no servers reachable")
+        for addr in servers:
+            try:
+                return self._rpc_pool.call(addr, method, body)
+            except (OSError, ConnError, TimeoutError) as exc:
+                last_exc = exc
+        raise last_exc
+
     def region(self) -> str:
         return self.config.region
 
@@ -123,6 +206,10 @@ class Agent:
         }
 
     def member_info(self) -> dict:
+        if self.cluster is not None and self.cluster.membership is not None:
+            ml = self.cluster.membership.memberlist.local_member()
+            return {"Name": ml.name, "Addr": ml.addr, "Port": ml.port,
+                    "Status": ml.state, "Tags": dict(ml.tags)}
         return {
             "Name": self.config.node_name or "local",
             "Addr": self.config.bind_addr,
@@ -132,9 +219,37 @@ class Agent:
                      "role": "nomad"},
         }
 
+    def members(self) -> list:
+        """(reference: /v1/agent/members, agent_endpoint.go)"""
+        if self.cluster is not None and self.cluster.membership is not None:
+            return self.cluster.membership.members()
+        return [self.member_info()]
+
+    def gossip_join(self, addresses: list) -> int:
+        """(reference: /v1/agent/join -> serf join)"""
+        if self.cluster is None or self.cluster.membership is None:
+            raise ValueError("gossip not enabled (dev-mode or client agent)")
+        return self.cluster.membership.join(list(addresses))
+
+    def gossip_force_leave(self, node: str) -> bool:
+        """(reference: /v1/agent/force-leave -> serf ForceLeave)"""
+        if self.cluster is None or self.cluster.membership is None:
+            raise ValueError("gossip not enabled (dev-mode or client agent)")
+        return self.cluster.membership.force_leave(node)
+
     def server_addresses(self) -> list:
+        if self.cluster is not None and self.cluster.membership is not None:
+            addrs = sorted(p.rpc_addr
+                           for p in self.cluster.membership.local_servers())
+            if addrs:
+                return addrs
+            return [self.cluster.addr]
         port = self.http.port if self.http else self.config.http_port
         return [f"{self.config.bind_addr}:{port}"]
 
     def leader_address(self) -> str:
+        if self.server is not None:
+            leader = getattr(self.server.raft, "leader_id", None)
+            if leader:
+                return leader
         return self.server_addresses()[0]

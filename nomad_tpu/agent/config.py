@@ -41,14 +41,22 @@ def config_from_dict(data: dict) -> AgentConfig:
     cfg.bind_addr = data.get("bind_addr", cfg.bind_addr)
     ports = data.get("ports") or {}
     cfg.http_port = int(ports.get("http", cfg.http_port))
+    cfg.rpc_port = int(ports.get("rpc", cfg.rpc_port))
+    cfg.serf_port = int(ports.get("serf", cfg.serf_port))
 
     server = data.get("server") or {}
     cfg.server_enabled = bool(server.get("enabled", False))
     cfg.num_schedulers = int(server.get("num_schedulers", cfg.num_schedulers))
+    cfg.bootstrap_expect = int(server.get("bootstrap_expect",
+                                          cfg.bootstrap_expect))
+    join = server.get("start_join") or []
+    cfg.start_join = [join] if isinstance(join, str) else list(join)
 
     client = data.get("client") or {}
     cfg.client_enabled = bool(client.get("enabled", False))
     cfg.node_class = client.get("node_class", "")
+    servers = client.get("servers") or []
+    cfg.servers = [servers] if isinstance(servers, str) else list(servers)
     cfg.meta = {k: str(v) for k, v in (client.get("meta") or {}).items()}
     cfg.options = {k: str(v) for k, v in (client.get("options") or {}).items()}
     return cfg

@@ -168,6 +168,28 @@ def route(agent, method: str, path: str, query, get_body):
     server = agent.server
     client = agent.client
     state = server.state if server is not None else None
+    # A request naming another region — or hitting a client-only agent —
+    # is served over RPC (with region/leader forwarding) instead of local
+    # state (reference: every HTTP handler goes through agent.RPC;
+    # local-state reads here are the AllowStale fast path).
+    q_region = query.get("region", [""])[0]
+    remote = server is None or (bool(q_region)
+                                and q_region != agent.region())
+
+    def rpc(method_name: str, body: dict):
+        if q_region:
+            body = dict(body, Region=q_region)
+        return agent.rpc(method_name, body)
+
+    def rpc_read(method_name: str, body: dict, key: str):
+        """Forwarded read with RPC-level blocking-query params."""
+        min_index, wait = _parse_wait(query)
+        body = dict(body)
+        if min_index:
+            body["MinQueryIndex"] = min_index
+            body["MaxQueryTime"] = wait or MAX_WAIT
+        resp = rpc(method_name, body)
+        return resp.get(key), resp.get("Index", 0)
 
     def need_server():
         if server is None:
@@ -181,9 +203,14 @@ def route(agent, method: str, path: str, query, get_body):
 
     # ------------------------------ jobs
     if path == "/v1/jobs":
-        need_server()
         if method == "GET":
             prefix = query.get("prefix", [""])[0]
+            if remote:
+                jobs, index = rpc_read("Job.List", {}, "Jobs")
+                if prefix:
+                    jobs = [j for j in jobs if j["ID"].startswith(prefix)]
+                return sorted(jobs, key=lambda j: j["ID"]), index
+            need_server()
 
             def run():
                 jobs = state.jobs_by_id_prefix(prefix) if prefix else state.jobs()
@@ -194,20 +221,25 @@ def route(agent, method: str, path: str, query, get_body):
             return _blocking(state, [Item(table="jobs")], query, run)
         if method in ("PUT", "POST"):
             payload = get_body()
-            job = from_dict(Job, payload.get("Job"))
             enforce = payload.get("EnforceIndex")
             enforce_index = payload.get("JobModifyIndex") if enforce else None
-            eval_id, jmi, index = server.job_register(
-                job, enforce_index=enforce_index)
-            return ({"EvalID": eval_id, "EvalCreateIndex": index,
-                     "JobModifyIndex": jmi, "Index": index}, index)
+            resp = rpc("Job.Register", {
+                "Job": payload.get("Job"), "EnforceIndex": enforce_index})
+            resp["EvalCreateIndex"] = resp["Index"]
+            return resp, resp["Index"]
         raise CodedError(405, "method not allowed")
 
     m = re.match(r"^/v1/job/([^/]+)$", path)
     if m:
-        need_server()
         job_id = urllib.parse.unquote(m.group(1))
         if method == "GET":
+            if remote:
+                job, index = rpc_read("Job.GetJob", {"JobID": job_id}, "Job")
+                if job is None:
+                    raise KeyError(f"job not found: {job_id}")
+                return job, index
+            need_server()
+
             def run():
                 job = state.job_by_id(job_id)
                 if job is None:
@@ -217,18 +249,15 @@ def route(agent, method: str, path: str, query, get_body):
             return _blocking(state, [Item(job=job_id)], query, run)
         if method in ("PUT", "POST"):
             payload = get_body()
-            job = from_dict(Job, payload.get("Job"))
-            eval_id, jmi, index = server.job_register(job)
-            return ({"EvalID": eval_id, "JobModifyIndex": jmi,
-                     "Index": index}, index)
+            resp = rpc("Job.Register", {"Job": payload.get("Job")})
+            return resp, resp["Index"]
         if method == "DELETE":
-            eval_id, index = server.job_deregister(job_id)
-            return ({"EvalID": eval_id, "Index": index}, index)
+            resp = rpc("Job.Deregister", {"JobID": job_id})
+            return resp, resp["Index"]
         raise CodedError(405, "method not allowed")
 
     m = re.match(r"^/v1/job/([^/]+)/plan$", path)
     if m:
-        need_server()
         _require_write(method)
         payload = get_body()
         job = from_dict(Job, payload.get("Job"))
@@ -238,14 +267,17 @@ def route(agent, method: str, path: str, query, get_body):
         if job.ID != path_id:
             raise CodedError(400, "Job ID does not match")
         want_diff = bool(payload.get("Diff"))
-        resp = server.job_plan(job, want_diff=want_diff)
-        index = resp.JobModifyIndex
-        return (to_dict(resp), index)
+        resp = rpc("Job.Plan", {"Job": payload.get("Job"),
+                                      "Diff": want_diff})
+        return (resp, resp.get("JobModifyIndex", 0))
 
     m = re.match(r"^/v1/job/([^/]+)/allocations$", path)
     if m:
-        need_server()
         job_id = urllib.parse.unquote(m.group(1))
+        if remote:
+            return rpc_read("Job.Allocations", {"JobID": job_id},
+                            "Allocations")
+        need_server()
 
         def run():
             allocs = [to_dict(a.stub()) for a in state.allocs_by_job(job_id)]
@@ -255,8 +287,11 @@ def route(agent, method: str, path: str, query, get_body):
 
     m = re.match(r"^/v1/job/([^/]+)/evaluations$", path)
     if m:
-        need_server()
         job_id = urllib.parse.unquote(m.group(1))
+        if remote:
+            return rpc_read("Job.Evaluations", {"JobID": job_id},
+                            "Evaluations")
+        need_server()
 
         def run():
             evals = [to_dict(e) for e in state.evals_by_job(job_id)]
@@ -267,19 +302,22 @@ def route(agent, method: str, path: str, query, get_body):
     m = re.match(r"^/v1/job/([^/]+)/evaluate$", path)
     if m:
         _require_write(method)
-        eval_id, index = need_server().job_evaluate(
-            urllib.parse.unquote(m.group(1)))
-        return ({"EvalID": eval_id, "Index": index}, index)
+        resp = rpc("Job.Evaluate",
+                         {"JobID": urllib.parse.unquote(m.group(1))})
+        return (resp, resp["Index"])
 
     m = re.match(r"^/v1/job/([^/]+)/periodic/force$", path)
     if m:
         _require_write(method)
-        need_server().periodic_force(urllib.parse.unquote(m.group(1)))
-        index = state.latest_index()
+        rpc("Periodic.Force",
+                  {"JobID": urllib.parse.unquote(m.group(1))})
+        index = state.latest_index() if state is not None else 0
         return ({"Index": index}, index)
 
     # ------------------------------ nodes
     if path == "/v1/nodes":
+        if remote:
+            return rpc_read("Node.List", {}, "Nodes")
         need_server()
 
         def run():
@@ -291,8 +329,14 @@ def route(agent, method: str, path: str, query, get_body):
 
     m = re.match(r"^/v1/node/([^/]+)$", path)
     if m:
-        need_server()
         node_id = urllib.parse.unquote(m.group(1))
+        if method == "GET" and remote:
+            node, index = rpc_read("Node.GetNode", {"NodeID": node_id},
+                                   "Node")
+            if node is None:
+                raise KeyError(f"node not found: {node_id}")
+            return node, index
+        need_server()
 
         def run():
             node = state.node_by_id(node_id)
@@ -304,8 +348,10 @@ def route(agent, method: str, path: str, query, get_body):
 
     m = re.match(r"^/v1/node/([^/]+)/allocations$", path)
     if m:
-        need_server()
         node_id = urllib.parse.unquote(m.group(1))
+        if remote:
+            return rpc_read("Node.GetAllocs", {"NodeID": node_id}, "Allocs")
+        need_server()
 
         def run():
             allocs = [to_dict(a) for a in state.allocs_by_node(node_id)]
@@ -317,19 +363,23 @@ def route(agent, method: str, path: str, query, get_body):
     if m:
         _require_write(method)
         enable = query.get("enable", ["false"])[0].lower() in ("1", "true")
-        index = need_server().node_update_drain(
-            urllib.parse.unquote(m.group(1)), enable)
-        return ({"Index": index}, index)
+        resp = rpc("Node.UpdateDrain",
+                         {"NodeID": urllib.parse.unquote(m.group(1)),
+                          "Drain": enable})
+        return (resp, resp["Index"])
 
     m = re.match(r"^/v1/node/([^/]+)/evaluate$", path)
     if m:
         _require_write(method)
-        eval_ids = need_server().node_evaluate(urllib.parse.unquote(m.group(1)))
-        index = state.latest_index()
-        return ({"EvalIDs": eval_ids, "Index": index}, index)
+        resp = rpc("Node.Evaluate",
+                         {"NodeID": urllib.parse.unquote(m.group(1))})
+        index = state.latest_index() if state is not None else 0
+        return ({"EvalIDs": resp["EvalIDs"], "Index": index}, index)
 
     # ------------------------------ allocations
     if path == "/v1/allocations":
+        if remote:
+            return rpc_read("Alloc.List", {}, "Allocations")
         need_server()
 
         def run():
@@ -341,15 +391,24 @@ def route(agent, method: str, path: str, query, get_body):
 
     m = re.match(r"^/v1/allocation/([^/]+)$", path)
     if m:
-        need_server()
         alloc_id = urllib.parse.unquote(m.group(1))
-        alloc = state.alloc_by_id(alloc_id)
+        if remote:
+            alloc, index = rpc_read("Alloc.GetAlloc", {"AllocID": alloc_id},
+                                    "Alloc")
+        else:
+            need_server()
+            found = state.alloc_by_id(alloc_id)
+            alloc = to_dict(found) if found else None
+            index = state.get_index("allocs")
         if alloc is None:
             raise KeyError(f"alloc not found: {alloc_id}")
-        return to_dict(alloc), state.get_index("allocs")
+        return alloc, index
 
     # ------------------------------ evaluations
     if path == "/v1/evaluations":
+        if remote:
+            evals, index = rpc_read("Eval.List", {}, "Evaluations")
+            return sorted(evals, key=lambda e: e["ID"]), index
         need_server()
 
         def run():
@@ -361,8 +420,13 @@ def route(agent, method: str, path: str, query, get_body):
 
     m = re.match(r"^/v1/evaluation/([^/]+)$", path)
     if m:
-        need_server()
         eval_id = urllib.parse.unquote(m.group(1))
+        if remote:
+            ev, index = rpc_read("Eval.GetEval", {"EvalID": eval_id}, "Eval")
+            if ev is None:
+                raise KeyError(f"eval not found: {eval_id}")
+            return ev, index
+        need_server()
 
         def run():
             ev = state.eval_by_id(eval_id)
@@ -374,8 +438,11 @@ def route(agent, method: str, path: str, query, get_body):
 
     m = re.match(r"^/v1/evaluation/([^/]+)/allocations$", path)
     if m:
-        need_server()
         eval_id = urllib.parse.unquote(m.group(1))
+        if remote:
+            return rpc_read("Eval.Allocations", {"EvalID": eval_id},
+                            "Allocations")
+        need_server()
         allocs = [to_dict(a.stub()) for a in state.allocs_by_eval(eval_id)]
         return allocs, state.get_index("allocs")
 
@@ -405,19 +472,34 @@ def route(agent, method: str, path: str, query, get_body):
         out = {"config": agent.self_config(), "member": agent.member_info()}
         return out, None
     if path == "/v1/agent/members":
-        return [agent.member_info()], None
+        return agent.members(), None
+    if path == "/v1/agent/join":
+        _require_write(method)
+        addrs = query.get("address", [])
+        return {"num_joined": agent.gossip_join(addrs)}, None
+    if path == "/v1/agent/force-leave":
+        _require_write(method)
+        node = query.get("node", [""])[0]
+        return {"ok": agent.gossip_force_leave(node)}, None
     if path == "/v1/agent/servers":
         return agent.server_addresses(), None
     if path == "/v1/status/leader":
-        need_server()
+        if remote:
+            return rpc("Status.Leader", {}), None
         return agent.leader_address(), None
     if path == "/v1/status/peers":
-        need_server()
+        if remote:
+            return rpc("Status.Peers", {}), None
         return [agent.leader_address()], None
     if path == "/v1/regions":
-        return [agent.region()], None
+        # gossip-derived region list when federated (reference:
+        # Region.List over the serf peers map, region_endpoint.go)
+        try:
+            return sorted(agent.rpc("Region.List", {})), None
+        except ValueError:
+            return [agent.region()], None
     if path == "/v1/system/gc":
         _require_write(method)
-        need_server().force_gc()
+        rpc("System.GC", {})
         return None
     raise CodedError(404, f"no handler for {path}")

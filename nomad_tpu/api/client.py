@@ -250,6 +250,16 @@ class Agent:
     def members(self):
         return self.c.get("/v1/agent/members")[0]
 
+    def join(self, addresses):
+        """(reference: api/agent.go Join)"""
+        qs = "&".join("address=" + urllib.parse.quote(a) for a in addresses)
+        return self.c.request("PUT", f"/v1/agent/join?{qs}")[0]
+
+    def force_leave(self, node: str):
+        """(reference: api/agent.go ForceLeave)"""
+        qs = "node=" + urllib.parse.quote(node)
+        return self.c.request("PUT", f"/v1/agent/force-leave?{qs}")[0]
+
     def servers(self):
         return self.c.get("/v1/agent/servers")[0]
 

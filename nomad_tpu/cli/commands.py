@@ -42,6 +42,14 @@ def main(argv) -> int:
     p.add_argument("-data-dir", default=None)
     p.add_argument("-node-class", default=None)
     p.add_argument("-dc", default=None)
+    p.add_argument("-region", default=None)
+    p.add_argument("-rpc-port", type=int, default=None)
+    p.add_argument("-serf-port", type=int, default=None)
+    p.add_argument("-bootstrap-expect", type=int, default=None)
+    p.add_argument("-join", action="append", default=None,
+                   help="gossip address of an existing server (repeatable)")
+    p.add_argument("-servers", default=None,
+                   help="comma-separated server RPC addrs (client mode)")
 
     p = sub.add_parser("run", help="run a job")
     _add_meta(p)
@@ -102,6 +110,16 @@ def main(argv) -> int:
     p = sub.add_parser("server-members", help="server membership")
     _add_meta(p)
 
+    p = sub.add_parser("join", help="join the agent's gossip pool to servers")
+    _add_meta(p)
+    p.add_argument("addresses", nargs="+",
+                   help="gossip host:port of servers to join")
+
+    p = sub.add_parser("force-leave",
+                       help="force a gossip member into the left state")
+    _add_meta(p)
+    p.add_argument("node", help="gossip member name (e.g. host.region)")
+
     p = sub.add_parser("agent-info", help="agent self info")
     _add_meta(p)
 
@@ -151,6 +169,18 @@ def cmd_agent(args) -> int:
         config.node_class = args.node_class
     if args.dc is not None:
         config.datacenter = args.dc
+    if args.region is not None:
+        config.region = args.region
+    if args.rpc_port is not None:
+        config.rpc_port = args.rpc_port
+    if args.serf_port is not None:
+        config.serf_port = args.serf_port
+    if args.bootstrap_expect is not None:
+        config.bootstrap_expect = args.bootstrap_expect
+    if args.join is not None:
+        config.start_join = list(args.join)
+    if args.servers is not None:
+        config.servers = [s.strip() for s in args.servers.split(",") if s]
 
     agent = Agent(config)
     agent.start()
@@ -531,6 +561,23 @@ def cmd_server_members(args) -> int:
     for m in client.agent.members():
         print(f"{m['Name']:<16} {m['Addr']}:{m['Port']} {m['Status']} "
               f"region={m['Tags'].get('region')} dc={m['Tags'].get('dc')}")
+    return 0
+
+
+def cmd_join(args) -> int:
+    client = _client(args)
+    out = client.agent.join(args.addresses)
+    print(f"Joined {out['num_joined']} servers successfully")
+    return 0
+
+
+def cmd_force_leave(args) -> int:
+    client = _client(args)
+    out = client.agent.force_leave(args.node)
+    if not out.get("ok"):
+        print(f"Error: unknown member {args.node}", file=sys.stderr)
+        return 1
+    print(f"Force-leave of {args.node} propagated")
     return 0
 
 

@@ -1,0 +1,22 @@
+"""Gossip membership plane (reference: hashicorp/serf + memberlist,
+consumed by nomad/serf.go)."""
+
+from .memberlist import (
+    ALIVE,
+    DEAD,
+    EVENT_FAILED,
+    EVENT_JOIN,
+    EVENT_LEAVE,
+    EVENT_UPDATE,
+    LEFT,
+    SUSPECT,
+    GossipConfig,
+    Member,
+    Memberlist,
+)
+
+__all__ = [
+    "Memberlist", "Member", "GossipConfig",
+    "ALIVE", "SUSPECT", "DEAD", "LEFT",
+    "EVENT_JOIN", "EVENT_LEAVE", "EVENT_FAILED", "EVENT_UPDATE",
+]

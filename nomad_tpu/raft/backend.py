@@ -26,7 +26,8 @@ class RaftBackend:
     def __init__(self, node_id: str, fsm, peers: List[str],
                  transport, log_store=None,
                  config: Optional[RaftConfig] = None,
-                 on_leader_change: Optional[Callable[[bool], None]] = None):
+                 on_leader_change: Optional[Callable[[bool], None]] = None,
+                 electable: bool = True):
         self.fsm = fsm
         self.node = RaftNode(
             node_id=node_id,
@@ -38,6 +39,7 @@ class RaftBackend:
             restore_fn=self._fsm_restore,
             config=config,
             on_leader_change=on_leader_change,
+            electable=electable,
         )
 
     def start(self) -> None:
@@ -85,6 +87,24 @@ class RaftBackend:
 
     def barrier(self, timeout: Optional[float] = None) -> int:
         return self.node.barrier(timeout)
+
+    # ----------------------------------------------------- membership seam
+    # (driven by the gossip plane, server/membership.py — the reference
+    # equivalents are raft.AddPeer/RemovePeer/SetPeers from nomad/leader.go
+    # reconcileMember and nomad/serf.go maybeBootstrap)
+    def add_peer(self, peer_id: str, timeout: Optional[float] = None) -> None:
+        self.node.add_peer(peer_id, timeout)
+
+    def remove_peer(self, peer_id: str,
+                    timeout: Optional[float] = None) -> None:
+        self.node.remove_peer(peer_id, timeout)
+
+    def bootstrap_cluster(self, peers: List[str]) -> bool:
+        return self.node.bootstrap_cluster(peers)
+
+    @property
+    def peers(self) -> List[str]:
+        return self.node.peers()
 
     def stats(self) -> Dict[str, Any]:
         return self.node.stats()

@@ -70,7 +70,8 @@ class RaftNode:
                  snapshot_fn: Optional[Callable[[], bytes]] = None,
                  restore_fn: Optional[Callable[[bytes], None]] = None,
                  config: Optional[RaftConfig] = None,
-                 on_leader_change: Optional[Callable[[bool], None]] = None):
+                 on_leader_change: Optional[Callable[[bool], None]] = None,
+                 electable: bool = True):
         self.id = node_id
         self.config = config or RaftConfig()
         self.log = log_store
@@ -88,6 +89,12 @@ class RaftNode:
         self._peers: List[str] = list(peers)
         if node_id not in self._peers:
             self._peers.append(node_id)
+        # Gossip-driven deployments boot dormant (no elections) until either
+        # bootstrap_cluster() fires on bootstrap-expect or a replicated
+        # Config entry admits us to an existing cluster (reference:
+        # maybeBootstrap, nomad/serf.go:80-139 — servers without peers.json
+        # wait for the expect quorum before their first election).
+        self._electable = electable
 
         self._commit_index = 0
         self._last_applied = 0
@@ -271,6 +278,10 @@ class RaftNode:
 
     def _set_peers_locked(self, peers: List[str]) -> None:
         self._peers = list(peers)
+        if self.id in self._peers:
+            # A committed Config entry naming us means a live cluster has
+            # admitted us — we may now stand for election.
+            self._electable = True
         if self.id not in self._peers and self._role == LEADER:
             # Removed ourselves: step down after the entry commits.
             pass
@@ -290,7 +301,8 @@ class RaftNode:
                 role = self._role
                 deadline = self._election_deadline
             now = time.monotonic()
-            if role in (FOLLOWER, CANDIDATE) and now >= deadline:
+            if (role in (FOLLOWER, CANDIDATE) and now >= deadline
+                    and self._electable):
                 self._run_election()
             time.sleep(0.01)
 
@@ -359,6 +371,12 @@ class RaftNode:
             self._match_index[p] = 0
         # Barrier noop commits everything from prior terms (leader.go:60).
         self._append_locked(EntryType.Noop, b"")
+        # Persist the peer set as a replicated Config entry so every
+        # follower — including gossip-bootstrap stragglers whose own
+        # bootstrap_cluster never fired — durably learns the membership
+        # (the v0-raft peers.json role, here carried by the log itself).
+        self._append_locked(EntryType.Config, msgpack.packb(
+            list(self._peers), use_bin_type=True))
         for p in self._peers:
             if p != self.id:
                 self._start_replicator(p)
@@ -549,6 +567,30 @@ class RaftNode:
             lambda peers: [p for p in peers if p != peer_id]
             if peer_id in peers else None,
             timeout)
+
+    def bootstrap_cluster(self, peers: List[str]) -> bool:
+        """One-time cluster formation from gossip discovery: set the initial
+        peer set and become electable. Only legal on a virgin node (empty
+        log, no snapshot) — an existing cluster manages membership through
+        Config entries instead. Every expect-server calls this with the same
+        discovered set; the usual election then picks one leader (reference:
+        maybeBootstrap's SetPeers, nomad/serf.go:80-139)."""
+        with self._lock:
+            # Empty log + no snapshot = virgin. (A bumped term alone — e.g.
+            # we granted a vote to an already-bootstrapped peer — does not
+            # disqualify: the log decides whether a cluster exists.)
+            if self.last_index > 0 or self._snap_index > 0:
+                return False
+            self._peers = list(peers)
+            if self.id not in self._peers:
+                self._peers.append(self.id)
+            self._electable = True
+            self._reset_election_timer()
+            return True
+
+    @property
+    def electable(self) -> bool:
+        return self._electable
 
     def _config_change(self, mutate: Callable[[List[str]],
                                               Optional[List[str]]],

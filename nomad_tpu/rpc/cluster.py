@@ -20,12 +20,14 @@ from .transport import TCPTransport
 class ClusterServer:
     def __init__(self, config, bind_addr: str = "127.0.0.1", port: int = 0):
         self.config = config
+        self.bind_addr = bind_addr
         self.rpc_server = RPCServer(bind_addr, port)
         self.addr = self.rpc_server.addr
         config.node_id = self.addr
         self.server = None
         self.endpoints: Optional[Endpoints] = None
         self.transport: Optional[TCPTransport] = None
+        self.membership = None
 
     def connect(self, peers: List[str], log_store=None, raft_config=None,
                 region_router=None, region_lister=None) -> None:
@@ -41,6 +43,30 @@ class ClusterServer:
         self.rpc_server.rpc_handler = self.endpoints.handle
         self.rpc_server.raft_handler = self.transport.handle
 
+    def enable_gossip(self, node_name: str, gossip_port: int = 0,
+                      join: Optional[List[str]] = None,
+                      gossip_config=None):
+        """Attach the membership plane (reference: setupSerf,
+        nomad/server.go:714-752). Call after connect(), before/after start().
+        Returns the ServerMembership; its gossip addr is
+        `membership.memberlist.addr:port` for other servers to join."""
+        from nomad_tpu.server.membership import ServerMembership
+
+        if self.server is None:
+            raise RuntimeError("connect() before enable_gossip()")
+        self.membership = ServerMembership(
+            self.server, rpc_addr=self.addr, node_name=node_name,
+            bind_addr=self.bind_addr, gossip_port=gossip_port,
+            gossip_config=gossip_config)
+        # Route cross-region RPCs through the gossip view.
+        self.endpoints.region_router = self.membership.region_router
+        self.endpoints.region_lister = self.membership.region_lister
+        self.endpoints.membership = self.membership
+        self.membership.start()
+        if join:
+            self.membership.join(join)
+        return self.membership
+
     def start(self) -> None:
         if self.server is None:
             raise RuntimeError("connect() before start()")
@@ -48,6 +74,8 @@ class ClusterServer:
         self.server.start()
 
     def shutdown(self) -> None:
+        if self.membership is not None:
+            self.membership.shutdown()
         if self.server is not None:
             self.server.shutdown()
         self.rpc_server.shutdown()

@@ -77,6 +77,7 @@ class Endpoints:
             "Status.Ping": self.status_ping,
             "Status.Leader": self.status_leader,
             "Status.Peers": self.status_peers,
+            "Status.RaftStats": self.status_raft_stats,
             "Job.Register": self.job_register,
             "Job.Deregister": self.job_deregister,
             "Job.GetJob": self.job_get,
@@ -108,7 +109,12 @@ class Endpoints:
             "Alloc.GetAllocs": self.alloc_get_many,
             "Region.List": self.region_list,
             "System.GC": self.system_gc,
+            "Agent.Members": self.agent_members,
+            "Agent.Join": self.agent_join,
+            "Agent.ForceLeave": self.agent_force_leave,
         }
+        # populated by ClusterServer.enable_gossip (server/membership.py)
+        self.membership = None
 
     # ------------------------------------------------------------- dispatch
     def handle(self, method: str, body: Any) -> Any:
@@ -155,6 +161,39 @@ class Endpoints:
         if hasattr(raft, "node"):
             return raft.node.peers()
         return [self.server.config.node_id or "dev"]
+
+    def status_raft_stats(self, body) -> Dict[str, Any]:
+        """Raft introspection for gossip bootstrap-expect: a non-zero log
+        index means a cluster already exists, so virgin joiners must not
+        self-bootstrap (reference: maybeBootstrap probing peers,
+        nomad/serf.go:80-139)."""
+        raft = self.server.raft
+        if hasattr(raft, "stats"):
+            stats = raft.stats()
+            return {"Bootstrapped": stats.get("last_log_index", 0) > 0
+                    or stats.get("snapshot_index", 0) > 0,
+                    "Stats": stats}
+        return {"Bootstrapped": True, "Stats": {}}  # dev mode
+
+    # ---------------------------------------------------------------- agent
+    # (reference: the serf-backed agent self RPCs behind `server-members`,
+    # `join`, `force-leave` — command/agent/agent_endpoint.go + serf.go)
+    def agent_members(self, body) -> List[Dict[str, Any]]:
+        if self.membership is None:
+            return []
+        return self.membership.members()
+
+    def agent_join(self, body) -> Dict[str, Any]:
+        if self.membership is None:
+            raise RuntimeError("gossip not enabled on this server")
+        n = self.membership.join(list(body.get("Addresses") or []))
+        return {"NumJoined": n}
+
+    def agent_force_leave(self, body) -> Dict[str, Any]:
+        if self.membership is None:
+            raise RuntimeError("gossip not enabled on this server")
+        ok = self.membership.force_leave(body["Node"])
+        return {"Ok": ok}
 
     # ------------------------------------------------------------------ job
     def job_register(self, body) -> Dict[str, Any]:

@@ -115,7 +115,11 @@ class Server:
                 transport=transport,
                 log_store=log_store,
                 config=raft_config,
-                on_leader_change=self._leadership_transition)
+                on_leader_change=self._leadership_transition,
+                # With explicit peers the node may elect immediately; with
+                # none it boots dormant until gossip bootstrap-expect fires
+                # or an existing cluster admits it (server/membership.py).
+                electable=bool(peers))
         else:
             self.raft = DevRaft(self.fsm)
         self.state: StateStore = self.fsm.state

@@ -1,0 +1,167 @@
+"""Gossip memberlist tests (reference analogue: the memberlist/serf
+behavior nomad/serf.go depends on — join, convergence, failure
+detection, graceful leave, tag updates)."""
+
+import threading
+import time
+
+import pytest
+
+from nomad_tpu.gossip import (
+    ALIVE,
+    DEAD,
+    EVENT_FAILED,
+    EVENT_JOIN,
+    EVENT_LEAVE,
+    EVENT_UPDATE,
+    GossipConfig,
+    Memberlist,
+)
+
+
+def wait_for(cond, timeout=5.0, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return
+        time.sleep(0.01)
+    pytest.fail(f"timeout waiting for {msg}")
+
+
+def make(name, events=None, tags=None):
+    cb = None
+    if events is not None:
+        cb = lambda ev, m: events.append((ev, m.name))
+    ml = Memberlist(name, tags=tags or {}, config=GossipConfig.fast(),
+                    on_event=cb)
+    ml.start()
+    return ml
+
+
+def test_join_and_convergence():
+    mls = []
+    try:
+        a = make("a")
+        mls.append(a)
+        for name in ("b", "c", "d"):
+            m = make(name)
+            mls.append(m)
+            assert m.join([f"{a.addr}:{a.port}"]) == 1
+        for m in mls:
+            wait_for(lambda m=m: m.num_alive() == 4, msg=f"{m.name} sees 4")
+            assert sorted(x.name for x in m.alive_members()) == [
+                "a", "b", "c", "d"]
+    finally:
+        for m in mls:
+            m.shutdown()
+
+
+def test_join_events_fire():
+    events = []
+    a = make("a", events=events)
+    b = make("b")
+    try:
+        b.join([f"{a.addr}:{a.port}"])
+        wait_for(lambda: (EVENT_JOIN, "b") in events, msg="join event")
+    finally:
+        a.shutdown()
+        b.shutdown()
+
+
+def test_failure_detection():
+    events = []
+    a = make("a", events=events)
+    b = make("b")
+    c = make("c")
+    try:
+        b.join([f"{a.addr}:{a.port}"])
+        c.join([f"{a.addr}:{a.port}"])
+        wait_for(lambda: a.num_alive() == 3, msg="cluster of 3")
+        # hard-kill c: sockets closed, no leave broadcast
+        c.shutdown()
+        wait_for(lambda: (EVENT_FAILED, "c") in events, timeout=10.0,
+                 msg="failure detected")
+        states = {m.name: m.state for m in a.members()}
+        assert states["c"] == DEAD
+        # b converges to the same verdict via gossip
+        wait_for(lambda: any(m.name == "c" and m.state == DEAD
+                             for m in b.members()), timeout=10.0,
+                 msg="b learns of c's death")
+    finally:
+        a.shutdown()
+        b.shutdown()
+
+
+def test_graceful_leave():
+    events = []
+    a = make("a", events=events)
+    b = make("b")
+    try:
+        b.join([f"{a.addr}:{a.port}"])
+        wait_for(lambda: a.num_alive() == 2, msg="joined")
+        b.leave()
+        wait_for(lambda: (EVENT_LEAVE, "b") in events, msg="leave event")
+        assert (EVENT_FAILED, "b") not in events
+    finally:
+        a.shutdown()
+        b.shutdown()
+
+
+def test_tag_update_propagates():
+    events = []
+    a = make("a", events=events)
+    b = make("b", tags={"port": "1"})
+    try:
+        b.join([f"{a.addr}:{a.port}"])
+        wait_for(lambda: a.num_alive() == 2, msg="joined")
+        b.set_tags({"port": "2"})
+        wait_for(lambda: (EVENT_UPDATE, "b") in events, msg="update event")
+        tags = {m.name: m.tags for m in a.members()}
+        assert tags["b"] == {"port": "2"}
+    finally:
+        a.shutdown()
+        b.shutdown()
+
+
+def test_refutation_keeps_live_member_alive():
+    """A falsely-suspected member refutes by raising its incarnation."""
+    a = make("a")
+    b = make("b")
+    try:
+        b.join([f"{a.addr}:{a.port}"])
+        wait_for(lambda: a.num_alive() == 2, msg="joined")
+        # inject a false suspicion of b directly into a's FSM
+        binfo = [m for m in a.members() if m.name == "b"][0]
+        a._on_suspect("b", binfo.incarnation, "a")
+        # b must refute before the suspicion deadline; it stays alive
+        time.sleep(a._suspicion_timeout() + 0.3)
+        states = {m.name: m.state for m in a.members()}
+        assert states["b"] == ALIVE
+        new_inc = [m for m in a.members() if m.name == "b"][0].incarnation
+        assert new_inc > binfo.incarnation
+    finally:
+        a.shutdown()
+        b.shutdown()
+
+
+def test_rejoin_after_failure():
+    a = make("a")
+    b = make("b")
+    try:
+        b.join([f"{a.addr}:{a.port}"])
+        wait_for(lambda: a.num_alive() == 2, msg="joined")
+        b.shutdown()
+        wait_for(lambda: any(m.name == "b" and m.state == DEAD
+                             for m in a.members()), timeout=10.0,
+                 msg="b declared dead")
+        # a new instance under the same name rejoins
+        b2 = make("b")
+        try:
+            b2.join([f"{a.addr}:{a.port}"])
+            wait_for(lambda: a.num_alive() == 2, timeout=10.0,
+                     msg="b rejoined")
+        finally:
+            b2.shutdown()
+    finally:
+        a.shutdown()
+        b.shutdown()

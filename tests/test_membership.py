@@ -1,0 +1,209 @@
+"""Gossip-driven cluster formation and multi-region federation tests
+(reference shapes: nomad/serf.go maybeBootstrap + nodeJoin/nodeFailed,
+nomad/leader.go:421-459 reconcileMember, rpc.go:223-242 forwardRegion;
+test style: in-process loopback clusters of nomad/server_test.go)."""
+
+import time
+
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.gossip import GossipConfig
+from nomad_tpu.raft import RaftConfig
+from nomad_tpu.rpc.cluster import ClusterServer
+from nomad_tpu.rpc.pool import ConnPool
+from nomad_tpu.server.server import ServerConfig
+from nomad_tpu.structs import to_dict
+
+
+def wait_for(cond, timeout=15.0, interval=0.02):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(interval)
+    return False
+
+
+FAST = RaftConfig(heartbeat_interval=0.02, election_timeout_min=0.08,
+                  election_timeout_max=0.16, apply_timeout=5.0)
+
+
+def boot(name, region="global", expect=1, join=None, num_schedulers=0):
+    cs = ClusterServer(ServerConfig(
+        node_id="", region=region, num_schedulers=num_schedulers,
+        bootstrap_expect=expect))
+    cs.connect([], raft_config=FAST)  # no static peers: gossip drives raft
+    cs.start()
+    cs.enable_gossip(name, join=join, gossip_config=GossipConfig.fast())
+    return cs
+
+
+def gossip_addr(cs):
+    ml = cs.membership.memberlist
+    return f"{ml.addr}:{ml.port}"
+
+
+def leader_of(nodes):
+    for n in nodes:
+        if n.server.is_leader() and n.server._leader:
+            return n
+    return None
+
+
+class TestGossipBootstrap:
+    def test_three_servers_form_cluster_via_gossip(self):
+        """bootstrap-expect=3: no server elects until all three have
+        discovered each other; then exactly one leader emerges."""
+        nodes = [boot("s0", expect=3)]
+        try:
+            # Alone, a 3-expect server must stay dormant.
+            time.sleep(0.5)
+            assert leader_of(nodes) is None
+            nodes.append(boot("s1", expect=3, join=[gossip_addr(nodes[0])]))
+            nodes.append(boot("s2", expect=3, join=[gossip_addr(nodes[0])]))
+            assert wait_for(lambda: leader_of(nodes) is not None)
+            leader = leader_of(nodes)
+            addrs = sorted(n.addr for n in nodes)
+            assert wait_for(
+                lambda: sorted(leader.server.raft.peers) == addrs)
+            # The whole cluster replicates: register a node through any
+            # member and observe it on a follower's store.
+            follower = [n for n in nodes if n is not leader][0]
+            resp = follower.endpoints.handle(
+                "Node.Register", {"Node": to_dict(mock.node())})
+            assert resp["Index"] > 0
+            assert wait_for(lambda: len(
+                follower.server.state.nodes()) == 1)
+        finally:
+            for n in nodes:
+                n.shutdown()
+
+    def test_late_joiner_added_as_raft_peer(self):
+        nodes = [boot("s0", expect=1)]
+        try:
+            assert wait_for(lambda: leader_of(nodes) is not None)
+            late = boot("s3", expect=0, join=[gossip_addr(nodes[0])])
+            nodes.append(late)
+            leader = leader_of(nodes)
+            assert wait_for(
+                lambda: late.addr in leader.server.raft.peers)
+            # the joiner eventually becomes a voting member (electable) by
+            # applying the replicated Config entry that names it
+            assert wait_for(lambda: late.server.raft.node.electable)
+        finally:
+            for n in nodes:
+                n.shutdown()
+
+    def test_failed_server_removed_from_raft(self):
+        nodes = [boot("s0", expect=3)]
+        nodes.append(boot("s1", expect=3, join=[gossip_addr(nodes[0])]))
+        nodes.append(boot("s2", expect=3, join=[gossip_addr(nodes[0])]))
+        try:
+            assert wait_for(lambda: leader_of(nodes) is not None)
+            leader = leader_of(nodes)
+            victim = [n for n in nodes if n is not leader][0]
+            victim.shutdown()
+            assert wait_for(
+                lambda: victim.addr not in leader_of(nodes).server.raft.peers
+                if leader_of(nodes) else False,
+                timeout=20.0)
+        finally:
+            for n in nodes:
+                n.shutdown()
+
+    def test_server_members_rpc(self):
+        nodes = [boot("s0", expect=1)]
+        try:
+            assert wait_for(lambda: leader_of(nodes) is not None)
+            members = nodes[0].endpoints.handle("Agent.Members", {})
+            assert len(members) == 1
+            assert members[0]["Name"] == "s0.global"
+            assert members[0]["Status"] == "alive"
+        finally:
+            for n in nodes:
+                n.shutdown()
+
+
+class TestFederation:
+    def test_cross_region_job_submission(self):
+        """A job for region A submitted to a region-B server is forwarded
+        over the gossip-populated region route and lands in region A
+        (reference: forwardRegion, nomad/rpc.go:223-242)."""
+        a = boot("a0", region="alpha", expect=1)
+        b = None
+        pool = ConnPool()
+        try:
+            assert wait_for(lambda: a.server.is_leader())
+            b = boot("b0", region="beta", expect=1,
+                     join=[gossip_addr(a)])
+            assert wait_for(lambda: b.server.is_leader())
+            # WAN pool converged: each side routes to the other's region
+            assert wait_for(lambda: a.membership.region_router("beta")
+                            is not None)
+            assert wait_for(lambda: b.membership.region_router("alpha")
+                            is not None)
+
+            job = mock.job()
+            job.Region = "alpha"
+            resp = pool.call(b.addr, "Job.Register",
+                             {"Job": to_dict(job), "Region": "alpha"})
+            assert resp["Index"] > 0
+            assert a.server.state.job_by_id(job.ID) is not None
+            assert b.server.state.job_by_id(job.ID) is None
+
+            regions = pool.call(b.addr, "Region.List", {})
+            assert regions == ["alpha", "beta"]
+        finally:
+            pool.close()
+            a.shutdown()
+            if b is not None:
+                b.shutdown()
+
+    def test_networked_agents_form_cluster(self):
+        """Two full server agents (HTTP + RPC + gossip) federate through
+        the agent layer: members visible over /v1/agent/members, a client
+        agent schedules against them over wire RPC."""
+        from nomad_tpu.agent import Agent, AgentConfig
+        from nomad_tpu.api import Client as ApiClient
+
+        a1 = Agent(AgentConfig(server_enabled=True, http_port=0,
+                               rpc_port=0, serf_port=0, bootstrap_expect=2,
+                               node_name="n1", num_schedulers=0))
+        a1.start()
+        ml = a1.cluster.membership.memberlist
+        a2 = Agent(AgentConfig(server_enabled=True, http_port=0,
+                               rpc_port=0, serf_port=0, bootstrap_expect=2,
+                               node_name="n2", num_schedulers=0,
+                               start_join=[f"{ml.addr}:{ml.port}"]))
+        a2.start()
+        try:
+            assert wait_for(lambda: sum(
+                1 for a in (a1, a2)
+                if a.server.is_leader() and a.server._leader) == 1)
+            api = ApiClient(f"http://127.0.0.1:{a1.http.port}")
+            members = api.agent.members()
+            assert sorted(m["Name"] for m in members) == [
+                "n1.global", "n2.global"]
+            assert all(m["Status"] == "alive" for m in members)
+            # servers list is the gossip-discovered RPC addresses
+            assert len(api.agent.servers()) == 2
+        finally:
+            a2.shutdown()
+            a1.shutdown()
+
+    def test_force_leave_marks_member_left(self):
+        a = boot("a0", expect=1)
+        b = boot("b0", expect=0, join=[gossip_addr(a)])
+        try:
+            assert wait_for(lambda: len(a.membership.members()) == 2)
+            b.membership.memberlist.shutdown()  # hard kill, no leave
+            resp = a.endpoints.handle("Agent.ForceLeave",
+                                      {"Node": "b0.global"})
+            assert resp["Ok"]
+            assert wait_for(lambda: any(
+                m["Name"] == "b0.global" and m["Status"] in ("left", "dead")
+                for m in a.membership.members()))
+        finally:
+            a.shutdown()
+            b.shutdown()

@@ -72,6 +72,11 @@ class ClusterServer:
             raise RuntimeError("connect() before start()")
         self.rpc_server.start()
         self.server.start()
+        # Scheduling workers on every server: followers dequeue and submit
+        # plans over leader RPC (reference: worker.go run on all servers).
+        if (self.config.distributed_workers
+                and self.config.num_schedulers > 0):
+            self.server.start_remote_workers(self.endpoints.pool)
 
     def shutdown(self) -> None:
         if self.membership is not None:

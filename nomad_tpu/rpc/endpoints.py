@@ -22,6 +22,7 @@ from nomad_tpu.structs import (
     Evaluation,
     Job,
     Node,
+    Plan,
     from_dict,
     to_dict,
 )
@@ -104,6 +105,8 @@ class Endpoints:
             "Eval.Dequeue": self.eval_dequeue,
             "Eval.Ack": self.eval_ack,
             "Eval.Nack": self.eval_nack,
+            "Eval.Update": self.eval_update,
+            "Plan.Submit": self.plan_submit,
             "Alloc.List": self.alloc_list,
             "Alloc.GetAlloc": self.alloc_get,
             "Alloc.GetAllocs": self.alloc_get_many,
@@ -401,12 +404,54 @@ class Endpoints:
         return {"Eval": to_dict(ev) if ev else None, "Token": token}
 
     def eval_ack(self, body) -> Dict[str, Any]:
+        if not self.server.eval_broker.enabled():
+            raise NotLeaderError(self.status_leader(body) or None)
         self.server.eval_broker.ack(body["EvalID"], body["Token"])
         return {}
 
     def eval_nack(self, body) -> Dict[str, Any]:
+        if not self.server.eval_broker.enabled():
+            raise NotLeaderError(self.status_leader(body) or None)
         self.server.eval_broker.nack(body["EvalID"], body["Token"])
         return {}
+
+    def _local_backend(self):
+        """The leader-side worker seam: Eval.Update / Plan.Submit delegate
+        to the SAME code path local workers use, so stale-token and reset
+        semantics cannot diverge between in-process and RPC scheduling."""
+        from nomad_tpu.server.worker import LocalBackend
+        return LocalBackend(self.server.raft, self.server.eval_broker,
+                            self.server.plan_queue)
+
+    def eval_update(self, body) -> Dict[str, Any]:
+        """Worker-side eval create/update/reblock through consensus
+        (reference: Eval.Update/Create/Reblock, eval_endpoint.go:98-187 —
+        one endpoint here since all three are an EvalUpdate apply plus an
+        outstanding-token refresh). A stale token raises out of
+        outstanding_reset BEFORE the apply — the FSM applies EvalUpdate
+        unconditionally, so this pre-check is the write barrier."""
+        if not self.server.eval_broker.enabled():
+            raise NotLeaderError(self.status_leader(body) or None)
+        backend = self._local_backend()
+        backend.eval_update(list(body["Evals"]),
+                            body.get("EvalToken", ""),
+                            body.get("ResetID", ""))
+        return {"Index": self.server.state.latest_index()}
+
+    # ----------------------------------------------------------------- plan
+    def plan_submit(self, body) -> Dict[str, Any]:
+        """Leader-brokered plan submission for remote scheduling workers
+        (reference: Plan.Submit, plan_endpoint.go:16-35). Blocks until the
+        plan applier responds; the result's RefreshIndex tells the remote
+        worker how far its local replica must catch up. A stale/unknown
+        EvalToken raises out of the broker reset exactly as it does for a
+        local worker; the applier's own token check remains the commit-time
+        authority (plan_apply.py)."""
+        if not self.server.plan_queue.enabled():
+            raise NotLeaderError(self.status_leader(body) or None)
+        plan = from_dict(Plan, body["Plan"])
+        result = self._local_backend().submit_plan(plan)
+        return {"Result": to_dict(result) if result is not None else None}
 
     # ---------------------------------------------------------------- alloc
     def alloc_list(self, body) -> Dict[str, Any]:

@@ -87,6 +87,9 @@ class ServerConfig:
     # pure-placement evals batch through one device pipeline per window.
     pipelined_scheduling: bool = True
     scheduler_window: int = 32
+    # Scheduling workers on follower servers, dequeuing/submitting over
+    # leader RPC (reference: workers on every server, worker.go:101-130).
+    distributed_workers: bool = True
     dev_mode: bool = False
     # Replicated deployment (reference: nomad/config.go RaftConfig +
     # BootstrapExpect). node_id doubles as the raft/transport address.
@@ -146,6 +149,7 @@ class Server:
             on_expire=self._invalidate_heartbeat)
         self.periodic = PeriodicDispatch(self._dispatch_periodic)
         self.workers: List[Worker] = []
+        self.remote_workers: List[Worker] = []
         self._leader = False
         self._shutdown = threading.Event()
         self._reapers: List[threading.Thread] = []
@@ -161,6 +165,31 @@ class Server:
         if hasattr(self.raft, "is_leader"):
             return self.raft.is_leader()
         return self._leader
+
+    def start_remote_workers(self, pool) -> None:
+        """Run scheduling workers on this server regardless of leadership,
+        resolving broker/plan operations over leader RPC (reference: workers
+        on every server, nomad/worker.go:101-130). The reference's leader
+        pauses 3/4 of its own workers to reserve capacity for plan
+        application (leader.go:110-116); here the leader pauses ALL routed
+        workers and runs its dedicated device-pipelined workers instead —
+        same intent, shaped for the TPU fast path. `_core` GC evals are
+        excluded: the core scheduler writes through raft directly, which is
+        leader-local by construction."""
+        from .worker import RemoteBackend
+        for i in range(self.config.num_schedulers):
+            backend = RemoteBackend(pool, self.raft,
+                                    local_addr=self.config.node_id)
+            w = Worker(self.raft, None, None, None, self.tindex,
+                       schedulers=list(self.config.enabled_schedulers),
+                       backend=backend)
+            # Register under the leadership lock: an election landing here
+            # must either see the worker (establish pauses it) or have
+            # already set _leader (we pause it ourselves).
+            with self._leadership_lock:
+                w.set_pause(self._leader or self.is_leader())
+                self.remote_workers.append(w)
+            w.start(name=f"remote-worker-{i}")
 
     def _leadership_transition(self, is_leader: bool) -> None:
         """(reference: monitorLeadership consuming leaderCh,
@@ -181,6 +210,10 @@ class Server:
     def establish_leadership(self) -> None:
         """(reference: leader.go:107-170)"""
         self._leader = True
+        # The leader's scheduling capacity is its pipelined workers; routed
+        # workers stand down first (reference intent: leader.go:110-116).
+        for w in self.remote_workers:
+            w.set_pause(True)
         self.plan_queue.set_enabled(True)
         self.plan_applier.start()
         self.eval_broker.set_enabled(True)
@@ -242,9 +275,14 @@ class Server:
         self.fsm.on_alloc_terminal = None
         self.fsm.on_job_upsert = None
         self.fsm.on_job_delete = None
+        for w in self.remote_workers:
+            w.set_pause(False)
 
     def shutdown(self) -> None:
         self._shutdown.set()
+        for w in self.remote_workers:
+            w.stop()
+        self.remote_workers = []
         self.revoke_leadership()
         if hasattr(self.raft, "shutdown"):
             self.raft.shutdown()

@@ -4,6 +4,17 @@ Dequeue an evaluation from the broker, wait for the state store to catch up
 to the eval's modify index, snapshot, run the scheduler, act as its Planner
 (submitting plans to the leader's plan queue and creating/updating evals
 through consensus), then ack/nack.
+
+Workers run on EVERY server, not just the leader (reference:
+nomad/worker.go:101-130 — all five broker/plan operations resolve through
+server.forward to the leader). The seam is a backend object: `LocalBackend`
+touches the in-process broker/plan-queue/raft directly (leader), while
+`RemoteBackend` performs the same five operations over leader RPC
+(Eval.Dequeue / Eval.Ack / Eval.Nack / Plan.Submit / Eval.Update), so
+follower CPUs contribute scheduling throughput. The scheduler's state
+snapshots always come from the LOCAL raft replica — followers replicate the
+FSM, and `_wait_for_index` is exactly the reference's raft-sync barrier
+(worker.go:214-244).
 """
 
 from __future__ import annotations
@@ -15,7 +26,7 @@ from typing import List, Optional, Tuple
 
 from nomad_tpu.scheduler import new_scheduler
 from nomad_tpu.scheduler.scheduler import SetStatusError
-from nomad_tpu.structs import Evaluation, Plan, PlanResult
+from nomad_tpu.structs import Evaluation, Plan, PlanResult, from_dict, to_dict
 from nomad_tpu.structs.structs import EvalStatusBlocked
 from nomad_tpu.tensor import TensorIndex
 
@@ -32,20 +43,136 @@ BACKOFF_LIMIT = 1.0
 
 RAFT_SYNC_LIMIT = 10.0  # max wait for state to catch up (worker.go:214)
 DEQUEUE_TIMEOUT = 0.5
+PLAN_WAIT = 30.0
+
+
+class LocalBackend:
+    """Leader-side worker seam: direct access to the in-process broker,
+    plan queue and raft apply (the only mode the reference's LEADER needs;
+    every operation below has an RPC twin in RemoteBackend)."""
+
+    def __init__(self, raft, eval_broker: EvalBroker, plan_queue: PlanQueue):
+        self.raft = raft
+        self.eval_broker = eval_broker
+        self.plan_queue = plan_queue
+
+    def enabled(self) -> bool:
+        return self.eval_broker.enabled()
+
+    def dequeue(self, schedulers: List[str], timeout: float
+                ) -> Tuple[Optional[Evaluation], str]:
+        return self.eval_broker.dequeue(schedulers, timeout)
+
+    def ack(self, eval_id: str, token: str) -> None:
+        self.eval_broker.ack(eval_id, token)
+
+    def nack(self, eval_id: str, token: str) -> None:
+        self.eval_broker.nack(eval_id, token)
+
+    def submit_plan(self, plan: Plan) -> Optional[PlanResult]:
+        pending = self.plan_queue.enqueue(plan)
+        # Keep the nack timer fresh while we wait on the applier.
+        self.eval_broker.outstanding_reset(plan.EvalID, plan.EvalToken)
+        return pending.wait(timeout=PLAN_WAIT)
+
+    def eval_update(self, evals: List[Evaluation], token: str,
+                    reset_id: str) -> None:
+        if reset_id:
+            self.eval_broker.outstanding_reset(reset_id, token)
+        self.raft.apply(MessageType.EvalUpdate, {"Evals": evals,
+                                                 "EvalToken": token})
+
+
+class RemoteBackend:
+    """Follower-side worker seam: the same five operations over RPC to the
+    current raft leader (reference: Eval.Dequeue eval_endpoint.go:68,
+    Plan.Submit plan_endpoint.go:16, Eval.Ack/Nack/Update — each forwarded
+    by server.forward, rpc.go:177-221). Leader discovery is the local raft
+    node's leader hint; while there is no leader (election in flight) every
+    operation backs off instead of erroring."""
+
+    def __init__(self, pool, raft, local_addr: str):
+        self.pool = pool
+        self.raft = raft
+        self.local_addr = local_addr
+
+    def _leader(self) -> Optional[str]:
+        leader = getattr(self.raft, "leader_id", None)
+        if not leader or leader == self.local_addr:
+            return None
+        return leader
+
+    def enabled(self) -> bool:
+        return self._leader() is not None
+
+    def dequeue(self, schedulers: List[str], timeout: float
+                ) -> Tuple[Optional[Evaluation], str]:
+        leader = self._leader()
+        if leader is None:
+            time.sleep(0.1)
+            return None, ""
+        try:
+            resp = self.pool.call(leader, "Eval.Dequeue",
+                                  {"Schedulers": list(schedulers),
+                                   "Timeout": timeout},
+                                  timeout=timeout + 10.0)
+        except Exception:
+            # Leader churn / transport failure: treat as an empty dequeue;
+            # the run loop retries against the next leader hint.
+            time.sleep(0.1)
+            return None, ""
+        ev = resp.get("Eval")
+        return (from_dict(Evaluation, ev) if ev else None), \
+            resp.get("Token", "")
+
+    def ack(self, eval_id: str, token: str) -> None:
+        leader = self._leader()
+        if leader is None:
+            raise RuntimeError("no leader for eval ack")
+        self.pool.call(leader, "Eval.Ack",
+                       {"EvalID": eval_id, "Token": token})
+
+    def nack(self, eval_id: str, token: str) -> None:
+        leader = self._leader()
+        if leader is None:
+            raise RuntimeError("no leader for eval nack")
+        self.pool.call(leader, "Eval.Nack",
+                       {"EvalID": eval_id, "Token": token})
+
+    def submit_plan(self, plan: Plan) -> Optional[PlanResult]:
+        leader = self._leader()
+        if leader is None:
+            raise RuntimeError("no leader for plan submit")
+        resp = self.pool.call(leader, "Plan.Submit",
+                              {"Plan": to_dict(plan)},
+                              timeout=PLAN_WAIT + 15.0)
+        result = resp.get("Result")
+        return from_dict(PlanResult, result) if result else None
+
+    def eval_update(self, evals: List[Evaluation], token: str,
+                    reset_id: str) -> None:
+        leader = self._leader()
+        if leader is None:
+            raise RuntimeError("no leader for eval update")
+        self.pool.call(leader, "Eval.Update",
+                       {"Evals": [to_dict(e) for e in evals],
+                        "EvalToken": token, "ResetID": reset_id})
 
 
 class Worker:
-    def __init__(self, raft: DevRaft, eval_broker: EvalBroker,
-                 plan_queue: PlanQueue,
+    def __init__(self, raft: DevRaft, eval_broker: Optional[EvalBroker],
+                 plan_queue: Optional[PlanQueue],
                  blocked_evals: Optional[BlockedEvals] = None,
                  tindex: Optional[TensorIndex] = None,
-                 schedulers: Optional[List[str]] = None):
+                 schedulers: Optional[List[str]] = None,
+                 backend=None):
         self.raft = raft
         self.eval_broker = eval_broker
         self.plan_queue = plan_queue
         self.blocked_evals = blocked_evals
         self.tindex = tindex
         self.schedulers = schedulers or ["service", "batch", "system"]
+        self.backend = backend or LocalBackend(raft, eval_broker, plan_queue)
         self._stop = threading.Event()
         self._paused = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -91,7 +218,7 @@ class Worker:
                 # Leadership loss tears down the plan queue / broker under a
                 # mid-flight eval; drop quietly, redelivery handles the rest
                 # (reference: worker pause on leadership, worker.go:88-99).
-                if self._stop.is_set() or not self.eval_broker.enabled():
+                if self._stop.is_set() or not self.backend.enabled():
                     logger.debug("worker: dropping eval %s on shutdown", ev.ID)
                     continue
                 logger.exception("worker: failed to process eval %s", ev.ID)
@@ -119,7 +246,7 @@ class Worker:
     def _dequeue_evaluation(self, timeout: float = DEQUEUE_TIMEOUT
                             ) -> Optional[Tuple[Evaluation, str]]:
         try:
-            ev, token = self.eval_broker.dequeue(self.schedulers, timeout)
+            ev, token = self.backend.dequeue(self.schedulers, timeout)
         except RuntimeError:
             time.sleep(BACKOFF_BASELINE)
             return None
@@ -149,13 +276,13 @@ class Worker:
     # ------------------------------------------------------------ ack / nack
     def _send_ack(self, eval_id: str, token: str) -> None:
         try:
-            self.eval_broker.ack(eval_id, token)
+            self.backend.ack(eval_id, token)
         except Exception:
             logger.exception("worker: ack failed for %s", eval_id)
 
     def _send_nack(self, eval_id: str, token: str) -> None:
         try:
-            self.eval_broker.nack(eval_id, token)
+            self.backend.nack(eval_id, token)
         except Exception:
             logger.exception("worker: nack failed for %s", eval_id)
 
@@ -163,12 +290,11 @@ class Worker:
     def submit_plan(self, plan: Plan) -> Tuple[Optional[PlanResult], Optional[object]]:
         """(reference: worker.go:285-342)"""
         plan.EvalToken = self._token
-        pending = self.plan_queue.enqueue(plan)
-        # Keep the nack timer fresh while we wait on the applier.
-        self.eval_broker.outstanding_reset(plan.EvalID, self._token)
-        result = pending.wait(timeout=30.0)
+        result = self.backend.submit_plan(plan)
 
         # If the state is behind the plan result, refresh before retrying.
+        # The wait runs against the LOCAL replica: followers see the applied
+        # plan through raft replication (reference: worker.go:330-340).
         state = None
         if result is not None and result.RefreshIndex > 0:
             self._wait_for_index(result.RefreshIndex)
@@ -177,20 +303,15 @@ class Worker:
 
     def update_eval(self, ev: Evaluation) -> None:
         """(reference: worker.go:345-371)"""
-        self.eval_broker.outstanding_reset(ev.ID, self._token)
-        self.raft.apply(MessageType.EvalUpdate, {"Evals": [ev],
-                                                 "EvalToken": self._token})
+        self.backend.eval_update([ev], self._token, ev.ID)
 
     def create_eval(self, ev: Evaluation) -> None:
         """(reference: worker.go:373-398)"""
         ev.SnapshotIndex = self._snapshot.latest_index() if self._snapshot else 0
-        self.eval_broker.outstanding_reset(self._eval.ID, self._token)
-        self.raft.apply(MessageType.EvalUpdate, {"Evals": [ev],
-                                                 "EvalToken": self._token})
+        self.backend.eval_update([ev], self._token,
+                                 self._eval.ID if self._eval else "")
 
     def reblock_eval(self, ev: Evaluation) -> None:
         """(reference: worker.go:400-426)"""
-        self.eval_broker.outstanding_reset(ev.ID, self._token)
         ev.SnapshotIndex = self._snapshot.latest_index() if self._snapshot else 0
-        self.raft.apply(MessageType.EvalUpdate, {"Evals": [ev],
-                                                 "EvalToken": self._token})
+        self.backend.eval_update([ev], self._token, ev.ID)

@@ -48,6 +48,9 @@ class AgentConfig:
     meta: Dict[str, str] = field(default_factory=dict)
     options: Dict[str, str] = field(default_factory=dict)
     dev_mode: bool = False
+    # Telemetry (reference: command/agent/config.go Telemetry block)
+    statsd_addr: str = ""
+    telemetry_interval: float = 10.0
 
     @staticmethod
     def dev() -> "AgentConfig":
@@ -74,6 +77,11 @@ class Agent:
             config.node_name = socket.gethostname()
 
     def start(self) -> None:
+        # (reference: command/agent/command.go:556-580 setupTelemetry)
+        from nomad_tpu.telemetry import metrics
+        metrics.configure(statsd_addr=self.config.statsd_addr,
+                          collection_interval=self.config.telemetry_interval,
+                          host_label=self.config.node_name)
         if self.config.server_enabled:
             if self.config.dev_mode:
                 self._setup_dev_server()

@@ -18,6 +18,7 @@ from __future__ import annotations
 import json
 
 from nomad_tpu.jobspec.hcl import parse as parse_hcl
+from nomad_tpu.jobspec.parse import parse_duration
 
 from .agent import AgentConfig
 
@@ -51,6 +52,12 @@ def config_from_dict(data: dict) -> AgentConfig:
                                           cfg.bootstrap_expect))
     join = server.get("start_join") or []
     cfg.start_join = [join] if isinstance(join, str) else list(join)
+
+    telemetry = data.get("telemetry") or {}
+    cfg.statsd_addr = telemetry.get("statsd_address", cfg.statsd_addr)
+    if "collection_interval" in telemetry:
+        cfg.telemetry_interval = parse_duration(
+            telemetry["collection_interval"]) / 1e9
 
     client = data.get("client") or {}
     cfg.client_enabled = bool(client.get("enabled", False))

@@ -473,6 +473,11 @@ def route(agent, method: str, path: str, query, get_body):
         return out, None
     if path == "/v1/agent/members":
         return agent.members(), None
+    if path == "/v1/agent/metrics":
+        # In-memory telemetry snapshot (reference shape: go-metrics
+        # DisplayMetrics behind the agent metrics endpoint).
+        from nomad_tpu.telemetry import metrics as _metrics
+        return _metrics.snapshot(), None
     if path == "/v1/agent/join":
         _require_write(method)
         addrs = query.get("address", [])

@@ -250,6 +250,10 @@ class Agent:
     def members(self):
         return self.c.get("/v1/agent/members")[0]
 
+    def metrics(self):
+        """Telemetry snapshot (gauges/counters/samples)."""
+        return self.c.get("/v1/agent/metrics")[0]
+
     def join(self, addresses):
         """(reference: api/agent.go Join)"""
         qs = "&".join("address=" + urllib.parse.quote(a) for a in addresses)

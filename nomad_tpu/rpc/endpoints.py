@@ -17,6 +17,7 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from nomad_tpu.raft.node import NotLeaderError
 from nomad_tpu.state.watch import Item
+from nomad_tpu.telemetry import metrics
 from nomad_tpu.structs import (
     Allocation,
     Evaluation,
@@ -121,14 +122,21 @@ class Endpoints:
 
     # ------------------------------------------------------------- dispatch
     def handle(self, method: str, body: Any) -> Any:
-        body = dict(body or {})
-        region = body.get("Region") or self.server.config.region
-        if region != self.server.config.region:
-            return self._forward_region(region, method, body)
+        """Every RPC is timed under nomad.rpc.<Method> (reference: the
+        per-endpoint MeasureSince calls, e.g. eval_endpoint.go:73)."""
+        start = time.monotonic()
+        metrics.incr_counter(("nomad", "rpc", "request"))
         try:
-            return self._methods[method](body)
-        except NotLeaderError as exc:
-            return self._forward_leader(method, body, exc)
+            body = dict(body or {})
+            region = body.get("Region") or self.server.config.region
+            if region != self.server.config.region:
+                return self._forward_region(region, method, body)
+            try:
+                return self._methods[method](body)
+            except NotLeaderError as exc:
+                return self._forward_leader(method, body, exc)
+        finally:
+            metrics.measure_since(("nomad", "rpc", method), start)
 
     def _forward_region(self, region: str, method: str,
                         body: Dict[str, Any]) -> Any:

@@ -17,6 +17,7 @@ from typing import Any, Callable, Dict, List, Optional
 
 from nomad_tpu.server.timetable import TimeTable
 from nomad_tpu.state.state_store import StateStore
+from nomad_tpu.telemetry import metrics
 from nomad_tpu.structs import (
     Allocation,
     Evaluation,
@@ -50,6 +51,24 @@ class MessageType(enum.IntEnum):
     PeriodicLaunchDelete = 11
 
 
+# Metric leaf names per message type (reference: the MeasureSince keys in
+# each fsm.go apply handler, fsm.go:147-430).
+_MSG_METRIC = {
+    MessageType.NodeRegister: "register_node",
+    MessageType.NodeDeregister: "deregister_node",
+    MessageType.NodeUpdateStatus: "node_status_update",
+    MessageType.NodeUpdateDrain: "node_drain_update",
+    MessageType.JobRegister: "register_job",
+    MessageType.JobDeregister: "deregister_job",
+    MessageType.EvalUpdate: "update_eval",
+    MessageType.EvalDelete: "delete_eval",
+    MessageType.AllocUpdate: "alloc_update",
+    MessageType.AllocClientUpdate: "alloc_client_update",
+    MessageType.PeriodicLaunchType: "periodic_launch",
+    MessageType.PeriodicLaunchDelete: "periodic_launch_delete",
+}
+
+
 class FSM:
     """Applies typed messages to the state store."""
 
@@ -68,10 +87,16 @@ class FSM:
         self.on_alloc_terminal: Optional[Callable[[Allocation], None]] = None
 
     def apply(self, index: int, msg_type: MessageType, payload: Dict[str, Any]) -> Any:
-        """(reference: fsm.go:99-144 Apply dispatch)"""
+        """(reference: fsm.go:99-144 Apply dispatch; each handler is timed
+        under nomad.fsm.<op> as in fsm.go:147 MeasureSince)"""
+        start = time.monotonic()
         self.timetable.witness(index, time.time())
         handler = _HANDLERS[msg_type]
-        return handler(self, index, payload)
+        try:
+            return handler(self, index, payload)
+        finally:
+            metrics.measure_since(("nomad", "fsm", _MSG_METRIC[msg_type]),
+                                  start)
 
     # ------------------------------------------------------------- handlers
     def _apply_node_register(self, index: int, req: Dict[str, Any]):

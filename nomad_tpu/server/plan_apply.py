@@ -34,6 +34,7 @@ from nomad_tpu.structs import (
     remove_allocs,
 )
 from nomad_tpu.structs.structs import NodeStatusReady
+from nomad_tpu.telemetry import metrics
 
 from .eval_broker import EvalBroker
 from .fsm import DevRaft, MessageType
@@ -235,7 +236,8 @@ class PlanApplier:
                 self.stats["rejected"] += 1
                 return None
         try:
-            result = evaluate_plan(opt, plan, self._pool)
+            with metrics.measure(("nomad", "plan", "evaluate")):
+                result = evaluate_plan(opt, plan, self._pool)
         except Exception as e:  # verification error: reject the plan
             pending.respond(None, e)
             self.stats["rejected"] += 1
@@ -249,7 +251,8 @@ class PlanApplier:
         """Commit through consensus, then answer the waiting worker
         (reference: applyPlan + asyncPlanWait, plan_apply.go:122-190)."""
         try:
-            index = self._apply(plan, result)
+            with metrics.measure(("nomad", "plan", "apply")):
+                index = self._apply(plan, result)
             result.AllocIndex = index
             self.stats["applied"] += 1
             pending.respond(result, None)

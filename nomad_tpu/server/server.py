@@ -47,6 +47,7 @@ from nomad_tpu.structs.structs import (
     NodeStatusReady,
     valid_node_status,
 )
+from nomad_tpu.telemetry import metrics
 from nomad_tpu.tensor import TensorIndex
 
 from .blocked_evals import BlockedEvals
@@ -257,6 +258,7 @@ class Server:
                          self.config.node_gc_interval)
         self._start_loop(self.blocked_evals.unblock_failed,
                          self.config.failed_eval_unblock_interval)
+        self._start_loop(self._emit_stats, 1.0)
 
     def revoke_leadership(self) -> None:
         """(reference: leader.go:390-431)"""
@@ -286,6 +288,34 @@ class Server:
         self.revoke_leadership()
         if hasattr(self.raft, "shutdown"):
             self.raft.shutdown()
+
+    def _emit_stats(self) -> None:
+        """Leader-side operational gauges, emitted every second
+        (reference: EmitStats loops — eval_broker.go:650-662,
+        blocked_evals.go:440-441, plan_queue EmitStats, heartbeat count
+        gauge in leader.go)."""
+        bs = self.eval_broker.stats
+        metrics.set_gauge(("nomad", "broker", "total_ready"), bs.TotalReady)
+        metrics.set_gauge(("nomad", "broker", "total_unacked"),
+                          bs.TotalUnacked)
+        metrics.set_gauge(("nomad", "broker", "total_blocked"),
+                          bs.TotalBlocked)
+        metrics.set_gauge(("nomad", "broker", "total_waiting"),
+                          bs.TotalWaiting)
+        for sched, ss in list(bs.ByScheduler.items()):
+            metrics.set_gauge(("nomad", "broker", sched, "ready"),
+                              ss.get("Ready", 0))
+            metrics.set_gauge(("nomad", "broker", sched, "unacked"),
+                              ss.get("Unacked", 0))
+        blocked = self.blocked_evals.stats
+        metrics.set_gauge(("nomad", "blocked_evals", "total_blocked"),
+                          blocked.TotalBlocked)
+        metrics.set_gauge(("nomad", "blocked_evals", "total_escaped"),
+                          blocked.TotalEscaped)
+        metrics.set_gauge(("nomad", "plan", "queue_depth"),
+                          self.plan_queue.stats["Depth"])
+        metrics.set_gauge(("nomad", "heartbeat", "active"),
+                          len(self.heartbeats))
 
     def _start_loop(self, fn, interval: float) -> None:
         def loop():

@@ -26,6 +26,7 @@ from typing import List, Optional, Tuple
 
 from nomad_tpu.scheduler import new_scheduler
 from nomad_tpu.scheduler.scheduler import SetStatusError
+from nomad_tpu.telemetry import metrics
 from nomad_tpu.structs import Evaluation, Plan, PlanResult, from_dict, to_dict
 from nomad_tpu.structs.structs import EvalStatusBlocked
 from nomad_tpu.tensor import TensorIndex
@@ -256,22 +257,34 @@ class Worker:
 
     def _wait_for_index(self, index: int) -> None:
         """Raft-sync barrier (reference: worker.go:214-244)."""
-        deadline = time.monotonic() + RAFT_SYNC_LIMIT
-        while self.raft.fsm.state.latest_index() < index:
-            if time.monotonic() > deadline:
-                raise TimeoutError(f"timed out waiting for index {index}")
-            time.sleep(0.001)
+        start = time.monotonic()
+        deadline = start + RAFT_SYNC_LIMIT
+        try:
+            while self.raft.fsm.state.latest_index() < index:
+                if time.monotonic() > deadline:
+                    raise TimeoutError(
+                        f"timed out waiting for index {index}")
+                time.sleep(0.001)
+        finally:
+            metrics.measure_since(("nomad", "worker", "wait_for_index"),
+                                  start)
 
     def _invoke_scheduler(self, ev: Evaluation, token: str) -> None:
-        """(reference: worker.go:246-283)"""
-        self._snapshot = self.raft.fsm.state.snapshot()
-        if ev.Type == "_core":
-            if self.core_scheduler is not None:
-                self.core_scheduler.process(ev)
-            return
-        sched = new_scheduler(ev.Type, self._snapshot, self, self.tindex,
-                              logger)
-        sched.process(ev)
+        """(reference: worker.go:246-283; timed per scheduler type like
+        worker.go's invoke_scheduler MeasureSince)"""
+        start = time.monotonic()
+        try:
+            self._snapshot = self.raft.fsm.state.snapshot()
+            if ev.Type == "_core":
+                if self.core_scheduler is not None:
+                    self.core_scheduler.process(ev)
+                return
+            sched = new_scheduler(ev.Type, self._snapshot, self,
+                                  self.tindex, logger)
+            sched.process(ev)
+        finally:
+            metrics.measure_since(
+                ("nomad", "worker", "invoke_scheduler", ev.Type), start)
 
     # ------------------------------------------------------------ ack / nack
     def _send_ack(self, eval_id: str, token: str) -> None:
@@ -289,8 +302,12 @@ class Worker:
     # --------------------------------------------------------- Planner seam
     def submit_plan(self, plan: Plan) -> Tuple[Optional[PlanResult], Optional[object]]:
         """(reference: worker.go:285-342)"""
+        start = time.monotonic()
         plan.EvalToken = self._token
-        result = self.backend.submit_plan(plan)
+        try:
+            result = self.backend.submit_plan(plan)
+        finally:
+            metrics.measure_since(("nomad", "worker", "submit_plan"), start)
 
         # If the state is behind the plan result, refresh before retrying.
         # The wait runs against the LOCAL replica: followers see the applied

@@ -1,0 +1,31 @@
+"""Telemetry: operational metrics with in-memory aggregation and push sinks
+(reference: the go-metrics instrumentation threaded through nomad/*.go and
+configured by command/agent/command.go setupTelemetry)."""
+
+from .metrics import (
+    InMemSink,
+    MetricsRegistry,
+    StatsdSink,
+    add_sample,
+    configure,
+    incr_counter,
+    measure,
+    measure_since,
+    registry,
+    set_gauge,
+    snapshot,
+)
+
+__all__ = [
+    "InMemSink",
+    "MetricsRegistry",
+    "StatsdSink",
+    "add_sample",
+    "configure",
+    "incr_counter",
+    "measure",
+    "measure_since",
+    "registry",
+    "set_gauge",
+    "snapshot",
+]

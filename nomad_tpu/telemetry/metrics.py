@@ -1,0 +1,231 @@
+"""Operational metrics: counters, gauges, and latency samples with pluggable
+sinks (reference: the armon/go-metrics surface the reference instruments
+through — MeasureSince/IncrCounter/SetGauge calls like nomad/fsm.go:147,
+nomad/eval_broker.go:650-662 — with its InmemSink interval aggregation and
+statsd push sink, configured from command/agent/command.go:556-580).
+
+Design notes (TPU-first framework, Python runtime): one process-global
+registry with a plain lock — every op is a couple of dict writes, far below
+the cost of the raft/RPC/scheduler work being measured. Timings are
+milliseconds (go-metrics convention). Keys are tuples of path segments,
+rendered dotted ("nomad.fsm.apply") for sinks and the HTTP endpoint.
+"""
+
+from __future__ import annotations
+
+import socket
+import threading
+import time
+from contextlib import contextmanager
+from typing import Any, Dict, Iterable, List, Optional, Tuple
+
+Key = Tuple[str, ...]
+
+
+def _name(key: Iterable[str]) -> str:
+    return ".".join(str(p) for p in key)
+
+
+class _Aggregate:
+    """Streaming count/sum/min/max for one metric within one interval
+    (reference: go-metrics AggregateSample)."""
+
+    __slots__ = ("count", "sum", "min", "max")
+
+    def __init__(self) -> None:
+        self.count = 0
+        self.sum = 0.0
+        self.min = float("inf")
+        self.max = float("-inf")
+
+    def ingest(self, v: float) -> None:
+        self.count += 1
+        self.sum += v
+        if v < self.min:
+            self.min = v
+        if v > self.max:
+            self.max = v
+
+    def to_dict(self, name: str) -> Dict[str, Any]:
+        mean = self.sum / self.count if self.count else 0.0
+        return {"Name": name, "Count": self.count, "Sum": self.sum,
+                "Min": self.min if self.count else 0.0,
+                "Max": self.max if self.count else 0.0, "Mean": mean}
+
+
+class InMemSink:
+    """Fixed-interval aggregating sink backing /v1/agent/metrics and the
+    SIGUSR1-style dump (reference: go-metrics inmem.go — gauges keep last
+    value, counters and samples aggregate per interval, a bounded ring of
+    past intervals is retained)."""
+
+    def __init__(self, interval: float = 10.0, retain: int = 60):
+        # Sub-second intervals make every sample its own interval (and 0
+        # would divide by zero inside the swallow-all sink fan-out, silently
+        # blanking telemetry) — floor to 1s.
+        self.interval = max(float(interval), 1.0)
+        self.retain = retain
+        self._lock = threading.Lock()
+        self._intervals: List[Dict[str, Any]] = []
+
+    def _current(self, now: float) -> Dict[str, Any]:
+        start = now - (now % self.interval)
+        cur = self._intervals[-1] if self._intervals else None
+        if cur is None or cur["start"] != start:
+            cur = {"start": start, "gauges": {}, "counters": {},
+                   "samples": {}}
+            self._intervals.append(cur)
+            if len(self._intervals) > self.retain:
+                self._intervals = self._intervals[-self.retain:]
+        return cur
+
+    def set_gauge(self, key: Key, value: float) -> None:
+        with self._lock:
+            self._current(time.time())["gauges"][_name(key)] = value
+
+    def incr_counter(self, key: Key, value: float) -> None:
+        with self._lock:
+            cur = self._current(time.time())["counters"]
+            agg = cur.get(_name(key))
+            if agg is None:
+                agg = cur[_name(key)] = _Aggregate()
+            agg.ingest(value)
+
+    def add_sample(self, key: Key, value: float) -> None:
+        with self._lock:
+            cur = self._current(time.time())["samples"]
+            agg = cur.get(_name(key))
+            if agg is None:
+                agg = cur[_name(key)] = _Aggregate()
+            agg.ingest(value)
+
+    def snapshot(self) -> Dict[str, Any]:
+        """Most recent complete-or-current interval, display-formatted
+        (reference: go-metrics DisplayMetrics shape behind the agent
+        metrics endpoint)."""
+        with self._lock:
+            if not self._intervals:
+                return {"Timestamp": "", "Gauges": [], "Counters": [],
+                        "Samples": []}
+            cur = self._intervals[-1]
+            return {
+                "Timestamp": time.strftime(
+                    "%Y-%m-%d %H:%M:%S +0000",
+                    time.gmtime(cur["start"])),
+                "Gauges": [{"Name": n, "Value": v}
+                           for n, v in sorted(cur["gauges"].items())],
+                "Counters": [agg.to_dict(n) for n, agg in
+                             sorted(cur["counters"].items())],
+                "Samples": [agg.to_dict(n) for n, agg in
+                            sorted(cur["samples"].items())],
+            }
+
+
+class StatsdSink:
+    """Push sink emitting statsd datagrams over UDP, best-effort
+    (reference: go-metrics statsd.go — gauges as |g, counters as |c,
+    timers as |ms). Never raises into the instrumented path."""
+
+    def __init__(self, addr: str):
+        host, port = addr.rsplit(":", 1)
+        # Resolve once: an unresolved hostname target would pay a DNS
+        # lookup on every sendto from instrumented hot paths.
+        info = socket.getaddrinfo(host, int(port), socket.AF_INET,
+                                  socket.SOCK_DGRAM)
+        self._target = info[0][4]
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        self._sock.setblocking(False)
+
+    def _send(self, payload: str) -> None:
+        try:
+            self._sock.sendto(payload.encode(), self._target)
+        except OSError:
+            pass
+
+    def set_gauge(self, key: Key, value: float) -> None:
+        self._send(f"{_name(key)}:{value:g}|g")
+
+    def incr_counter(self, key: Key, value: float) -> None:
+        self._send(f"{_name(key)}:{value:g}|c")
+
+    def add_sample(self, key: Key, value: float) -> None:
+        self._send(f"{_name(key)}:{value:g}|ms")
+
+    def close(self) -> None:
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+class MetricsRegistry:
+    """Fan-out front for all sinks. Always carries one InMemSink so the
+    agent metrics endpoint works without configuration."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self.inmem = InMemSink()
+        self._sinks: List[Any] = [self.inmem]
+        self.host_label: str = ""
+
+    def configure(self, statsd_addr: str = "",
+                  collection_interval: float = 10.0,
+                  host_label: str = "") -> None:
+        """(reference: command/agent/command.go:556-580 setupTelemetry)"""
+        with self._lock:
+            self.inmem = InMemSink(interval=collection_interval)
+            sinks: List[Any] = [self.inmem]
+            if statsd_addr:
+                sinks.append(StatsdSink(statsd_addr))
+            self._sinks = sinks
+            self.host_label = host_label
+
+    def add_sink(self, sink: Any) -> None:
+        with self._lock:
+            self._sinks.append(sink)
+
+    def _fan(self, op: str, key: Key, value: float) -> None:
+        for sink in self._sinks:
+            try:
+                getattr(sink, op)(key, value)
+            except Exception:
+                pass  # a broken sink must never break the measured path
+
+    # ------------------------------------------------------------- surface
+    def set_gauge(self, key: Key, value: float) -> None:
+        self._fan("set_gauge", tuple(key), float(value))
+
+    def incr_counter(self, key: Key, value: float = 1.0) -> None:
+        self._fan("incr_counter", tuple(key), float(value))
+
+    def add_sample(self, key: Key, value: float) -> None:
+        self._fan("add_sample", tuple(key), float(value))
+
+    def measure_since(self, key: Key, start: float) -> None:
+        """`start` is a time.monotonic() stamp; records milliseconds."""
+        self.add_sample(tuple(key), (time.monotonic() - start) * 1000.0)
+
+    @contextmanager
+    def measure(self, key: Key):
+        start = time.monotonic()
+        try:
+            yield
+        finally:
+            self.measure_since(key, start)
+
+    def snapshot(self) -> Dict[str, Any]:
+        return self.inmem.snapshot()
+
+
+# Process-global registry: instrumentation sites call these directly, the
+# agent configures sinks at boot (reference: go-metrics global metrics
+# singleton initialised by setupTelemetry).
+registry = MetricsRegistry()
+
+set_gauge = registry.set_gauge
+incr_counter = registry.incr_counter
+add_sample = registry.add_sample
+measure_since = registry.measure_since
+measure = registry.measure
+snapshot = registry.snapshot
+configure = registry.configure

@@ -58,6 +58,27 @@ class TestHTTPAPI:
         assert members[0]["Status"] == "alive"
         assert api.regions.list() == ["global"]
 
+    def test_agent_metrics_endpoint(self, dev_agent):
+        agent, api = dev_agent
+        # Force one FSM apply into the current collection interval so the
+        # assertion is deterministic regardless of interval rotation.
+        from nomad_tpu import mock
+        node = mock.node()
+        agent.server.node_register(node)
+        try:
+            snap = api.agent.metrics()
+            assert set(snap) == {"Timestamp", "Gauges", "Counters",
+                                 "Samples"}
+            # The HTTP snapshot shows the current interval; the sample we
+            # just forced may land either side of a rotation boundary, so
+            # assert against the sink's retained intervals.
+            from nomad_tpu.telemetry import registry
+            assert any("nomad.fsm.register_node" in iv["samples"]
+                       for iv in registry.inmem._intervals)
+        finally:
+            # Leave the shared dev agent's node list as we found it.
+            agent.server.node_deregister(node.ID)
+
     def test_nodes_listed(self, dev_agent):
         agent, api = dev_agent
         assert wait_for(lambda: len(api.nodes.list()[0]) == 1)

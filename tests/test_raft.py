@@ -203,6 +203,9 @@ class TestReplication:
             assert wait_for(lambda: leader_of(nodes) is not None)
             leader = leader_of(nodes)
             follower = [n for n in nodes if n is not leader][0]
+            # The hint arrives with the first AppendEntries from the new
+            # leader; wait for it so the assertion isn't heartbeat-raced.
+            assert wait_for(lambda: follower.leader_id == leader.id)
             with pytest.raises(NotLeaderError) as exc:
                 follower.apply_command(cmd("nope"))
             assert exc.value.leader_hint == leader.id

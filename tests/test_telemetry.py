@@ -1,0 +1,145 @@
+"""Telemetry tests: sink aggregation, statsd datagrams, and counters
+advancing through a real scheduling cycle (reference shapes: go-metrics
+inmem/statsd behavior; EmitStats gauges of eval_broker.go:650-662)."""
+
+import socket
+import time
+
+from nomad_tpu import mock, telemetry
+from nomad_tpu.server import Server, ServerConfig
+from nomad_tpu.structs.structs import EvalStatusComplete
+from nomad_tpu.telemetry.metrics import InMemSink, MetricsRegistry, StatsdSink
+
+
+def wait_for(cond, timeout=15.0, interval=0.05):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if cond():
+            return True
+        time.sleep(interval)
+    return False
+
+
+class TestInMemSink:
+    def test_gauge_keeps_last_value(self):
+        sink = InMemSink(interval=60.0)
+        sink.set_gauge(("a", "b"), 1.0)
+        sink.set_gauge(("a", "b"), 5.0)
+        snap = sink.snapshot()
+        assert snap["Gauges"] == [{"Name": "a.b", "Value": 5.0}]
+
+    def test_samples_aggregate(self):
+        sink = InMemSink(interval=60.0)
+        for v in (10.0, 20.0, 30.0):
+            sink.add_sample(("lat",), v)
+        [s] = sink.snapshot()["Samples"]
+        assert s["Count"] == 3
+        assert s["Sum"] == 60.0
+        assert s["Min"] == 10.0 and s["Max"] == 30.0
+        assert abs(s["Mean"] - 20.0) < 1e-9
+
+    def test_counters_aggregate(self):
+        sink = InMemSink(interval=60.0)
+        sink.incr_counter(("hits",), 1)
+        sink.incr_counter(("hits",), 1)
+        [c] = sink.snapshot()["Counters"]
+        assert c["Count"] == 2 and c["Sum"] == 2.0
+
+    def test_interval_rotation_bounded(self):
+        sink = InMemSink(interval=1.0, retain=3)
+        for i in range(10):
+            with sink._lock:
+                sink._current(1000.0 + i)  # each stamp its own interval
+        assert len(sink._intervals) <= 3
+
+    def test_interval_floored_to_one_second(self):
+        # 0 would divide-by-zero inside the swallow-all sink fan-out and
+        # silently blank telemetry; sub-second fragments every sample.
+        assert InMemSink(interval=0).interval == 1.0
+        assert InMemSink(interval=0.001).interval == 1.0
+
+
+class TestStatsdSink:
+    def test_datagrams_cross_the_socket(self):
+        recv = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        recv.bind(("127.0.0.1", 0))
+        recv.settimeout(2.0)
+        addr = "127.0.0.1:%d" % recv.getsockname()[1]
+        sink = StatsdSink(addr)
+        sink.set_gauge(("nomad", "broker", "total_ready"), 4)
+        sink.incr_counter(("nomad", "rpc", "request"), 1)
+        sink.add_sample(("nomad", "fsm", "register_job"), 1.25)
+        got = set()
+        for _ in range(3):
+            got.add(recv.recv(1024).decode())
+        assert "nomad.broker.total_ready:4|g" in got
+        assert "nomad.rpc.request:1|c" in got
+        assert "nomad.fsm.register_job:1.25|ms" in got
+        sink.close()
+        recv.close()
+
+
+class TestRegistry:
+    def test_measure_records_milliseconds(self):
+        reg = MetricsRegistry()
+        with reg.measure(("op",)):
+            time.sleep(0.01)
+        [s] = reg.snapshot()["Samples"]
+        assert s["Name"] == "op"
+        assert s["Min"] >= 5.0  # ms, not seconds
+
+    def test_broken_sink_never_breaks_caller(self):
+        reg = MetricsRegistry()
+
+        class Bad:
+            def set_gauge(self, k, v):
+                raise RuntimeError("boom")
+
+        reg.add_sink(Bad())
+        reg.set_gauge(("g",), 1)  # must not raise
+        assert reg.snapshot()["Gauges"][0]["Value"] == 1
+
+
+class TestSchedulingCycleMetrics:
+    def test_counters_advance_through_a_cycle(self):
+        """One job register -> schedule -> commit cycle must leave FSM
+        apply timers, plan evaluate/apply timers, and broker gauges in the
+        global registry (reference: fsm.go:147, plan_apply.go:168,195,
+        eval_broker.go:650)."""
+        # Fresh in-mem sink with a huge interval: counts cannot rotate away
+        # mid-test and earlier tests' noise is discarded.
+        telemetry.configure(collection_interval=3600.0)
+        before = telemetry.snapshot()
+
+        def sample_count(snap, name):
+            for s in snap["Samples"]:
+                if s["Name"] == name:
+                    return s["Count"]
+            return 0
+
+        srv = Server(ServerConfig(num_schedulers=1, dev_mode=True))
+        try:
+            srv.establish_leadership()
+            for _ in range(2):
+                srv.node_register(mock.node())
+            job = mock.job()
+            eval_id, _, _ = srv.job_register(job)
+            assert wait_for(lambda: (
+                (e := srv.state.eval_by_id(eval_id)) is not None
+                and e.Status == EvalStatusComplete))
+            srv._emit_stats()
+            snap = telemetry.snapshot()
+            assert sample_count(snap, "nomad.fsm.register_job") \
+                > sample_count(before, "nomad.fsm.register_job")
+            assert sample_count(snap, "nomad.fsm.register_node") \
+                > sample_count(before, "nomad.fsm.register_node")
+            assert sample_count(snap, "nomad.plan.evaluate") \
+                > sample_count(before, "nomad.plan.evaluate")
+            assert sample_count(snap, "nomad.plan.apply") \
+                > sample_count(before, "nomad.plan.apply")
+            gauges = {g["Name"] for g in snap["Gauges"]}
+            assert "nomad.broker.total_ready" in gauges
+            assert "nomad.plan.queue_depth" in gauges
+            assert "nomad.heartbeat.active" in gauges
+        finally:
+            srv.shutdown()

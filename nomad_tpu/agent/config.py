@@ -56,8 +56,14 @@ def config_from_dict(data: dict) -> AgentConfig:
     telemetry = data.get("telemetry") or {}
     cfg.statsd_addr = telemetry.get("statsd_address", cfg.statsd_addr)
     if "collection_interval" in telemetry:
-        cfg.telemetry_interval = parse_duration(
-            telemetry["collection_interval"]) / 1e9
+        # Bare numbers mean SECONDS here (an interval config, not a wire
+        # duration): interpreting 30 as 30ns would silently floor to the
+        # sink minimum. Strings take Go duration syntax ("10s", "1m").
+        raw = telemetry["collection_interval"]
+        if isinstance(raw, (int, float)):
+            cfg.telemetry_interval = float(raw)
+        else:
+            cfg.telemetry_interval = parse_duration(raw) / 1e9
 
     client = data.get("client") or {}
     cfg.client_enabled = bool(client.get("enabled", False))

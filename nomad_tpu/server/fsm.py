@@ -95,8 +95,9 @@ class FSM:
         try:
             return handler(self, index, payload)
         finally:
-            metrics.measure_since(("nomad", "fsm", _MSG_METRIC[msg_type]),
-                                  start)
+            metrics.measure_since(
+                ("nomad", "fsm",
+                 _MSG_METRIC.get(msg_type, msg_type.name.lower())), start)
 
     # ------------------------------------------------------------- handlers
     def _apply_node_register(self, index: int, req: Dict[str, Any]):

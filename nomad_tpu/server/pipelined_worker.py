@@ -94,6 +94,7 @@ class PipelinedWorker(Worker):
     def __init__(self, *args, window: int = 32, **kwargs):
         super().__init__(*args, **kwargs)
         self.window = max(1, window)
+        self._noise: Optional[np.ndarray] = None
         # Observability: how evals flowed (fast = device-chained window,
         # slow = per-eval GenericScheduler, fallback = fast dispatch that
         # re-ran slow after partial commit / port collision).
@@ -144,14 +145,28 @@ class PipelinedWorker(Worker):
         slow: List[Tuple[Evaluation, str]] = []
         usage_chain = None
         # Shared per-window: every eval sees the same snapshot, so the ready
-        # node list, candidate mask, and class-eligibility cache are built
-        # once per datacenter set, not once per eval.
+        # node list, candidate mask, class-eligibility cache, AND the node
+        # table's device arrays (whose dirty-row refresh is a blocking
+        # host->device transfer) are built once per window, not once per
+        # eval. The tie-break noise is refreshed every 64 windows — enough
+        # to spread load across ties without paying an upload per window.
         node_cache: Dict[tuple, tuple] = {}
+        nt = self.tindex.nt
+        tables = nt.device_arrays()
+        if self._noise is None or self._noise.shape[0] != nt.n_rows \
+                or self.stats["windows"] % 64 == 0:
+            from nomad_tpu.scheduler.stack import _NOISE_SCALE
+
+            self._noise = np.asarray(
+                np.random.default_rng(
+                    np.random.randint(2**31)).random(nt.n_rows),
+                dtype=np.float32) * _NOISE_SCALE
+        noise_vec = self._noise
         for ev, token in batch:
             rec = None
             try:
                 rec = self._try_dispatch_fast(ev, token, snap, usage_chain,
-                                              node_cache)
+                                              node_cache, noise_vec, tables)
             except Exception:
                 logger.exception("fast dispatch failed for eval %s", ev.ID)
             if rec is None:
@@ -169,7 +184,9 @@ class PipelinedWorker(Worker):
 
     def _try_dispatch_fast(self, ev: Evaluation, token: str, snap,
                            usage_chain,
-                           node_cache: Dict[tuple, tuple]
+                           node_cache: Dict[tuple, tuple],
+                           noise_vec: Optional[np.ndarray] = None,
+                           tables: Optional[dict] = None
                            ) -> Optional[_FastEval]:
         """Launch the eval's placement kernel chained on the window's usage,
         or return None to route it through the per-eval GenericScheduler."""
@@ -217,8 +234,9 @@ class PipelinedWorker(Worker):
         stack.adopt_nodes(nodes_by_id, cand_mask, elig)
         ctx.metrics.NodesAvailable = by_dc
 
-        prep = stack.prepare_batch([t.TaskGroup for t in diff.place])
-        res = stack.dispatch(prep, usage_override=usage_chain)
+        prep = stack.prepare_batch([t.TaskGroup for t in diff.place],
+                                   noise_vec=noise_vec)
+        res = stack.dispatch(prep, usage_override=usage_chain, tables=tables)
         return _FastEval(ev=ev, token=token, plan=plan, ctx=ctx, stack=stack,
                          prep=prep, place=diff.place, res=res)
 
@@ -331,15 +349,30 @@ class PipelinedWorker(Worker):
         return out
 
     def _drain_window(self, results: List[object]) -> List[np.ndarray]:
-        """Overlapped device->host transfers for the whole window: start every
-        copy async first, then materialize — the RTTs overlap instead of
-        serializing (and no stacking op to recompile per window size)."""
-        for res in results:
-            try:
-                res.packed.copy_to_host_async()
-            except AttributeError:
-                pass  # non-jax array (already host-side)
-        return [np.asarray(res.packed) for res in results]
+        """ONE device->host transfer per packed shape for the whole window:
+        the per-eval results are stacked ON DEVICE and come home in a single
+        RTT (remote-attached TPUs pay a fixed round trip per transfer). The
+        stack arity is padded to the configured window size (repeating the
+        last element) so XLA compiles ONE stack program per packed shape,
+        never one per distinct window fill level."""
+        try:
+            import jax.numpy as jnp
+
+            by_shape: Dict[tuple, List[int]] = {}
+            for i, res in enumerate(results):
+                by_shape.setdefault(tuple(res.packed.shape), []).append(i)
+            out: List[Optional[np.ndarray]] = [None] * len(results)
+            for idxs in by_shape.values():
+                group = [results[i].packed for i in idxs]
+                if len(group) < self.window:
+                    group = group + [group[-1]] * (self.window - len(group))
+                stacked = np.asarray(jnp.stack(group))
+                for i, arr in zip(idxs, stacked):
+                    out[i] = arr
+            return out
+        except (ImportError, TypeError, AttributeError):
+            # Non-jax packed arrays (already host-side, e.g. tests).
+            return [np.asarray(res.packed) for res in results]
 
     # ------------------------------------------------------------- slow path
     def _process_slow(self, ev: Evaluation, token: str) -> None:

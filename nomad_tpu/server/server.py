@@ -134,7 +134,7 @@ class Server:
         self.blocked_evals = BlockedEvals(self.eval_broker)
         self.plan_queue = PlanQueue()
         self.plan_applier = PlanApplier(self.plan_queue, self.raft,
-                                        self.eval_broker)
+                                        self.eval_broker, tindex=self.tindex)
         # Owned by the FSM so it is persisted in snapshots and rebuilt from
         # apply on every replica (survives leader failover).
         self.timetable = self.fsm.timetable

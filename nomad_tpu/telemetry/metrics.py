@@ -126,7 +126,7 @@ class StatsdSink:
     (reference: go-metrics statsd.go — gauges as |g, counters as |c,
     timers as |ms). Never raises into the instrumented path."""
 
-    def __init__(self, addr: str):
+    def __init__(self, addr: str, host_label: str = ""):
         host, port = addr.rsplit(":", 1)
         # Resolve once: an unresolved hostname target would pay a DNS
         # lookup on every sendto from instrumented hot paths.
@@ -135,6 +135,10 @@ class StatsdSink:
         self._target = info[0][4]
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
         self._sock.setblocking(False)
+        # Shared-aggregator sinks need per-node series (reference: go-metrics
+        # hostname key prefix); the in-memory sink is per-agent and stays
+        # unprefixed.
+        self._prefix = f"{host_label}." if host_label else ""
 
     def _send(self, payload: str) -> None:
         try:
@@ -143,13 +147,13 @@ class StatsdSink:
             pass
 
     def set_gauge(self, key: Key, value: float) -> None:
-        self._send(f"{_name(key)}:{value:g}|g")
+        self._send(f"{self._prefix}{_name(key)}:{value:g}|g")
 
     def incr_counter(self, key: Key, value: float) -> None:
-        self._send(f"{_name(key)}:{value:g}|c")
+        self._send(f"{self._prefix}{_name(key)}:{value:g}|c")
 
     def add_sample(self, key: Key, value: float) -> None:
-        self._send(f"{_name(key)}:{value:g}|ms")
+        self._send(f"{self._prefix}{_name(key)}:{value:g}|ms")
 
     def close(self) -> None:
         try:
@@ -176,7 +180,7 @@ class MetricsRegistry:
             self.inmem = InMemSink(interval=collection_interval)
             sinks: List[Any] = [self.inmem]
             if statsd_addr:
-                sinks.append(StatsdSink(statsd_addr))
+                sinks.append(StatsdSink(statsd_addr, host_label=host_label))
             self._sinks = sinks
             self.host_label = host_label
 

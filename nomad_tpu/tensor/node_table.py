@@ -207,10 +207,18 @@ class NodeTensor:
                     rows = np.concatenate(
                         [rows, np.full(padded - len(rows), rows[0],
                                        dtype=np.int32)])
+                # ONE host->device transfer for the whole refresh: transfers
+                # are synchronous RTTs on remote-attached TPUs, so shipping
+                # rows+capacity+score_cap+usage as one packed array and
+                # splitting device-side (cheap async dispatch) is ~4x fewer
+                # blocking round trips than four separate puts.
+                packed = np.concatenate(
+                    [rows[:, None].astype(np.float32),
+                     self.capacity[rows], self.score_cap[rows],
+                     self.usage[rows]], axis=1)
                 d = self._device
-                d["capacity"] = d["capacity"].at[rows].set(self.capacity[rows])
-                d["score_cap"] = d["score_cap"].at[rows].set(self.score_cap[rows])
-                d["usage"] = d["usage"].at[rows].set(self.usage[rows])
+                d["capacity"], d["score_cap"], d["usage"] = _scatter_refresh(
+                    d["capacity"], d["score_cap"], d["usage"], packed)
                 self._dirty_rows.clear()
             return dict(self._device)
 
@@ -231,6 +239,30 @@ class NodeTensor:
 
 
 _BACKEND_CHECKED = False
+_SCATTER_REFRESH = None
+
+
+def _scatter_refresh(capacity, score_cap, usage, packed):
+    """Jitted split + 3-way row scatter of one packed refresh transfer.
+    packed: [k, 1 + R + 2 + R] f32 = (row, capacity, score_cap, usage)."""
+    global _SCATTER_REFRESH
+    if _SCATTER_REFRESH is None:
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def refresh(cap, sc, us, pk):
+            rows = pk[:, 0].astype(jnp.int32)
+            cap_v = pk[:, 1:1 + RES_DIMS]
+            sc_v = pk[:, 1 + RES_DIMS:3 + RES_DIMS]
+            us_v = pk[:, 3 + RES_DIMS:]
+            return (cap.at[rows].set(cap_v), sc.at[rows].set(sc_v),
+                    us.at[rows].set(us_v))
+
+        _SCATTER_REFRESH = refresh
+    import jax.numpy as jnp
+
+    return _SCATTER_REFRESH(capacity, score_cap, usage, jnp.asarray(packed))
 
 
 def ensure_backend() -> None:

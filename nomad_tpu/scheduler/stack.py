@@ -11,6 +11,7 @@ network-checking nodes that survive ranking (reference: rank.go:150-240).
 
 from __future__ import annotations
 
+import hashlib
 import random
 import threading
 import time
@@ -61,7 +62,10 @@ class _DeviceInputCache:
         import jax.numpy as jnp
 
         arr = np.ascontiguousarray(arr)
-        key = (arr.tobytes(), arr.dtype.str, arr.shape)
+        # 128-bit content digest as the key: exact-bytes keys would retain a
+        # full host copy of every cached array (MBs at large node counts).
+        key = (hashlib.blake2b(arr.tobytes(), digest_size=16).digest(),
+               arr.dtype.str, arr.shape)
         with self._lock:
             dev = self._entries.get(key)
             if dev is not None:
@@ -108,6 +112,8 @@ class PreparedBatch:
     distinct: bool
     penalty: float
     noise_vec: np.ndarray         # [N] f32 tie-break jitter
+    tg_mask_sums: np.ndarray      # [U] eligible-node count per unique TG
+    cand_sum: int                 # candidate node count (metrics base)
 
 
 def _pad_pow2(n: int, floor: int = 8) -> int:
@@ -115,6 +121,14 @@ def _pad_pow2(n: int, floor: int = 8) -> int:
     while p < n:
         p *= 2
     return p
+
+
+def make_noise_vec(n_rows: int, rng: random.Random) -> np.ndarray:
+    """Per-node tie-break jitter (the load-spreading analogue of the
+    reference's node shuffle, stack.go:120-133)."""
+    return np.asarray(
+        np.random.default_rng(rng.randrange(2**31)).random(n_rows),
+        dtype=np.float32) * _NOISE_SCALE
 
 
 class GenericStack:
@@ -264,17 +278,16 @@ class GenericStack:
             valid[p] = True
 
         if noise_vec is None:
-            noise = self.rng.random()  # seed scalar; vector below
-            noise_vec = np.asarray(
-                np.random.default_rng(int(noise * 2**31)).random(nt.n_rows),
-                dtype=np.float32) * _NOISE_SCALE
+            noise_vec = make_noise_vec(nt.n_rows, self.rng)
 
         return PreparedBatch(
             tgs=list(tgs), tg_index=tg_index, tg_masks=tg_masks,
             tg_demands=tg_demands, demands=demands, tg_ids=tg_ids,
             valid=valid, p_pad=p_pad, evict_rows=evict_rows,
             evict_vecs=evict_vecs, job_counts=job_counts, distinct=distinct,
-            penalty=penalty, noise_vec=noise_vec)
+            penalty=penalty, noise_vec=noise_vec,
+            tg_mask_sums=tg_masks.sum(axis=1),
+            cand_sum=int(self._cand_mask.sum()))
 
     def dispatch(self, prep: PreparedBatch, usage_override=None,
                  banned: Optional[np.ndarray] = None,
@@ -346,8 +359,7 @@ class GenericStack:
         for p in list(remaining):
             row = int(chosen[p])
             ti = prep.tg_index[prep.tgs[p].Name]
-            self._fill_metrics(prep.tgs[p], prep.tg_masks[ti],
-                               int(n_feasible[p]))
+            self._fill_metrics(prep, ti, int(n_feasible[p]))
             if row < 0:
                 self._note_exhaustion(prep.tgs[p], prep.tg_masks[ti],
                                       prep.tg_demands[ti], prep, placed_usage)
@@ -413,6 +425,17 @@ class GenericStack:
     def _assign_networks(self, node: Node, tg: TaskGroup,
                          score: float) -> Optional[SelectedOption]:
         """Host-side port/bandwidth assignment for a chosen node."""
+        if not any(t.Resources is not None and t.Resources.Networks
+                   for t in tg.Tasks):
+            # No network asks anywhere in the group: nothing to reserve, so
+            # skip building the node's port/bandwidth index entirely (the
+            # common case in large placement storms).
+            option = SelectedOption(node=node, score=score)
+            for task in tg.Tasks:
+                option.task_resources[task.Name] = (
+                    task.Resources.copy() if task.Resources is not None
+                    else Resources())
+            return option
         netidx = self._netidx_cache.get(node.ID)
         if netidx is None:
             netidx = NetworkIndex()
@@ -439,12 +462,14 @@ class GenericStack:
             option.task_resources[task.Name] = resources
         return option
 
-    def _fill_metrics(self, tg: TaskGroup, mask: np.ndarray,
+    def _fill_metrics(self, prep: PreparedBatch, ti: int,
                       n_feasible: int) -> None:
+        """Metrics from the per-unique-TG sums precomputed in prepare_batch
+        (summing the node axis per placement would be O(P*N) per eval)."""
         m = self.ctx.metrics
-        n_eligible = int(mask.sum())
+        n_eligible = int(prep.tg_mask_sums[ti])
         m.NodesEvaluated = n_eligible
-        m.NodesFiltered = int(self._cand_mask.sum()) - n_eligible
+        m.NodesFiltered = prep.cand_sum - n_eligible
         m.NodesExhausted = max(0, n_eligible - n_feasible)
 
     def _note_exhaustion(self, tg: TaskGroup, mask: np.ndarray,

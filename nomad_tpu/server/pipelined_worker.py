@@ -32,6 +32,7 @@ only accelerates evals whose outcome is provably the same.
 from __future__ import annotations
 
 import logging
+import random
 import time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
@@ -155,12 +156,9 @@ class PipelinedWorker(Worker):
         tables = nt.device_arrays()
         if self._noise is None or self._noise.shape[0] != nt.n_rows \
                 or self.stats["windows"] % 64 == 0:
-            from nomad_tpu.scheduler.stack import _NOISE_SCALE
+            from nomad_tpu.scheduler.stack import make_noise_vec
 
-            self._noise = np.asarray(
-                np.random.default_rng(
-                    np.random.randint(2**31)).random(nt.n_rows),
-                dtype=np.float32) * _NOISE_SCALE
+            self._noise = make_noise_vec(nt.n_rows, random.Random())
         noise_vec = self._noise
         for ev, token in batch:
             rec = None

@@ -401,7 +401,11 @@ class Server:
     def _reap_failed_evaluations(self) -> None:
         """Mark over-delivered evals failed (reference: leader.go:302-332)."""
         while True:
-            ev, token = self.eval_broker.dequeue([FAILED_QUEUE], timeout=0.01)
+            try:
+                ev, token = self.eval_broker.dequeue([FAILED_QUEUE],
+                                                     timeout=0.01)
+            except RuntimeError:
+                return  # broker disabled: leadership being revoked
             if ev is None:
                 return
             updated = ev.copy()

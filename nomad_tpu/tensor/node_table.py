@@ -29,6 +29,10 @@ from nomad_tpu.structs.structs import NodeStatusReady
 RES_DIMS = 5  # cpu, mem, disk, iops, mbits
 DIM_NAMES = ("cpu", "memory", "disk", "iops", "bandwidth")
 _MIN_CAP = 64
+# Dirty-row device refresh chunks (two fixed shapes -> two compiled
+# programs ever: a trickle bucket and a storm bucket).
+_REFRESH_CHUNK = 2048
+_REFRESH_CHUNK_SMALL = 8
 
 
 def resources_vec(r: Optional[Resources]) -> np.ndarray:
@@ -199,26 +203,35 @@ class NodeTensor:
                 self._dirty_rows.clear()
             elif self._dirty_rows:
                 rows = np.fromiter(self._dirty_rows, dtype=np.int32)
-                # Pad the scatter to a power-of-two bucket (repeating the
-                # first row, same values) so XLA compiles one scatter per
-                # bucket size instead of one per distinct dirty-row count.
-                padded = _next_pow2(max(8, len(rows)))
-                if padded > len(rows):
-                    rows = np.concatenate(
-                        [rows, np.full(padded - len(rows), rows[0],
-                                       dtype=np.int32)])
-                # ONE host->device transfer for the whole refresh: transfers
-                # are synchronous RTTs on remote-attached TPUs, so shipping
-                # rows+capacity+score_cap+usage as one packed array and
-                # splitting device-side (cheap async dispatch) is ~4x fewer
-                # blocking round trips than four separate puts.
-                packed = np.concatenate(
-                    [rows[:, None].astype(np.float32),
-                     self.capacity[rows], self.score_cap[rows],
-                     self.usage[rows]], axis=1)
+                # Fixed-size scatter chunks (tail padded by repeating the
+                # first row — sets are idempotent): ONE compiled refresh
+                # program ever, instead of one per distinct dirty-row count.
+                # A mid-serving XLA compile blocks the scheduling path for
+                # hundreds of ms, which dwarfs any transfer saving.
                 d = self._device
-                d["capacity"], d["score_cap"], d["usage"] = _scatter_refresh(
-                    d["capacity"], d["score_cap"], d["usage"], packed)
+                # Small bucket for trickle updates, big bucket for storms:
+                # compile count stays bounded at 2 without shipping a 2048-row
+                # transfer when one heartbeat dirtied one row.
+                size = (_REFRESH_CHUNK_SMALL
+                        if len(rows) <= _REFRESH_CHUNK_SMALL
+                        else _REFRESH_CHUNK)
+                for i in range(0, len(rows), size):
+                    chunk = rows[i:i + size]
+                    if len(chunk) < size:
+                        chunk = np.concatenate(
+                            [chunk, np.full(size - len(chunk),
+                                            chunk[0], dtype=np.int32)])
+                    # ONE host->device transfer per chunk: rows + all three
+                    # column groups ride a single packed array and split
+                    # device-side (transfers are blocking RTTs on
+                    # remote-attached TPUs; dispatches are async).
+                    packed = np.concatenate(
+                        [chunk[:, None].astype(np.float32),
+                         self.capacity[chunk], self.score_cap[chunk],
+                         self.usage[chunk]], axis=1)
+                    d["capacity"], d["score_cap"], d["usage"] = \
+                        _scatter_refresh(d["capacity"], d["score_cap"],
+                                         d["usage"], packed)
                 self._dirty_rows.clear()
             return dict(self._device)
 

@@ -18,6 +18,7 @@ from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
 
 from nomad_tpu.structs import Evaluation, generate_uuid
+from nomad_tpu.timerwheel import TimerHandle, wheel
 
 FAILED_QUEUE = "_failed"
 
@@ -60,7 +61,7 @@ class _PriorityQueue:
 class _Unack:
     eval: Evaluation
     token: str
-    nack_timer: threading.Timer
+    nack_timer: TimerHandle
 
 
 @dataclass
@@ -88,7 +89,7 @@ class EvalBroker:
         self._ready: Dict[str, _PriorityQueue] = {}    # scheduler -> ready
         self._unack: Dict[str, _Unack] = {}
         self._requeue: Dict[str, Evaluation] = {}  # token -> eval
-        self._time_wait: Dict[str, threading.Timer] = {}
+        self._time_wait: Dict[str, TimerHandle] = {}
         self.stats = BrokerStats()
 
     # ------------------------------------------------------------- lifecycle
@@ -142,11 +143,9 @@ class EvalBroker:
             self._evals[ev.ID] = 0
 
         if ev.Wait > 0:
-            timer = threading.Timer(ev.Wait / 1e9, self._enqueue_waiting, (ev,))
-            timer.daemon = True
-            self._time_wait[ev.ID] = timer
+            self._time_wait[ev.ID] = wheel.after(
+                ev.Wait / 1e9, self._enqueue_waiting, ev)
             self.stats.TotalWaiting += 1
-            timer.start()
             return
         self._enqueue_locked(ev, ev.Type)
 
@@ -221,11 +220,9 @@ class EvalBroker:
     def _dequeue_for_sched(self, sched: str) -> Tuple[Evaluation, str]:
         ev = self._ready[sched].pop()
         token = generate_uuid()
-        timer = threading.Timer(self.nack_timeout, self.nack, (ev.ID, token))
-        timer.daemon = True
+        timer = wheel.after(self.nack_timeout, self.nack, ev.ID, token)
         self._unack[ev.ID] = _Unack(ev, token, timer)
         self._evals[ev.ID] = self._evals.get(ev.ID, 0) + 1
-        timer.start()
         self.stats.TotalReady -= 1
         self.stats.TotalUnacked += 1
         by = self.stats.ByScheduler[sched]
@@ -248,11 +245,8 @@ class EvalBroker:
             if unack.token != token:
                 raise TokenMismatchError(eval_id)
             unack.nack_timer.cancel()
-            timer = threading.Timer(self.nack_timeout, self.nack,
-                                    (eval_id, token))
-            timer.daemon = True
-            unack.nack_timer = timer
-            timer.start()
+            unack.nack_timer = wheel.after(self.nack_timeout, self.nack,
+                                           eval_id, token)
 
     def ack(self, eval_id: str, token: str) -> None:
         """(reference: eval_broker.go:461-519)"""

@@ -12,7 +12,18 @@ import random
 import threading
 from typing import Callable, Dict
 
+from nomad_tpu.timerwheel import DaemonPool, TimerHandle, wheel
+
 logger = logging.getLogger("nomad.heartbeat")
+
+_EXPIRY_POOL: DaemonPool = None
+
+
+def _expiry_pool() -> DaemonPool:
+    global _EXPIRY_POOL
+    if _EXPIRY_POOL is None:
+        _EXPIRY_POOL = DaemonPool(8, "hb-expire")
+    return _EXPIRY_POOL
 
 
 class HeartbeatTimers:
@@ -24,7 +35,7 @@ class HeartbeatTimers:
         self.max_per_second = max_per_second
         self.on_expire = on_expire
         self._lock = threading.Lock()
-        self._timers: Dict[str, threading.Timer] = {}
+        self._timers: Dict[str, TimerHandle] = {}
 
     def reset_heartbeat_timer(self, node_id: str) -> float:
         """Arm (or re-arm) the node's TTL; returns the TTL granted
@@ -38,18 +49,22 @@ class HeartbeatTimers:
             existing = self._timers.get(node_id)
             if existing is not None:
                 existing.cancel()
-            timer = threading.Timer(ttl + self.grace,
-                                    self._invalidate, (node_id,))
-            timer.daemon = True
-            self._timers[node_id] = timer
-            timer.start()
+            self._timers[node_id] = wheel.after(
+                ttl + self.grace, self._invalidate, node_id)
             return ttl
 
     def _invalidate(self, node_id: str) -> None:
-        """TTL expired: node is presumed down (reference: heartbeat.go:76-107)."""
+        """TTL expired: node is presumed down (reference: heartbeat.go:76-107).
+        The handler does a consensus write, so it runs on a dedicated pool —
+        a partition expiring thousands of TTLs at once must not starve the
+        shared timer wheel's callback workers (the reference runs each
+        invalidation in its own goroutine, heartbeat.go:60)."""
         with self._lock:
             self._timers.pop(node_id, None)
         logger.warning("heartbeat: node %s TTL expired", node_id)
+        _expiry_pool().submit(self._expire, node_id)
+
+    def _expire(self, node_id: str) -> None:
         try:
             self.on_expire(node_id)
         except Exception:

@@ -29,10 +29,9 @@ from nomad_tpu.structs.structs import NodeStatusReady
 RES_DIMS = 5  # cpu, mem, disk, iops, mbits
 DIM_NAMES = ("cpu", "memory", "disk", "iops", "bandwidth")
 _MIN_CAP = 64
-# Dirty-row device refresh chunks (two fixed shapes -> two compiled
-# programs ever: a trickle bucket and a storm bucket).
-_REFRESH_CHUNK = 2048
-_REFRESH_CHUNK_SMALL = 8
+# Dirty-row device refresh chunks (fixed shapes -> bounded compile count:
+# trickle, steady, and storm buckets).
+_REFRESH_CHUNKS = (8, 128, 2048)
 
 
 def resources_vec(r: Optional[Resources]) -> np.ndarray:
@@ -209,12 +208,14 @@ class NodeTensor:
                 # A mid-serving XLA compile blocks the scheduling path for
                 # hundreds of ms, which dwarfs any transfer saving.
                 d = self._device
-                # Small bucket for trickle updates, big bucket for storms:
-                # compile count stays bounded at 2 without shipping a 2048-row
-                # transfer when one heartbeat dirtied one row.
-                size = (_REFRESH_CHUNK_SMALL
-                        if len(rows) <= _REFRESH_CHUNK_SMALL
-                        else _REFRESH_CHUNK)
+                # Smallest bucket that fits: compile count stays bounded
+                # without shipping a storm-sized transfer when one heartbeat
+                # dirtied one row.
+                size = _REFRESH_CHUNKS[-1]
+                for candidate in _REFRESH_CHUNKS:
+                    if len(rows) <= candidate:
+                        size = candidate
+                        break
                 for i in range(0, len(rows), size):
                     chunk = rows[i:i + size]
                     if len(chunk) < size:

@@ -1,0 +1,136 @@
+"""Shared timer service: one heap, one thread, thread-pooled callbacks.
+
+`threading.Timer` spawns a full OS thread per timer. The server schedules a
+timer per tracked node heartbeat (reference: nomad/heartbeat.go uses the Go
+runtime's shared timer heap via time.AfterFunc) and two per in-flight
+evaluation (nack redelivery, eval_broker.go:372-416) — at 10k nodes that is
+10k parked threads plus constant thread create/exit churn on the scheduling
+hot path. This wheel replaces them with a single heap-ordered dispatcher;
+callbacks run on a small pool so a slow callback (heartbeat expiry does a
+consensus write) can't stall the wheel.
+
+The module-level `wheel` is the process singleton; tests may construct
+private wheels.
+"""
+
+from __future__ import annotations
+
+import heapq
+import itertools
+import queue
+import threading
+import time
+from typing import Any, Callable, List, Optional, Tuple
+
+
+class DaemonPool:
+    """Minimal fixed-size daemon worker pool.
+
+    concurrent.futures joins its (non-daemon) workers at interpreter exit,
+    so one callback blocked on a wedged consensus write would hang process
+    shutdown — the replaced threading.Timers were daemonic and never did.
+    """
+
+    def __init__(self, size: int, name: str):
+        self._q: "queue.SimpleQueue[Optional[Tuple[Callable, tuple]]]" = (
+            queue.SimpleQueue())
+        for i in range(size):
+            threading.Thread(target=self._work, daemon=True,
+                             name=f"{name}-{i}").start()
+
+    def submit(self, fn: Callable, *args: Any) -> None:
+        self._q.put((fn, args))
+
+    def _work(self) -> None:
+        while True:
+            fn, args = self._q.get()
+            try:
+                fn(*args)
+            except Exception:
+                import logging
+
+                logging.getLogger("nomad.timerwheel").exception(
+                    "pooled callback failed")
+
+
+class TimerHandle:
+    """Cancellable handle for one scheduled callback."""
+
+    __slots__ = ("deadline", "fn", "args", "_cancelled")
+
+    def __init__(self, deadline: float, fn: Callable, args: Tuple[Any, ...]):
+        self.deadline = deadline
+        self.fn = fn
+        self.args = args
+        self._cancelled = False
+
+    def cancel(self) -> None:
+        # Best-effort, same as threading.Timer.cancel(): a callback already
+        # handed to the pool may still run.
+        self._cancelled = True
+
+
+class TimerWheel:
+    def __init__(self, pool_size: int = 4):
+        self._heap: List[Tuple[float, int, TimerHandle]] = []
+        self._seq = itertools.count()
+        self._cond = threading.Condition()
+        self._pool_size = pool_size
+        self._pool: Optional[DaemonPool] = None
+        self._thread: Optional[threading.Thread] = None
+
+    def _ensure_started(self) -> None:
+        if self._thread is None or not self._thread.is_alive():
+            self._pool = DaemonPool(self._pool_size, "timer-cb")
+            self._thread = threading.Thread(target=self._run, daemon=True,
+                                            name="timer-wheel")
+            self._thread.start()
+
+    def after(self, delay: float, fn: Callable, *args: Any) -> TimerHandle:
+        """Schedule fn(*args) after `delay` seconds; returns a cancellable
+        handle."""
+        handle = TimerHandle(time.monotonic() + max(0.0, delay), fn, args)
+        with self._cond:
+            self._ensure_started()
+            heapq.heappush(self._heap, (handle.deadline, next(self._seq),
+                                        handle))
+            self._cond.notify()
+        return handle
+
+    def _run(self) -> None:
+        while True:
+            with self._cond:
+                while True:
+                    now = time.monotonic()
+                    if not self._heap:
+                        self._cond.wait()
+                        continue
+                    deadline = self._heap[0][0]
+                    if deadline <= now:
+                        break
+                    self._cond.wait(deadline - now)
+                due: List[TimerHandle] = []
+                now = time.monotonic()
+                while self._heap and self._heap[0][0] <= now:
+                    _, _, handle = heapq.heappop(self._heap)
+                    if not handle._cancelled:
+                        due.append(handle)
+                pool = self._pool
+            for handle in due:
+                pool.submit(self._invoke, handle)
+
+    @staticmethod
+    def _invoke(handle: TimerHandle) -> None:
+        if handle._cancelled:
+            return
+        try:
+            handle.fn(*handle.args)
+        except Exception:
+            import logging
+
+            logging.getLogger("nomad.timerwheel").exception(
+                "timer callback failed")
+
+
+# Process-global wheel (the Go runtime-timer analogue).
+wheel = TimerWheel()

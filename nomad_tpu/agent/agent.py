@@ -41,6 +41,9 @@ class AgentConfig:
     # Client-only agents dial these RPC addresses (reference:
     # client/config Servers list)
     servers: List[str] = field(default_factory=list)
+    # ... or bootstrap them from any agent's HTTP API via the service
+    # registry ("nomad-server" instances)
+    server_discovery_url: str = ""
     server_enabled: bool = False
     client_enabled: bool = False
     num_schedulers: int = 2
@@ -92,6 +95,42 @@ class Agent:
         self.http = HTTPServer(self, host=self.config.bind_addr,
                                port=self.config.http_port)
         self.http.start()
+        if self.server is not None:
+            self._register_server_service()
+
+    def _register_server_service(self) -> None:
+        """Advertise this server in the service registry (name
+        "nomad-server") so clients can bootstrap their server list from any
+        agent's HTTP API. Retries in the background until a leader exists."""
+        import threading
+
+        rpc_addr = self.cluster.addr if self.cluster is not None else ""
+        http_addr = f"{self.config.bind_addr}:{self.http.port}"
+
+        from nomad_tpu.services import build_server_service_regs
+        from nomad_tpu.structs import to_dict
+
+        node_id = self.server.config.node_id or self.config.node_name or "dev"
+        self._server_service_node_id = node_id
+        regs = [to_dict(r) for r in build_server_service_regs(
+            node_id, rpc_addr, http_addr)]
+
+        def attempt() -> None:
+            # Through the RPC dispatch so followers forward to the leader.
+            backoff = 0.5
+            import time as _time
+            end = _time.monotonic() + 60.0
+            while _time.monotonic() < end:
+                try:
+                    self.rpc("Service.Sync", {"Upserts": regs, "Deletes": []})
+                    return
+                except Exception:
+                    _time.sleep(backoff)
+                    backoff = min(backoff * 2, 5.0)
+            logger.warning("agent: server self-registration timed out")
+
+        threading.Thread(target=attempt, daemon=True,
+                         name="server-self-reg").start()
 
     def _setup_dev_server(self) -> None:
         """(reference: agent.go:356 setupServer, DevMode branch)"""
@@ -150,13 +189,30 @@ class Agent:
         if self.server is not None and self.cluster is None:
             channel = InProcServerChannel(self.server)
         else:
-            from nomad_tpu.client.rpc import NetServerChannel
+            from nomad_tpu.client.rpc import NetServerChannel, discover_servers
             servers = list(self.config.servers)
             if self.cluster is not None:
                 servers.append(self.cluster.addr)
+            if not servers and self.config.server_discovery_url:
+                # Cold boot races server self-registration (which itself
+                # waits on leader election): retry instead of crashing.
+                import time as _time
+
+                deadline = _time.monotonic() + 60.0
+                backoff = 0.5
+                while not servers and _time.monotonic() < deadline:
+                    try:
+                        servers = discover_servers(
+                            self.config.server_discovery_url)
+                    except Exception:
+                        pass
+                    if not servers:
+                        _time.sleep(backoff)
+                        backoff = min(backoff * 2, 5.0)
             if not servers:
                 raise ValueError(
-                    "client-only agents need config.servers (RPC addresses)")
+                    "client-only agents need config.servers (RPC addresses) "
+                    "or server_discovery_url")
             channel = NetServerChannel(servers)
         self.client = Client(cconf, channel)
         if self.config.node_name:
@@ -164,6 +220,19 @@ class Agent:
         self.client.start()
 
     def shutdown(self) -> None:
+        if getattr(self, "_server_service_node_id", None):
+            # Graceful departure: pull this server's registry entries so
+            # bootstrapping clients stop being handed its addresses. (A
+            # crashed server's entries are pruned by the membership plane.)
+            from nomad_tpu.services import server_service_reg_ids
+
+            try:
+                self.rpc("Service.Sync", {
+                    "Upserts": [],
+                    "Deletes": server_service_reg_ids(
+                        self._server_service_node_id)})
+            except Exception:
+                logger.debug("agent: self-deregistration failed", exc_info=True)
         if self._rpc_pool is not None:
             self._rpc_pool.close()
         if self.http is not None:

@@ -70,6 +70,8 @@ def config_from_dict(data: dict) -> AgentConfig:
     cfg.node_class = client.get("node_class", "")
     servers = client.get("servers") or []
     cfg.servers = [servers] if isinstance(servers, str) else list(servers)
+    cfg.server_discovery_url = client.get("server_discovery_url",
+                                          cfg.server_discovery_url)
     cfg.meta = {k: str(v) for k, v in (client.get("meta") or {}).items()}
     cfg.options = {k: str(v) for k, v in (client.get("options") or {}).items()}
     return cfg

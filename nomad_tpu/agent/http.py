@@ -404,6 +404,38 @@ def route(agent, method: str, path: str, query, get_body):
             raise KeyError(f"alloc not found: {alloc_id}")
         return alloc, index
 
+    # ------------------------------ service registry
+    if path == "/v1/services":
+        if remote:
+            regs, index = rpc_read("Service.List", {}, "Services")
+            return sorted(regs, key=lambda s: s["ID"]), index
+        need_server()
+
+        def run():
+            regs = sorted((to_dict(s) for s in state.services()),
+                          key=lambda s: s["ID"])
+            return regs, state.get_index("services")
+
+        return _blocking(state, [Item(table="services")], query, run)
+
+    m = re.match(r"^/v1/service/([^/]+)$", path)
+    if m:
+        name = urllib.parse.unquote(m.group(1))
+        if remote:
+            regs, index = rpc_read("Service.GetService",
+                                   {"ServiceName": name}, "Services")
+            return sorted(regs, key=lambda s: s["ID"]), index
+        need_server()
+
+        def run():
+            regs = state.services_by_name(name)
+            # Table index, not max(ModifyIndex): a delete must not regress
+            # the reported index (see Service.GetService).
+            return sorted((to_dict(r) for r in regs),
+                          key=lambda s: s["ID"]), state.get_index("services")
+
+        return _blocking(state, [Item(service_name=name)], query, run)
+
     # ------------------------------ evaluations
     if path == "/v1/evaluations":
         if remote:

@@ -54,6 +54,7 @@ class Client:
         self.evaluations = Evaluations(self)
         self.agent = Agent(self)
         self.regions = Regions(self)
+        self.services = Services(self)
         self.system = System(self)
         self.alloc_fs = AllocFS(self)
 
@@ -266,6 +267,19 @@ class Agent:
 
     def servers(self):
         return self.c.get("/v1/agent/servers")[0]
+
+
+class Services:
+    """Service registry queries (/v1/services, /v1/service/<name>)."""
+
+    def __init__(self, c: Client):
+        self.c = c
+
+    def list(self, q: Optional[QueryOptions] = None):
+        return self.c.get("/v1/services", q)
+
+    def get(self, name: str, q: Optional[QueryOptions] = None):
+        return self.c.get(f"/v1/service/{urllib.parse.quote(name)}", q)
 
 
 class Regions:

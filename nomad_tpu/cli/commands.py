@@ -126,6 +126,11 @@ def main(argv) -> int:
     p = sub.add_parser("system-gc", help="force garbage collection")
     _add_meta(p)
 
+    p = sub.add_parser("services", help="list registered services")
+    _add_meta(p)
+    p.add_argument("name", nargs="?",
+                   help="show instances of one service")
+
     args = parser.parse_args(argv)
     if args.command is None:
         parser.print_help()
@@ -591,4 +596,22 @@ def cmd_system_gc(args) -> int:
     client = _client(args)
     client.system.garbage_collect()
     print("System GC triggered")
+    return 0
+
+
+def cmd_services(args) -> int:
+    client = _client(args)
+    if args.name:
+        regs, _ = client.services.get(args.name)
+    else:
+        regs, _ = client.services.list()
+    if not regs:
+        print("No services registered")
+        return 0
+    print(f"{'Service':<24} {'Status':<10} {'Address':<22} "
+          f"{'Node':<10} Task")
+    for r in regs:
+        addr = f"{r['Address']}:{r['Port']}" if r.get("Port") else r["Address"]
+        print(f"{r['ServiceName']:<24} {r['Status']:<10} {addr:<22} "
+              f"{r['NodeID'][:8]:<10} {r.get('TaskName') or '-'}")
     return 0

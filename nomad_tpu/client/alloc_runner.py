@@ -34,11 +34,13 @@ logger = logging.getLogger("nomad.alloc_runner")
 
 class AllocRunner:
     def __init__(self, client_config, alloc: Allocation, node,
-                 on_status_change: Callable[[Allocation], None]):
+                 on_status_change: Callable[[Allocation], None],
+                 service_manager=None):
         self.config = client_config
         self.alloc = alloc
         self.node = node
         self.on_status_change = on_status_change
+        self.service_manager = service_manager
         self.alloc_dir: Optional[AllocDir] = None
         self.task_runners: Dict[str, TaskRunner] = {}
         self.task_states: Dict[str, TaskState] = dict(alloc.TaskStates or {})
@@ -112,13 +114,23 @@ class AllocRunner:
     def destroy(self) -> None:
         """Stop tasks and remove the alloc dir (GC)."""
         self.destroy_tasks()
+        if self.service_manager is not None:
+            self.service_manager.deregister_alloc(self.alloc.ID)
         if self.alloc_dir is not None:
             self.alloc_dir.destroy()
 
     # ------------------------------------------------------------ aggregation
+    def restart_task(self, task_name: str, reason: str) -> None:
+        """Health-check restart: route to the task's runner."""
+        with self._lock:
+            runner = self.task_runners.get(task_name)
+        if runner is not None:
+            runner.trigger_restart(reason)
+
     def _on_task_state(self, task_name: str, state: str,
                        event: Optional[TaskEvent]) -> None:
         """(reference: alloc_runner.go:285-335 setTaskState/syncStatus)"""
+        self._sync_services(task_name, state)
         with self._lock:
             ts = self.task_states.setdefault(task_name, TaskState())
             ts.State = state
@@ -128,6 +140,30 @@ class AllocRunner:
             self._persist_handles()
             client_status, desc = self._alloc_status()
         self._push_status(client_status, desc)
+
+    def _sync_services(self, task_name: str, state: str) -> None:
+        """Register services when a task starts; deregister when it leaves
+        the running state (restart or death)."""
+        if self.service_manager is None:
+            return
+        with self._lock:
+            runner = self.task_runners.get(task_name)
+        if runner is None:
+            return
+        try:
+            if state == TaskStateRunning:
+                env = runner.exec_ctx.task_env
+                task_dir = os.path.join(
+                    self.alloc_dir.task_dirs.get(task_name, ""), "local") \
+                    if self.alloc_dir is not None else None
+                self.service_manager.register_task(
+                    self.alloc, runner.task, cwd=task_dir,
+                    env=env.build_env() if env is not None else None)
+            else:
+                self.service_manager.deregister_task(self.alloc.ID, task_name)
+        except Exception:
+            logger.exception("alloc %s: service sync for task %s failed",
+                             self.alloc.ID, task_name)
 
     def _alloc_status(self) -> tuple:
         """Aggregate task states -> alloc client status

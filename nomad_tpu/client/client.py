@@ -54,6 +54,10 @@ class Client:
         os.makedirs(config.state_dir, exist_ok=True)
         os.makedirs(config.alloc_dir, exist_ok=True)
         self.node = self._build_node()
+        from nomad_tpu.services import ServiceManager
+
+        self.service_manager = ServiceManager(
+            self.node, channel.sync_services, self._restart_task)
         self.alloc_runners: Dict[str, AllocRunner] = {}
         self._alloc_lock = threading.Lock()
         self._alloc_updates: Dict[str, Allocation] = {}
@@ -102,6 +106,17 @@ class Client:
             runners = list(self.alloc_runners.values())
         for r in runners:
             r.destroy_tasks()
+        self.service_manager.shutdown()
+
+    def _restart_task(self, alloc_id: str, task_name: str,
+                      reason: str) -> None:
+        """Health-check-driven restart (services/manager.py)."""
+        with self._alloc_lock:
+            runner = self.alloc_runners.get(alloc_id)
+        if runner is not None:
+            logger.warning("client: restarting %s/%s: %s",
+                           alloc_id[:8], task_name, reason)
+            runner.restart_task(task_name, reason)
 
     # ------------------------------------------------------------- register
     def _register(self) -> None:
@@ -173,7 +188,8 @@ class Client:
                 if alloc.terminal_status():
                     continue
                 runner = AllocRunner(self.config, alloc.copy(), self.node,
-                                     self._on_alloc_status)
+                                     self._on_alloc_status,
+                                     service_manager=self.service_manager)
                 with self._alloc_lock:
                     self.alloc_runners[alloc.ID] = runner
                 threading.Thread(target=runner.run, daemon=True,

@@ -74,7 +74,9 @@ class TaskEnv:
             self.env[CPU_LIMIT] = str(res.CPU)
             for net in res.Networks:
                 for label, value in net.port_labels().items():
-                    key = label.upper().replace("-", "_")
+                    # Label case is preserved (reference: env.go:140 uses the
+                    # label verbatim — jobs reference ${NOMAD_PORT_http}).
+                    key = label.replace("-", "_")
                     self.env[f"{IP_PREFIX}{key}"] = net.IP
                     self.env[f"{PORT_PREFIX}{key}"] = str(value)
                     self.env[f"{ADDR_PREFIX}{key}"] = f"{net.IP}:{value}"

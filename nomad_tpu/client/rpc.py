@@ -25,6 +25,7 @@ class ServerChannel(Protocol):
                           max_wait: float) -> Tuple[Dict[str, int], int]: ...
     def get_allocs(self, alloc_ids: List[str]) -> List[Allocation]: ...
     def update_allocs(self, allocs: List[Allocation]) -> None: ...
+    def sync_services(self, upserts: List, deletes: List[str]) -> None: ...
 
 
 class InProcServerChannel:
@@ -76,6 +77,26 @@ class InProcServerChannel:
 
     def update_allocs(self, allocs: List[Allocation]) -> None:
         self.server.node_update_allocs(allocs)
+
+    def sync_services(self, upserts: List, deletes: List[str]) -> None:
+        self.server.service_sync(upserts, deletes)
+
+
+def discover_servers(http_addr: str, timeout: float = 5.0) -> List[str]:
+    """Bootstrap a server list from any agent's HTTP API via the service
+    registry (the reference's analogue: clients discovering "nomad-server"
+    rpc services from the local Consul agent, client/client.go:1240-1278).
+    Returns rpc addresses for every registered server."""
+    import json
+    import urllib.request
+
+    if not http_addr.startswith("http"):
+        http_addr = "http://" + http_addr
+    url = f"{http_addr.rstrip('/')}/v1/service/nomad-server"
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        regs = json.load(resp)
+    return sorted({f"{r['Address']}:{r['Port']}" for r in regs
+                   if "rpc" in (r.get("Tags") or ())})
 
 
 class RpcProxy:
@@ -192,3 +213,8 @@ class NetServerChannel:
     def update_allocs(self, allocs: List[Allocation]) -> None:
         self._call("Node.UpdateAlloc",
                    {"Allocs": [to_dict(a) for a in allocs]})
+
+    def sync_services(self, upserts: List, deletes: List[str]) -> None:
+        self._call("Service.Sync",
+                   {"Upserts": [to_dict(r) for r in upserts],
+                    "Deletes": list(deletes)})

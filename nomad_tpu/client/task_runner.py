@@ -53,6 +53,8 @@ class TaskRunner:
         self.handle = None
         self.handle_id: str = ""
         self._destroy = threading.Event()
+        self._restart = threading.Event()
+        self._restart_reason = ""
         self._update_lock = threading.Lock()
         self._thread: Optional[threading.Thread] = None
 
@@ -65,6 +67,12 @@ class TaskRunner:
 
     def destroy(self) -> None:
         self._destroy.set()
+
+    def trigger_restart(self, reason: str) -> None:
+        """Kill the task and let the restart policy decide what happens next
+        (driven by failing health checks, services/manager.py)."""
+        self._restart_reason = reason
+        self._restart.set()
 
     def restore(self, handle_id: str) -> bool:
         """Re-attach to a live executor (reference: task_runner.go:141-191)."""
@@ -92,6 +100,13 @@ class TaskRunner:
         if self.handle is None:
             if not self._prepare():
                 return
+        else:
+            # Reattached to a live executor after agent restart: report
+            # running so downstream consumers (service registration, alloc
+            # status) see the task alive again.
+            event = TaskEvent.new(TaskStarted)
+            event.Message = "reattached to running task"
+            self._set_state(TaskStateRunning, event)
 
         while not self._destroy.is_set():
             if self.handle is None:
@@ -160,11 +175,29 @@ class TaskRunner:
                 if self._destroy.wait(wait):
                     return False
                 continue
+            # A restart signaled against the PREVIOUS incarnation (e.g. its
+            # health check went critical as it exited) must not kill the
+            # fresh process.
+            self._restart.clear()
             self._set_state(TaskStateRunning, TaskEvent.new(TaskStarted))
             return True
 
     def _wait_for_exit(self) -> Optional[WaitResult]:
         while not self._destroy.is_set():
+            if self._restart.is_set():
+                self._restart.clear()
+                reason = self._restart_reason or "restart signaled"
+                timeout = ns_to_seconds(self.task.KillTimeout)
+                self.handle.kill(kill_timeout=timeout)
+                result = self.handle.wait(timeout=timeout + 5.0)
+                if result is None:
+                    result = WaitResult(exit_code=-1, error=reason)
+                else:
+                    result.error = result.error or reason
+                    if result.successful():
+                        # Restart-by-check is a failure for policy purposes.
+                        result.exit_code = 1
+                return result
             result = self.handle.wait(timeout=0.2)
             if result is not None:
                 return result

@@ -112,12 +112,23 @@ class Memberlist:
         self.config = config or GossipConfig()
         self.on_event = on_event
 
-        self._udp = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
-        self._udp.bind((bind_addr, port))
-        self.addr, self.port = self._udp.getsockname()
-        self._tcp = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
-        self._tcp.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-        self._tcp.bind((bind_addr, self.port))
+        # UDP and TCP share one port number. With port=0 the kernel picks the
+        # UDP port freely, and the matching TCP port may be taken by an
+        # unrelated process — retry the pair until both bind.
+        for attempt in range(16):
+            self._udp = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+            self._udp.bind((bind_addr, port))
+            self.addr, self.port = self._udp.getsockname()
+            self._tcp = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+            self._tcp.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+            try:
+                self._tcp.bind((bind_addr, self.port))
+                break
+            except OSError:
+                self._udp.close()
+                self._tcp.close()
+                if port != 0 or attempt == 15:
+                    raise
         self._tcp.listen(16)
 
         self._lock = threading.RLock()

@@ -112,6 +112,9 @@ class Endpoints:
             "Alloc.GetAlloc": self.alloc_get,
             "Alloc.GetAllocs": self.alloc_get_many,
             "Region.List": self.region_list,
+            "Service.Sync": self.service_sync,
+            "Service.List": self.service_list,
+            "Service.GetService": self.service_get,
             "System.GC": self.system_gc,
             "Agent.Members": self.agent_members,
             "Agent.Join": self.agent_join,
@@ -485,6 +488,48 @@ class Endpoints:
         allocs = [state.alloc_by_id(aid) for aid in body["AllocIDs"]]
         return {"Allocs": [to_dict(a) for a in allocs if a is not None],
                 "Index": state.get_index("allocs")}
+
+    # ------------------------------------------------------ service registry
+    def service_sync(self, body) -> Dict[str, Any]:
+        """Batched registry sync from one node's service manager (write;
+        forwards to the leader via NotLeaderError like every other write)."""
+        from nomad_tpu.structs import ServiceRegistration
+
+        upserts = [from_dict(ServiceRegistration, r)
+                   if isinstance(r, dict) else r
+                   for r in body.get("Upserts", ())]
+        index = self.server.service_sync(upserts, list(body.get("Deletes",
+                                                                ())))
+        return {"Index": index}
+
+    def service_list(self, body) -> Dict[str, Any]:
+        state = self.server.state
+
+        def run():
+            regs = [to_dict(s) for s in state.services()]
+            return regs, state.get_index("services")
+
+        result, index = blocking_query(
+            state, [Item(table="services")],
+            body.get("MinQueryIndex", 0), body.get("MaxQueryTime", 0), run)
+        return {"Services": result, "Index": index}
+
+    def service_get(self, body) -> Dict[str, Any]:
+        """Instances of one service name, blocking — the discovery query."""
+        state = self.server.state
+        name = body["ServiceName"]
+
+        def run():
+            regs = state.services_by_name(name)
+            # Table index, not max(ModifyIndex): deregistering the newest
+            # instance must not make the reported index regress (a watcher
+            # would never see the delete).
+            return [to_dict(r) for r in regs], state.get_index("services")
+
+        result, index = blocking_query(
+            state, [Item(service_name=name)],
+            body.get("MinQueryIndex", 0), body.get("MaxQueryTime", 0), run)
+        return {"Services": result, "Index": index}
 
     # --------------------------------------------------------------- region
     def region_list(self, body) -> List[str]:

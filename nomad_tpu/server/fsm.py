@@ -24,6 +24,7 @@ from nomad_tpu.structs import (
     Job,
     Node,
     PeriodicLaunch,
+    ServiceRegistration,
     from_dict,
     to_dict,
 )
@@ -49,6 +50,7 @@ class MessageType(enum.IntEnum):
     AllocClientUpdate = 9
     PeriodicLaunchType = 10
     PeriodicLaunchDelete = 11
+    ServiceSync = 12
 
 
 # Metric leaf names per message type (reference: the MeasureSince keys in
@@ -66,6 +68,7 @@ _MSG_METRIC = {
     MessageType.AllocClientUpdate: "alloc_client_update",
     MessageType.PeriodicLaunchType: "periodic_launch",
     MessageType.PeriodicLaunchDelete: "periodic_launch_delete",
+    MessageType.ServiceSync: "service_sync",
 }
 
 
@@ -194,6 +197,18 @@ class FSM:
         self.state.delete_periodic_launch(index, req["JobID"])
         return None
 
+    def _apply_service_sync(self, index: int, req: Dict[str, Any]):
+        """Service registry sync: batched upserts + deregistrations from one
+        node's service manager (or a server's self-registration)."""
+        upserts = [from_dict(ServiceRegistration, r) if isinstance(r, dict)
+                   else r for r in req.get("Upserts", ())]
+        if upserts:
+            self.state.upsert_services(index, upserts)
+        deletes = list(req.get("Deletes", ()))
+        if deletes:
+            self.state.delete_services(index, deletes)
+        return None
+
     # ------------------------------------------------------ snapshot/restore
     def snapshot(self) -> Dict[str, Any]:
         """Serialize the full FSM state (reference: fsm.go:430-551)."""
@@ -204,9 +219,10 @@ class FSM:
             "evals": [to_dict(e) for e in snap.evals()],
             "allocs": [to_dict(a) for a in snap.allocs()],
             "periodic_launches": [to_dict(p) for p in snap.periodic_launches()],
+            "services": [to_dict(s) for s in snap.services()],
             "indexes": {t: snap.get_index(t)
                         for t in ("nodes", "jobs", "evals", "allocs",
-                                  "periodic_launch")},
+                                  "periodic_launch", "services")},
             "timetable": self.timetable.serialize(),
         }
 
@@ -223,6 +239,8 @@ class FSM:
             r.alloc_restore(from_dict(Allocation, a))
         for p in data.get("periodic_launches", ()):
             r.periodic_launch_restore(from_dict(PeriodicLaunch, p))
+        for s in data.get("services", ()):
+            r.service_restore(from_dict(ServiceRegistration, s))
         for t, idx in data.get("indexes", {}).items():
             r.index_restore(t, idx)
         r.commit()
@@ -243,6 +261,7 @@ _HANDLERS = {
     MessageType.AllocClientUpdate: FSM._apply_alloc_client_update,
     MessageType.PeriodicLaunchType: FSM._apply_periodic_launch,
     MessageType.PeriodicLaunchDelete: FSM._apply_periodic_launch_delete,
+    MessageType.ServiceSync: FSM._apply_service_sync,
 }
 
 

@@ -294,7 +294,23 @@ class ServerMembership:
             for addr in sorted((have - want) & dead):
                 LOG.info("%s: removing raft peer %s", self.gossip_name, addr)
                 raft.remove_peer(addr)
+            self._prune_server_services(dead)
         except NotLeaderError:
             pass  # lost leadership mid-reconcile; next leader redoes it
         except Exception:
             LOG.exception("%s: reconcile failed", self.gossip_name)
+
+    def _prune_server_services(self, dead_addrs: set) -> None:
+        """Drop dead servers' "nomad-server" registry entries so clients
+        bootstrapping via discovery stop receiving their addresses (crashed
+        servers can't deregister themselves the way a graceful shutdown
+        does — agent.shutdown)."""
+        if not dead_addrs:
+            return
+        stale = [reg.ID
+                 for reg in self.server.state.services_by_name("nomad-server")
+                 if reg.NodeID in dead_addrs]
+        if stale:
+            LOG.info("%s: pruning service registrations of dead servers: %s",
+                     self.gossip_name, stale)
+            self.server.service_sync([], stale)

@@ -711,6 +711,26 @@ class Server:
         return self.raft.apply(MessageType.AllocClientUpdate,
                                {"Alloc": allocs})
 
+    # Service registry (standalone replacement for the reference's Consul
+    # delegation, command/agent/consul/syncer.go — see structs.ServiceRegistration)
+    def service_sync(self, upserts: List, deletes: List[str]) -> int:
+        return self.raft.apply(MessageType.ServiceSync,
+                               {"Upserts": upserts, "Deletes": deletes})
+
+    def register_self_service(self, rpc_addr: str = "",
+                              http_addr: str = "") -> int:
+        """Register this server in the registry so clients can bootstrap
+        their server list from any agent's HTTP API (the reference's analogue
+        is server self-registration in Consul for client auto-discovery,
+        command/agent/agent.go syncAgentServicesWithConsul)."""
+        from nomad_tpu.services import build_server_service_regs
+
+        regs = build_server_service_regs(self.config.node_id or "dev",
+                                         rpc_addr, http_addr)
+        if not regs:
+            return 0
+        return self.service_sync(regs, [])
+
     def _invalidate_heartbeat(self, node_id: str) -> None:
         """(reference: heartbeat.go:84-107)"""
         try:

@@ -165,13 +165,32 @@ class _ReadAPI:
     def periodic_launches(self) -> List[PeriodicLaunch]:
         return self._iter("periodic_launch")
 
+    # -- service registry --
+    def service_by_id(self, reg_id: str):
+        return self._get("services", reg_id)
 
-TABLES = ("nodes", "jobs", "evals", "allocs", "periodic_launch")
+    def services(self) -> List:
+        return self._iter("services")
+
+    def services_by_name(self, name: str) -> List:
+        return self._members("service_name", name, "services")
+
+    def services_by_node(self, node_id: str) -> List:
+        return self._members("service_node", node_id, "services")
+
+    def services_by_alloc(self, alloc_id: str) -> List:
+        return self._members("service_alloc", alloc_id, "services")
+
+
+TABLES = ("nodes", "jobs", "evals", "allocs", "periodic_launch", "services")
 _MEMBER_INDEXES = {
     "eval_job": ("evals", lambda e: e.JobID),
     "alloc_node": ("allocs", lambda a: a.NodeID),
     "alloc_job": ("allocs", lambda a: a.JobID),
     "alloc_eval": ("allocs", lambda a: a.EvalID),
+    "service_name": ("services", lambda s: s.ServiceName),
+    "service_node": ("services", lambda s: s.NodeID),
+    "service_alloc": ("services", lambda s: s.AllocID),
 }
 
 
@@ -263,8 +282,48 @@ class StateStore(_ReadAPI):
             if existing is None:
                 raise KeyError(f"node not found: {node_id}")
             self._tables["nodes"].write(index, node_id, None)
-            self._commit(index, ["nodes"], Items([Item(node=node_id)]))
+            watch_items = Items([Item(node=node_id)])
+            # Cascade: a deregistered node's service instances are gone
+            # (the reference relies on the node-local Consul agent dying
+            # with the node; the replicated registry must prune explicitly).
+            tables = ["nodes"]
+            for reg in self._members("service_node", node_id, "services"):
+                self._tables["services"].write(index, reg.ID, None)
+                watch_items.add(Item(service_name=reg.ServiceName))
+                tables.append("services")
+            self._commit(index, tables, watch_items)
             self._emit([("node", existing, None)])
+
+    # ------------------------------------------------------- service registry
+    def upsert_services(self, index: int, regs: List) -> None:
+        """Write service registrations (client sync / server self-reg)."""
+        with self._lock:
+            watch_items = Items()
+            for reg in regs:
+                existing = self._get("services", reg.ID)
+                reg.CreateIndex = (existing.CreateIndex if existing is not None
+                                   else index)
+                reg.ModifyIndex = index
+                self._tables["services"].write(index, reg.ID, reg)
+                self._member_add("service_name", reg.ServiceName, reg.ID)
+                self._member_add("service_node", reg.NodeID, reg.ID)
+                self._member_add("service_alloc", reg.AllocID, reg.ID)
+                watch_items.add(Item(service_name=reg.ServiceName))
+            self._commit(index, ["services"], watch_items)
+
+    def delete_services(self, index: int, reg_ids: List[str]) -> None:
+        with self._lock:
+            watch_items = Items()
+            touched = False
+            for rid in reg_ids:
+                existing = self._get("services", rid)
+                if existing is None:
+                    continue  # idempotent: double-deregister is normal
+                self._tables["services"].write(index, rid, None)
+                watch_items.add(Item(service_name=existing.ServiceName))
+                touched = True
+            if touched:
+                self._commit(index, ["services"], watch_items)
 
     def update_node_status(self, index: int, node_id: str, status: str) -> None:
         with self._lock:
@@ -607,6 +666,13 @@ class Restore:
         self._store._tables["periodic_launch"].write(launch.ModifyIndex,
                                                      launch.ID, launch)
         self._bump(launch.ModifyIndex)
+
+    def service_restore(self, reg) -> None:
+        self._store._tables["services"].write(reg.ModifyIndex, reg.ID, reg)
+        self._store._member_add("service_name", reg.ServiceName, reg.ID)
+        self._store._member_add("service_node", reg.NodeID, reg.ID)
+        self._store._member_add("service_alloc", reg.AllocID, reg.ID)
+        self._bump(reg.ModifyIndex)
 
     def index_restore(self, table: str, index: int) -> None:
         self._store._table_index[table] = index

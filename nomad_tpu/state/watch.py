@@ -23,6 +23,7 @@ class Item:
     eval: str = ""
     job: str = ""
     node: str = ""
+    service_name: str = ""
     table: str = ""
 
 

@@ -1,10 +1,12 @@
 """Data model + wire structs (reference: nomad/structs/)."""
 
 from .structs import (  # explicit re-exports for the commonly used names
-    Allocation, AllocListStub, AllocMetric, Constraint, DesiredUpdates,
+    Allocation, AllocListStub, AllocMetric, CheckState, Constraint,
+    DesiredUpdates,
     Evaluation, Job, JobListStub, JobPlanResponse, LogConfig, NetworkResource, Node,
     NodeListStub, PeriodicConfig, PeriodicLaunch, Plan, PlanAnnotations,
-    PlanResult, Port, Resources, RestartPolicy, Service, ServiceCheck, Task,
+    PlanResult, Port, Resources, RestartPolicy, Service, ServiceCheck,
+    ServiceRegistration, Task,
     TaskArtifact, TaskEvent, TaskGroup, TaskState, UpdateStrategy,
     ValidationError, generate_uuid, job_stub,
 )

@@ -12,7 +12,7 @@ import copy
 import re
 import time as _time
 import uuid as _uuid
-from dataclasses import dataclass, field
+from dataclasses import dataclass, field, replace
 from typing import Any, Dict, List, Optional
 
 # --- Duration helpers (Go time.Duration is int64 nanoseconds on the wire) ---
@@ -369,6 +369,69 @@ class Service:
             if not self.PortLabel and check.requires_port():
                 errs.append(f"check {check.Name} is a {check.Type} check but the service has no port")
         return errs
+
+
+# Service registry check/instance statuses. The registry is this framework's
+# standalone replacement for the reference's external Consul dependency
+# (command/agent/consul/syncer.go): registrations live in the replicated
+# state store and are queryable cluster-wide with blocking queries.
+CheckStatusPassing = "passing"
+CheckStatusWarning = "warning"
+CheckStatusCritical = "critical"
+CheckStatusUnknown = "unknown"
+
+
+@dataclass
+class CheckState:
+    """Latest result of one health check run against a registered service."""
+
+    Name: str = ""
+    Type: str = ""
+    Status: str = CheckStatusUnknown
+    Output: str = ""
+    Timestamp: float = 0.0
+
+
+@dataclass
+class ServiceRegistration:
+    """One live instance of a service in the cluster registry.
+
+    The reference registers AgentServiceRegistrations with the node-local
+    Consul agent (consul/syncer.go:723-743); here the registration is a
+    first-class replicated object written through the FSM, so discovery
+    queries hit the same MVCC store as everything else.
+    """
+
+    ID: str = ""           # unique instance id (alloc+task+service, or agent)
+    ServiceName: str = ""
+    Tags: List[str] = field(default_factory=list)
+    JobID: str = ""
+    AllocID: str = ""
+    TaskName: str = ""
+    NodeID: str = ""
+    Address: str = ""
+    Port: int = 0
+    Status: str = CheckStatusUnknown  # worst check status; passing if no checks
+    Checks: List[CheckState] = field(default_factory=list)
+    CreateIndex: int = 0
+    ModifyIndex: int = 0
+
+    def copy(self) -> "ServiceRegistration":
+        out = replace(self)
+        out.Tags = list(self.Tags)
+        out.Checks = [replace(c) for c in self.Checks]
+        return out
+
+    def derive_status(self) -> str:
+        """Worst-of over check states (Consul health aggregation order)."""
+        if not self.Checks:
+            return CheckStatusPassing
+        order = (CheckStatusCritical, CheckStatusUnknown, CheckStatusWarning,
+                 CheckStatusPassing)
+        for status in order:
+            if any(c.Status == status for c in self.Checks):
+                return status
+        return CheckStatusUnknown
 
 
 # ---------------------------------------------------------------------------

@@ -76,9 +76,10 @@ class TestTaskEnv:
         assert built["NOMAD_TASK_DIR"] == "/task"
         assert built["NOMAD_MEMORY_LIMIT"] == "256"
         assert built["FOO"] == "bar"
-        # Port env vars from assigned resources.
-        assert built["NOMAD_PORT_MAIN"] == "5000"
-        assert built["NOMAD_IP_MAIN"] == "192.168.0.100"
+        # Port env vars from assigned resources; the label's case is
+        # preserved (reference: env.go:140 — jobs use ${NOMAD_PORT_http}).
+        assert built["NOMAD_PORT_main"] == "5000"
+        assert built["NOMAD_IP_main"] == "192.168.0.100"
         # Interpolation of node attrs/meta.
         assert env.replace("${attr.kernel.name}") == "linux"
         assert env.replace("${meta.pci-dss}") == "true"

@@ -499,6 +499,11 @@ def route(agent, method: str, path: str, query, get_body):
     if path == "/v1/client/stats":
         return need_client().stats(), None
 
+    m = re.match(r"^/v1/client/allocation/([^/]+)/stats$", path)
+    if m:
+        alloc_id = urllib.parse.unquote(m.group(1))
+        return need_client().alloc_stats(alloc_id), None
+
     # ------------------------------ agent / status / regions / system
     if path == "/v1/agent/self":
         out = {"config": agent.self_config(), "member": agent.member_info()}

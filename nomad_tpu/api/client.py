@@ -202,6 +202,11 @@ class Allocations:
     def info(self, alloc_id: str, q: Optional[QueryOptions] = None):
         return self.c.get(f"/v1/allocation/{alloc_id}", q)
 
+    def stats(self, alloc_id: str):
+        """Live task resource usage from the client agent running the alloc
+        (reference: /v1/client/allocation/<id>/stats)."""
+        return self.c.get(f"/v1/client/allocation/{alloc_id}/stats")
+
 
 class Evaluations:
     def __init__(self, c: Client):

@@ -46,6 +46,9 @@ class AllocRunner:
         self.task_states: Dict[str, TaskState] = dict(alloc.TaskStates or {})
         self._lock = threading.Lock()
         self._destroyed = False
+        from .stats import TaskStatsTracker
+
+        self._stats_tracker = TaskStatsTracker()
 
     # ------------------------------------------------------------- lifecycle
     def run(self) -> None:
@@ -120,6 +123,56 @@ class AllocRunner:
             self.alloc_dir.destroy()
 
     # ------------------------------------------------------------ aggregation
+    def stats(self) -> dict:
+        """Live resource usage of this alloc's tasks
+        (reference: /v1/client/allocation/<id>/stats, AllocResourceUsage)."""
+        with self._lock:
+            runners = dict(self.task_runners)
+        # Docker containers batch into ONE `docker stats` invocation (the
+        # CLI samples twice per call to compute CPU%, seconds per call).
+        docker_handles = [r.handle for r in runners.values()
+                          if r.handle is not None
+                          and hasattr(r.handle, "container_id")]
+        docker_samples: dict = {}
+        if docker_handles:
+            try:
+                docker_samples = type(docker_handles[0]).stats_many(
+                    docker_handles)
+            except Exception:
+                docker_samples = {}
+        tasks = {}
+        agg_rss = 0
+        agg_pct = 0.0
+        ts = 0
+        for name, runner in runners.items():
+            handle = runner.handle
+            if handle is None:
+                continue
+            try:
+                if hasattr(handle, "container_id"):
+                    sample = docker_samples.get(handle.container_id)
+                else:
+                    sample = handle.stats()
+                usage = self._stats_tracker.usage(
+                    f"{self.alloc.ID}/{name}", sample)
+            except Exception:
+                usage = None
+            if usage is None:
+                continue
+            tasks[name] = usage
+            agg_rss += usage["ResourceUsage"]["MemoryStats"]["RSS"]
+            agg_pct += usage["ResourceUsage"]["CpuStats"]["Percent"]
+            ts = max(ts, usage["Timestamp"])
+        return {
+            "ResourceUsage": {
+                "MemoryStats": {"RSS": agg_rss, "Measured": ["RSS"]},
+                "CpuStats": {"Percent": round(agg_pct, 2),
+                             "Measured": ["Percent"]},
+            },
+            "Tasks": tasks,
+            "Timestamp": ts,
+        }
+
     def restart_task(self, task_name: str, reason: str) -> None:
         """Health-check restart: route to the task's runner."""
         with self._lock:
@@ -144,6 +197,8 @@ class AllocRunner:
     def _sync_services(self, task_name: str, state: str) -> None:
         """Register services when a task starts; deregister when it leaves
         the running state (restart or death)."""
+        if state == TaskStateDead:
+            self._stats_tracker.forget(f"{self.alloc.ID}/{task_name}")
         if self.service_manager is None:
             return
         with self._lock:

@@ -233,6 +233,14 @@ class Client:
             runner = self.alloc_runners.get(alloc_id)
         return runner.alloc_dir if runner is not None else None
 
+    def alloc_stats(self, alloc_id: str) -> dict:
+        """(reference: /v1/client/allocation/<id>/stats)"""
+        with self._alloc_lock:
+            runner = self.alloc_runners.get(alloc_id)
+        if runner is None:
+            raise KeyError(f"unknown allocation {alloc_id}")
+        return runner.stats()
+
     def stats(self) -> dict:
         """Host stats (reference: client/stats/host.go)."""
         out = {"Timestamp": time.time()}

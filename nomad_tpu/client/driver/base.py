@@ -61,6 +61,12 @@ class DriverHandle:
     def kill(self, kill_timeout: float = 5.0) -> None:
         raise NotImplementedError
 
+    def stats(self) -> Optional[dict]:
+        """Raw usage sample ({pids, user_seconds, system_seconds, rss_bytes}
+        or {cpu_percent, rss_bytes}); None when unavailable (reference:
+        executor.go pid-tree stats / docker stats API)."""
+        return None
+
 
 class Driver:
     name = "base"
@@ -166,6 +172,22 @@ class ExecutorHandle(DriverHandle):
                 return json.load(f).get("pgid")
         except (OSError, json.JSONDecodeError):
             return None
+
+    def stats(self) -> Optional[dict]:
+        """Pid-tree usage of the task's process group (reference:
+        executor.go:36-41 collects the executor's child pids)."""
+        if self._done.is_set():
+            return None
+        pgid = self._pgid()
+        if pgid is None:
+            return None
+        from nomad_tpu.client.stats import sample_pid_tree
+
+        pids, user, system, rss = sample_pid_tree(pgid)
+        if not pids:
+            return None
+        return {"pids": pids, "user_seconds": user,
+                "system_seconds": system, "rss_bytes": rss}
 
 
 def _pid_alive(pid: int) -> bool:

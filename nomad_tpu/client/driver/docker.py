@@ -18,19 +18,99 @@ from .base import Driver, DriverHandle, ExecContext, WaitResult
 
 
 class DockerHandle(DriverHandle):
-    def __init__(self, container_id: str):
+    def __init__(self, container_id: str, log_dir: str = "",
+                 task_name: str = "", max_files: int = 10,
+                 max_file_size_mb: int = 10):
         self.container_id = container_id
+        self.log_dir = log_dir
+        self.task_name = task_name
+        self.max_files = max_files
+        self.max_file_size_mb = max_file_size_mb
         self._result: Optional[WaitResult] = None
         self._done = threading.Event()
+        self._log_proc: Optional[subprocess.Popen] = None
         self._watcher = threading.Thread(target=self._watch, daemon=True)
         self._watcher.start()
+        if log_dir and task_name:
+            self._start_log_pump()
 
     def id(self) -> str:
-        return json.dumps({"container_id": self.container_id})
+        return json.dumps({"container_id": self.container_id,
+                           "log_dir": self.log_dir,
+                           "task_name": self.task_name,
+                           "max_files": self.max_files,
+                           "max_file_size_mb": self.max_file_size_mb})
 
     @staticmethod
     def from_id(handle_id: str) -> "DockerHandle":
-        return DockerHandle(json.loads(handle_id)["container_id"])
+        data = json.loads(handle_id)
+        return DockerHandle(data["container_id"],
+                            log_dir=data.get("log_dir", ""),
+                            task_name=data.get("task_name", ""),
+                            max_files=data.get("max_files", 10),
+                            max_file_size_mb=data.get("max_file_size_mb", 10))
+
+    def _since_path(self) -> str:
+        import os
+
+        return os.path.join(self.log_dir,
+                            f".{self.task_name}.docker_log_since")
+
+    def _start_log_pump(self) -> None:
+        """Pump container stdout/stderr into the alloc's rotated log files
+        so `nomad fs` serves docker task logs like any executor driver's
+        (reference routes docker logs through a syslog server,
+        client/driver/logging/; a follow-pump is the same capability without
+        the daemon hop). Progress is checkpointed to a since-file so an
+        agent restart resumes from where the pump left off (bounded
+        duplication, no loss); the first start pumps from the beginning."""
+        from nomad_tpu.client.logs import FileRotator
+
+        stdout = FileRotator(self.log_dir, f"{self.task_name}.stdout",
+                             self.max_files, self.max_file_size_mb)
+        stderr = FileRotator(self.log_dir, f"{self.task_name}.stderr",
+                             self.max_files, self.max_file_size_mb)
+        since = ""
+        try:
+            with open(self._since_path()) as f:
+                since = f.read().strip()
+        except OSError:
+            pass
+        cmd = ["docker", "logs", "-f"]
+        if since:
+            cmd.extend(["--since", since])
+        cmd.append(self.container_id)
+        try:
+            self._log_proc = subprocess.Popen(
+                cmd, stdout=subprocess.PIPE, stderr=subprocess.PIPE)
+        except OSError:
+            return
+
+        def pump(stream, rotator):
+            for chunk in iter(lambda: stream.read(4096), b""):
+                rotator.write(chunk)
+            rotator.close()
+
+        def checkpoint():
+            while self._log_proc is not None \
+                    and self._log_proc.poll() is None:
+                try:
+                    tmp = self._since_path() + ".tmp"
+                    with open(tmp, "w") as f:
+                        f.write(str(int(time.time())))
+                    import os
+
+                    os.replace(tmp, self._since_path())
+                except OSError:
+                    pass
+                if self._done.wait(5.0):
+                    return
+
+        threading.Thread(target=pump, args=(self._log_proc.stdout, stdout),
+                         daemon=True).start()
+        threading.Thread(target=pump, args=(self._log_proc.stderr, stderr),
+                         daemon=True).start()
+        threading.Thread(target=checkpoint, daemon=True).start()
 
     def _watch(self) -> None:
         try:
@@ -50,6 +130,66 @@ class DockerHandle(DriverHandle):
     def kill(self, kill_timeout: float = 5.0) -> None:
         subprocess.run(["docker", "stop", "-t", str(int(kill_timeout)),
                         self.container_id], capture_output=True)
+        if self._log_proc is not None:
+            try:
+                self._log_proc.terminate()
+            except OSError:
+                pass
+
+    def stats(self) -> Optional[dict]:
+        """One-shot docker stats sample (reference: docker.go stats via the
+        daemon's stats API)."""
+        if self._done.is_set():
+            return None
+        return DockerHandle.stats_many([self]).get(self.container_id)
+
+    @staticmethod
+    def stats_many(handles: list) -> Dict[str, dict]:
+        """One `docker stats` invocation covering many containers: the CLI
+        samples twice to compute CPU%, so per-container calls would cost
+        seconds each inside the stats HTTP handler."""
+        ids = [h.container_id for h in handles if not h._done.is_set()]
+        if not ids:
+            return {}
+        try:
+            out = subprocess.run(
+                ["docker", "stats", "--no-stream", "--format",
+                 "{{.ID}} {{.CPUPerc}} {{.MemUsage}}"] + ids,
+                capture_output=True, text=True, timeout=15)
+        except Exception:
+            return {}
+        if out.returncode != 0:
+            return {}
+        results: Dict[str, dict] = {}
+        for line in out.stdout.splitlines():
+            parts = line.strip().split(" ", 2)
+            if len(parts) < 3:
+                continue
+            cid, cpu_raw, mem_raw = parts
+            try:
+                cpu = float(cpu_raw.rstrip("%"))
+                rss = _parse_mem(mem_raw.split("/")[0].strip())
+            except (ValueError, IndexError):
+                continue
+            # docker prints short ids; match back to the full ones.
+            for full in ids:
+                if full.startswith(cid) or cid.startswith(full[:12]):
+                    results[full] = {"cpu_percent": cpu, "rss_bytes": rss,
+                                     "pids": []}
+        return results
+
+
+_MEM_UNITS = (("GiB", 2**30), ("MiB", 2**20), ("KiB", 2**10),
+              ("GB", 1000**3), ("MB", 1000**2), ("kB", 1000), ("B", 1))
+
+
+def _parse_mem(value: str) -> int:
+    """Docker human units -> bytes. Longest suffix first: "5.3MiB" must not
+    match the bare "B" rule."""
+    for suffix, mult in _MEM_UNITS:
+        if value.endswith(suffix):
+            return int(float(value[: -len(suffix)]) * mult)
+    return int(float(value))
 
 
 class DockerDriver(Driver):
@@ -81,9 +221,13 @@ class DockerDriver(Driver):
         env = ctx.task_env
         image = env.replace(str(task.Config["image"]))
         task_dir = ctx.alloc_dir.task_dirs[task.Name]
-        cmd = ["docker", "run", "-d",
-               "-v", f"{ctx.alloc_dir.shared_dir}:/alloc",
-               "-v", f"{task_dir}/local:/local"]
+        cmd = ["docker"]
+        auth_dir = self._write_auth_config(task, task_dir)
+        if auth_dir:
+            cmd.extend(["--config", auth_dir])
+        cmd.extend(["run", "-d",
+                    "-v", f"{ctx.alloc_dir.shared_dir}:/alloc",
+                    "-v", f"{task_dir}/local:/local"])
         if task.Resources is not None:
             cmd.extend(["--memory", f"{task.Resources.MemoryMB}m",
                         "--cpu-shares", str(task.Resources.CPU)])
@@ -101,7 +245,37 @@ class DockerDriver(Driver):
         out = subprocess.run(cmd, capture_output=True, text=True, timeout=300)
         if out.returncode != 0:
             raise RuntimeError(f"docker run failed: {out.stderr.strip()}")
-        return DockerHandle(out.stdout.strip())
+        log_cfg = task.LogConfig
+        return DockerHandle(
+            out.stdout.strip(), log_dir=ctx.alloc_dir.log_dir,
+            task_name=task.Name,
+            max_files=log_cfg.MaxFiles if log_cfg else 10,
+            max_file_size_mb=log_cfg.MaxFileSizeMB if log_cfg else 10)
 
     def open(self, ctx: ExecContext, handle_id: str) -> DriverHandle:
         return DockerHandle.from_id(handle_id)
+
+    @staticmethod
+    def _write_auth_config(task: Task, task_dir: str) -> str:
+        """Private-registry auth: task config `auth {username, password,
+        server_address}` becomes a per-task docker client config passed via
+        --config (reference: docker.go:683+ authenticates pulls with
+        per-task credentials)."""
+        auth = task.Config.get("auth")
+        if not auth:
+            return ""
+        import base64
+        import os
+
+        user = str(auth.get("username", ""))
+        password = str(auth.get("password", ""))
+        server = str(auth.get("server_address", "")
+                     or "https://index.docker.io/v1/")
+        token = base64.b64encode(f"{user}:{password}".encode()).decode()
+        cfg_dir = os.path.join(task_dir, "docker-auth")
+        os.makedirs(cfg_dir, exist_ok=True)
+        cfg_path = os.path.join(cfg_dir, "config.json")
+        with open(cfg_path, "w") as f:
+            json.dump({"auths": {server: {"auth": token}}}, f)
+        os.chmod(cfg_path, 0o600)
+        return cfg_dir

@@ -1,0 +1,121 @@
+"""Per-task resource usage sampling + the alloc stats API
+(reference: client/driver/executor/executor.go:36-41, /v1/client/allocation/
+<id>/stats)."""
+
+import os
+import subprocess
+import time
+
+from nomad_tpu.client.stats import TaskStatsTracker, sample_pid_tree
+
+
+def wait_for(fn, timeout=10.0, interval=0.05):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if fn():
+            return True
+        time.sleep(interval)
+    return False
+
+
+class TestPidTreeSampling:
+    def test_samples_own_process_group(self):
+        # Spawn a process group: a shell with a sleeping child.
+        proc = subprocess.Popen(
+            ["/bin/sh", "-c", "sleep 30 & sleep 30"],
+            preexec_fn=os.setsid)
+        try:
+            assert wait_for(
+                lambda: len(sample_pid_tree(proc.pid)[0]) >= 2)
+            pids, user, system, rss = sample_pid_tree(proc.pid)
+            assert proc.pid in pids
+            assert rss > 0
+            assert user >= 0.0 and system >= 0.0
+        finally:
+            os.killpg(proc.pid, 15)
+            proc.wait()
+
+    def test_unknown_group_is_empty(self):
+        pids, user, system, rss = sample_pid_tree(2**22 - 1)
+        assert pids == [] and rss == 0
+
+
+class TestTracker:
+    def test_cpu_percent_from_deltas(self):
+        tracker = TaskStatsTracker()
+        first = tracker.usage("k", {"pids": [1], "user_seconds": 1.0,
+                                    "system_seconds": 0.5,
+                                    "rss_bytes": 4096})
+        assert first["ResourceUsage"]["CpuStats"]["Percent"] == 0.0
+        time.sleep(0.05)
+        second = tracker.usage("k", {"pids": [1], "user_seconds": 1.2,
+                                     "system_seconds": 0.6,
+                                     "rss_bytes": 8192})
+        assert second["ResourceUsage"]["CpuStats"]["Percent"] > 0
+        assert second["ResourceUsage"]["MemoryStats"]["RSS"] == 8192
+
+    def test_docker_style_percent_passthrough(self):
+        tracker = TaskStatsTracker()
+        u = tracker.usage("d", {"cpu_percent": 12.5, "rss_bytes": 1024})
+        assert u["ResourceUsage"]["CpuStats"]["Percent"] == 12.5
+
+    def test_none_sample(self):
+        assert TaskStatsTracker().usage("x", None) is None
+
+
+class TestDockerMemParsing:
+    def test_units_longest_suffix_first(self):
+        from nomad_tpu.client.driver.docker import _parse_mem
+
+        assert _parse_mem("5.3MiB") == int(5.3 * 2**20)
+        assert _parse_mem("1.5GiB") == int(1.5 * 2**30)
+        assert _parse_mem("200KiB") == 200 * 1024
+        assert _parse_mem("7MB") == 7 * 1000**2
+        assert _parse_mem("123B") == 123
+        assert _parse_mem("42") == 42
+
+
+class TestAllocStatsE2E:
+    def test_stats_through_http(self, tmp_path):
+        from nomad_tpu import mock
+        from nomad_tpu.agent import Agent, AgentConfig
+        from nomad_tpu.api import Client as ApiClient
+
+        conf = AgentConfig.dev()
+        conf.http_port = 0
+        conf.data_dir = str(tmp_path)
+        agent = Agent(conf)
+        agent.start()
+        try:
+            api = ApiClient(f"http://127.0.0.1:{agent.http.port}")
+            job = mock.job()
+            job.ID = job.Name = "stats-job"
+            tg = job.TaskGroups[0]
+            tg.Count = 1
+            task = tg.Tasks[0]
+            task.Driver = "raw_exec"
+            task.Config = {"command": "/bin/sleep", "args": ["300"]}
+            task.Services = []
+            job.init_fields()
+            api.jobs.register(job)
+
+            def running_alloc():
+                allocs, _ = api.allocations.list()
+                for a in allocs:
+                    if a["ClientStatus"] == "running":
+                        return a["ID"]
+                return None
+            assert wait_for(running_alloc, timeout=30)
+            alloc_id = running_alloc()
+
+            def live_stats():
+                stats, _ = api.allocations.stats(alloc_id)
+                return stats if stats.get("Tasks") else None
+            assert wait_for(live_stats, timeout=15)
+            stats = live_stats()
+            usage = stats["Tasks"][task.Name]["ResourceUsage"]
+            assert usage["MemoryStats"]["RSS"] > 0
+            assert stats["ResourceUsage"]["MemoryStats"]["RSS"] > 0
+            assert stats["Tasks"][task.Name]["Pids"]
+        finally:
+            agent.shutdown()

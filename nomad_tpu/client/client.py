@@ -95,7 +95,8 @@ class Client:
         self._register()
         for target, name in ((self._heartbeat_loop, "client-heartbeat"),
                              (self._watch_allocations, "client-watch"),
-                             (self._alloc_sync_loop, "client-sync")):
+                             (self._alloc_sync_loop, "client-sync"),
+                             (self._fingerprint_loop, "client-fingerprint")):
             t = threading.Thread(target=target, daemon=True, name=name)
             t.start()
             self._threads.append(t)
@@ -107,6 +108,9 @@ class Client:
         for r in runners:
             r.destroy_tasks()
         self.service_manager.shutdown()
+        close = getattr(self.channel, "close", None)
+        if close is not None:
+            close()
 
     def _restart_task(self, alloc_id: str, task_name: str,
                       reason: str) -> None:
@@ -146,6 +150,26 @@ class Client:
             except Exception:
                 logger.exception("client: heartbeat failed; re-registering")
                 self._register()
+
+    def _fingerprint_loop(self) -> None:
+        """Periodic re-fingerprinting: drifting readings (free disk, network)
+        push a node update when they materially change (reference:
+        client/fingerprint/fingerprint.go:68-77 Periodic fingerprints +
+        client.go fingerprintPeriodic)."""
+        from .fingerprint import run_periodic_fingerprints
+
+        period = float(self.config.read_option("fingerprint.period", "30"))
+        dirty = False  # a change survives a failed push until it lands
+        while not self._shutdown.wait(period):
+            try:
+                dirty = run_periodic_fingerprints(self.node,
+                                                  self.config) or dirty
+                if dirty:
+                    logger.info("client: fingerprint changed; updating node")
+                    self.channel.register_node(self.node)
+                    dirty = False
+            except Exception:
+                logger.exception("client: periodic fingerprint failed")
 
     # ------------------------------------------------------------ alloc sync
     def _watch_allocations(self) -> None:

@@ -92,12 +92,16 @@ class DockerHandle(DriverHandle):
             rotator.close()
 
         def checkpoint():
+            # Lag the checkpoint behind wall time: output can sit in the
+            # docker-logs pipe or a blocked rotator write, so "now" isn't
+            # proof of durability. A 30s lag bounds restart duplication at
+            # ~35s and loses data only if the pump stalls longer than that.
             while self._log_proc is not None \
                     and self._log_proc.poll() is None:
                 try:
                     tmp = self._since_path() + ".tmp"
                     with open(tmp, "w") as f:
-                        f.write(str(int(time.time())))
+                        f.write(str(int(time.time()) - 30))
                     import os
 
                     os.replace(tmp, self._since_path())
@@ -131,10 +135,15 @@ class DockerHandle(DriverHandle):
         subprocess.run(["docker", "stop", "-t", str(int(kill_timeout)),
                         self.container_id], capture_output=True)
         if self._log_proc is not None:
+            # The container stopping ends the log stream; give the pump a
+            # moment to drain the final output before forcing it down.
             try:
-                self._log_proc.terminate()
-            except OSError:
-                pass
+                self._log_proc.wait(timeout=3.0)
+            except subprocess.TimeoutExpired:
+                try:
+                    self._log_proc.terminate()
+                except OSError:
+                    pass
 
     def stats(self) -> Optional[dict]:
         """One-shot docker stats sample (reference: docker.go stats via the
@@ -243,6 +252,12 @@ class DockerDriver(Driver):
             cmd.extend(env.replace(str(a))
                        for a in task.Config.get("args", []))
         out = subprocess.run(cmd, capture_output=True, text=True, timeout=300)
+        if auth_dir:
+            # The pull happened inside `docker run`; credentials must not
+            # stay at rest in the alloc dir.
+            import shutil as _shutil
+
+            _shutil.rmtree(auth_dir, ignore_errors=True)
         if out.returncode != 0:
             raise RuntimeError(f"docker run failed: {out.stderr.strip()}")
         log_cfg = task.LogConfig
@@ -273,9 +288,11 @@ class DockerDriver(Driver):
                      or "https://index.docker.io/v1/")
         token = base64.b64encode(f"{user}:{password}".encode()).decode()
         cfg_dir = os.path.join(task_dir, "docker-auth")
-        os.makedirs(cfg_dir, exist_ok=True)
+        os.makedirs(cfg_dir, mode=0o700, exist_ok=True)
+        os.chmod(cfg_dir, 0o700)
         cfg_path = os.path.join(cfg_dir, "config.json")
-        with open(cfg_path, "w") as f:
+        # 0600 from the first byte: no world-readable window before a chmod.
+        fd = os.open(cfg_path, os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o600)
+        with os.fdopen(fd, "w") as f:
             json.dump({"auths": {server: {"auth": token}}}, f)
-        os.chmod(cfg_path, 0o600)
         return cfg_dir

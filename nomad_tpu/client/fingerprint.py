@@ -139,9 +139,124 @@ def _cgroup(node: Node, config) -> bool:
     return False
 
 
+_AWS_KEYS = (
+    # (metadata path, unique)  (reference: fingerprint/env_aws.go:87-98)
+    ("ami-id", False),
+    ("instance-id", True),
+    ("instance-type", False),
+    ("local-hostname", True),
+    ("local-ipv4", True),
+    ("public-hostname", True),
+    ("public-ipv4", True),
+    ("placement/availability-zone", False),
+)
+
+
+def _metadata_get(url: str, timeout: float = 0.5,
+                  headers: Dict[str, str] = None) -> str:
+    import urllib.request
+
+    req = urllib.request.Request(url, headers=headers or {})
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return resp.read().decode().strip()
+
+
+def _env_aws(node: Node, config) -> bool:
+    """EC2 metadata service (reference: fingerprint/env_aws.go). The base
+    URL is overridable (client option / env var) so tests and non-standard
+    environments can point it at a mock."""
+    base = ((config.read_option("fingerprint.env_aws.url")
+             if config is not None else "")
+            or os.environ.get("NOMAD_TPU_AWS_METADATA_URL", ""))
+    explicit = bool(base)
+    base = base or "http://169.254.169.254/latest/meta-data/"
+    if not base.endswith("/"):
+        base += "/"
+    # IMDSv2 (token-required is the EC2 launch default now): try for a
+    # session token; fall back to v1-style unauthenticated GETs.
+    headers: Dict[str, str] = {}
+    try:
+        import urllib.request
+
+        token_url = base.split("/latest/")[0] + "/latest/api/token"
+        req = urllib.request.Request(
+            token_url, method="PUT",
+            headers={"X-aws-ec2-metadata-token-ttl-seconds": "300"})
+        with urllib.request.urlopen(req, timeout=0.3) as resp:
+            headers = {"X-aws-ec2-metadata-token":
+                       resp.read().decode().strip()}
+    except Exception:
+        pass
+    try:
+        _metadata_get(base + "ami-id", timeout=2.0 if explicit else 0.3,
+                      headers=headers)
+    except Exception:
+        return False  # not on EC2 (reference: isAWS probe)
+    for key, unique in _AWS_KEYS:
+        try:
+            value = _metadata_get(base + key, headers=headers)
+        except Exception:
+            continue
+        attr = key.replace("/", ".")
+        prefix = "unique.platform.aws." if unique else "platform.aws."
+        node.Attributes[f"{prefix}{attr}"] = value
+    instance = node.Attributes.get("unique.platform.aws.instance-id")
+    zone = node.Attributes.get("platform.aws.placement.availability-zone")
+    if instance and zone:
+        node.Links["aws.ec2"] = f"{zone}.{instance}"
+    return True
+
+
+_GCE_KEYS = (
+    ("instance/id", True),
+    ("instance/machine-type", False),
+    ("instance/zone", False),
+    ("instance/hostname", True),
+)
+
+
+def _env_gce(node: Node, config) -> bool:
+    """GCE metadata service (reference: fingerprint/env_gce.go); requires
+    the Metadata-Flavor header."""
+    base = ((config.read_option("fingerprint.env_gce.url")
+             if config is not None else "")
+            or os.environ.get("NOMAD_TPU_GCE_METADATA_URL", ""))
+    explicit = bool(base)
+    base = base or "http://169.254.169.254/computeMetadata/v1/"
+    if not base.endswith("/"):
+        base += "/"
+    headers = {"Metadata-Flavor": "Google"}
+    try:
+        _metadata_get(base + "instance/id",
+                      timeout=2.0 if explicit else 0.3, headers=headers)
+    except Exception:
+        return False
+    for key, unique in _GCE_KEYS:
+        try:
+            value = _metadata_get(base + key, headers=headers)
+        except Exception:
+            continue
+        # zone/machine-type come as full resource paths; keep the leaf.
+        value = value.rsplit("/", 1)[-1]
+        attr = key.split("/", 1)[1].replace("/", ".")
+        prefix = "unique.platform.gce." if unique else "platform.gce."
+        node.Attributes[f"{prefix}{attr}"] = value
+    instance = node.Attributes.get("unique.platform.gce.id")
+    zone = node.Attributes.get("platform.gce.zone")
+    if instance and zone:
+        node.Links["gce"] = f"{zone}.{instance}"
+    return True
+
+
 BUILTIN_FINGERPRINTERS: List[Callable] = [
     _arch, _host, _cpu, _memory, _storage, _network, _nomad, _cgroup,
+    _env_aws, _env_gce,
 ]
+
+# Fingerprinters whose readings drift and are re-run on the client's
+# fingerprint.period interval (reference: Fingerprint.Periodic(),
+# client/fingerprint/fingerprint.go:68-77 + client.go fingerprintPeriodic).
+PERIODIC_FINGERPRINTERS = frozenset({"storage", "network"})
 
 
 def fingerprint_node(node: Node, config=None) -> Dict[str, bool]:
@@ -154,3 +269,30 @@ def fingerprint_node(node: Node, config=None) -> Dict[str, bool]:
         except Exception:
             results[name] = False
     return results
+
+
+def run_periodic_fingerprints(node: Node, config=None) -> bool:
+    """Re-run the periodic fingerprinters; mutates node and returns True
+    when something MATERIAL changed (free-space drift under 10% doesn't
+    count — a node update is a consensus write, so continuous readings
+    must not re-register every node every period)."""
+    before = dict(node.Attributes)
+    for fp in BUILTIN_FINGERPRINTERS:
+        if fp.__name__.lstrip("_") in PERIODIC_FINGERPRINTERS:
+            try:
+                fp(node, config)
+            except Exception:
+                pass
+    for key in set(before) | set(node.Attributes):
+        old, new = before.get(key), node.Attributes.get(key)
+        if old == new:
+            continue
+        if key == "unique.storage.bytesfree" and old and new:
+            try:
+                if abs(int(new) - int(old)) < 0.1 * int(old):
+                    node.Attributes[key] = old  # suppress minor drift
+                    continue
+            except ValueError:
+                pass
+        return True
+    return False

@@ -11,7 +11,7 @@ from __future__ import annotations
 
 import threading
 import time
-from typing import Dict, List, Optional, Protocol, Tuple
+from typing import Callable, Dict, List, Optional, Protocol, Tuple
 
 from nomad_tpu.state.watch import Item
 from nomad_tpu.structs import Allocation, Node, from_dict, to_dict
@@ -133,6 +133,31 @@ class RpcProxy:
             new = [s for s in servers if s not in keep]
             self._servers = keep + new
 
+    def rebalance(self, ping: "Callable[[str], bool]") -> Optional[str]:
+        """Shuffle the list and promote the first server that answers a
+        ping — spreads client load across servers and skips dead ones
+        (reference: rpcproxy.go:317-449 RebalanceServers: shuffle, then
+        ping-test the selected server before committing the new order)."""
+        import random as _random
+
+        with self._lock:
+            shuffled = list(self._servers)
+        if len(shuffled) <= 1:
+            return shuffled[0] if shuffled else None
+        _random.shuffle(shuffled)
+        for i, addr in enumerate(shuffled):
+            if ping(addr):
+                order = shuffled[i:] + shuffled[:i]
+                with self._lock:
+                    # Re-intersect with the live list: update() may have
+                    # added/removed servers during the unlocked ping window,
+                    # and a removed server must stay removed.
+                    order = [s for s in order if s in self._servers]
+                    extra = [s for s in self._servers if s not in order]
+                    self._servers = order + extra
+                    return self._servers[0] if self._servers else None
+        return None
+
 
 class NetServerChannel:
     """ServerChannel over the wire: msgpack-RPC through a ConnPool with
@@ -146,11 +171,38 @@ class NetServerChannel:
     NO_LEADER_RETRIES = 10
     NO_LEADER_BACKOFF = 0.25
 
-    def __init__(self, servers: List[str]):
+    # Periodic ping-based rebalance cadence (reference: rpcproxy.go
+    # clusterInfo-scaled rebalance timer; a small fixed default here).
+    REBALANCE_INTERVAL = 120.0
+
+    def __init__(self, servers: List[str],
+                 rebalance_interval: Optional[float] = None):
         from nomad_tpu.rpc import ConnPool
 
         self.pool = ConnPool()
         self.proxy = RpcProxy(servers)
+        self._stop_rebalance = threading.Event()
+        interval = (self.REBALANCE_INTERVAL if rebalance_interval is None
+                    else rebalance_interval)
+        if interval > 0:
+            threading.Thread(target=self._rebalance_loop, args=(interval,),
+                             daemon=True, name="rpcproxy-rebalance").start()
+
+    def close(self) -> None:
+        self._stop_rebalance.set()
+
+    def _ping(self, addr: str) -> bool:
+        try:
+            return bool(self.pool.call(addr, "Status.Ping", {}, timeout=3.0))
+        except Exception:
+            return False
+
+    def _rebalance_loop(self, interval: float) -> None:
+        while not self._stop_rebalance.wait(interval):
+            try:
+                self.proxy.rebalance(self._ping)
+            except Exception:
+                pass
 
     def _call(self, method: str, body: dict, timeout: Optional[float] = None):
         from nomad_tpu.rpc.pool import RPCError

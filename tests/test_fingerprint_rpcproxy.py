@@ -1,0 +1,139 @@
+"""Cloud-env fingerprints, periodic re-fingerprinting, and rpcproxy
+rebalance (reference: client/fingerprint/env_aws.go, fingerprint.go:68-77,
+client/rpcproxy/rpcproxy.go:317-449)."""
+
+import http.server
+import threading
+import time
+
+from nomad_tpu import mock
+from nomad_tpu.client.fingerprint import (
+    _env_aws,
+    _env_gce,
+    fingerprint_node,
+    run_periodic_fingerprints,
+)
+from nomad_tpu.client.rpc import RpcProxy
+
+
+class _AWSMeta(http.server.BaseHTTPRequestHandler):
+    DATA = {
+        "/ami-id": "ami-1234",
+        "/instance-id": "i-abcdef",
+        "/instance-type": "m4.large",
+        "/local-ipv4": "10.0.0.7",
+        "/placement/availability-zone": "us-west-2a",
+    }
+
+    def do_GET(self):
+        value = self.DATA.get(self.path)
+        if value is None:
+            self.send_response(404)
+            self.end_headers()
+            return
+        self.send_response(200)
+        self.end_headers()
+        self.wfile.write(value.encode())
+
+    def log_message(self, *args):
+        pass
+
+
+class _GCEMeta(_AWSMeta):
+    DATA = {
+        "/instance/id": "7777",
+        "/instance/machine-type":
+            "projects/1/machineTypes/n1-standard-2",
+        "/instance/zone": "projects/1/zones/us-central1-a",
+        "/instance/hostname": "vm.c.proj.internal",
+    }
+
+    def do_GET(self):
+        if self.headers.get("Metadata-Flavor") != "Google":
+            self.send_response(403)
+            self.end_headers()
+            return
+        super().do_GET()
+
+
+def _serve(handler):
+    srv = http.server.HTTPServer(("127.0.0.1", 0), handler)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    return srv
+
+
+class _Config:
+    def __init__(self, **options):
+        self.options = options
+        self.alloc_dir = "/tmp"
+        self.network_speed = 0
+
+    def read_option(self, key, default=""):
+        return self.options.get(key, default)
+
+
+class TestEnvFingerprints:
+    def test_aws_metadata(self):
+        srv = _serve(_AWSMeta)
+        try:
+            node = mock.node()
+            cfg = _Config(**{"fingerprint.env_aws.url":
+                             f"http://127.0.0.1:{srv.server_address[1]}/"})
+            assert _env_aws(node, cfg)
+            assert node.Attributes["platform.aws.ami-id"] == "ami-1234"
+            assert node.Attributes["unique.platform.aws.instance-id"] == \
+                "i-abcdef"
+            assert node.Attributes[
+                "platform.aws.placement.availability-zone"] == "us-west-2a"
+            assert node.Links["aws.ec2"] == "us-west-2a.i-abcdef"
+        finally:
+            srv.shutdown()
+
+    def test_gce_metadata_requires_header_and_trims_paths(self):
+        srv = _serve(_GCEMeta)
+        try:
+            node = mock.node()
+            cfg = _Config(**{"fingerprint.env_gce.url":
+                             f"http://127.0.0.1:{srv.server_address[1]}/"})
+            assert _env_gce(node, cfg)
+            assert node.Attributes["platform.gce.machine-type"] == \
+                "n1-standard-2"
+            assert node.Attributes["platform.gce.zone"] == "us-central1-a"
+            assert node.Links["gce"] == "us-central1-a.7777"
+        finally:
+            srv.shutdown()
+
+    def test_not_on_cloud_is_clean_false(self):
+        node = mock.node()
+        cfg = _Config(**{"fingerprint.env_aws.url":
+                         "http://127.0.0.1:1/"})
+        assert _env_aws(node, cfg) is False
+        assert "platform.aws.ami-id" not in node.Attributes
+
+
+class TestPeriodicFingerprint:
+    def test_material_change_detected(self):
+        node = mock.node()
+        fingerprint_node(node, _Config())
+        # No change on an immediate re-run (free-space drift is suppressed).
+        assert run_periodic_fingerprints(node, _Config()) is False
+        # A materially different reading (simulate: wipe the attr) reports.
+        node.Attributes["unique.storage.bytesfree"] = "1"
+        assert run_periodic_fingerprints(node, _Config()) is True
+
+
+class TestRpcProxyRebalance:
+    def test_rebalance_promotes_healthy(self):
+        proxy = RpcProxy(["dead1:1", "dead2:1", "alive:1"])
+        chosen = proxy.rebalance(lambda addr: addr.startswith("alive"))
+        assert chosen == "alive:1"
+        assert proxy.find_server() == "alive:1"
+        assert set(proxy.servers()) == {"dead1:1", "dead2:1", "alive:1"}
+
+    def test_rebalance_all_dead(self):
+        proxy = RpcProxy(["a:1", "b:1"])
+        assert proxy.rebalance(lambda addr: False) is None
+
+    def test_single_server_noop(self):
+        proxy = RpcProxy(["only:1"])
+        assert proxy.rebalance(lambda a: False) == "only:1"

@@ -54,6 +54,11 @@ class Client:
         os.makedirs(config.state_dir, exist_ok=True)
         os.makedirs(config.alloc_dir, exist_ok=True)
         self.node = self._build_node()
+        # Serializes node mutation (periodic fingerprints) against node
+        # serialization (register/heartbeat pushes) — and pushes always send
+        # a copy so in-process channels never hand a live mutable Node to
+        # the FSM.
+        self._node_lock = threading.Lock()
         from nomad_tpu.services import ServiceManager
 
         self.service_manager = ServiceManager(
@@ -128,7 +133,9 @@ class Client:
         backoff = 0.5
         while not self._shutdown.is_set():
             try:
-                self._heartbeat_ttl = self.channel.register_node(self.node)
+                with self._node_lock:
+                    snapshot = self.node.copy()
+                self._heartbeat_ttl = self.channel.register_node(snapshot)
                 self.node.Status = NodeStatusReady
                 self.channel.update_node_status(self.node.ID, NodeStatusReady)
                 logger.info("client: node %s registered (ttl %.1fs)",
@@ -162,11 +169,13 @@ class Client:
         dirty = False  # a change survives a failed push until it lands
         while not self._shutdown.wait(period):
             try:
-                dirty = run_periodic_fingerprints(self.node,
-                                                  self.config) or dirty
+                with self._node_lock:
+                    dirty = run_periodic_fingerprints(self.node,
+                                                      self.config) or dirty
+                    snapshot = self.node.copy() if dirty else None
                 if dirty:
                     logger.info("client: fingerprint changed; updating node")
-                    self.channel.register_node(self.node)
+                    self.channel.register_node(snapshot)
                     dirty = False
             except Exception:
                 logger.exception("client: periodic fingerprint failed")

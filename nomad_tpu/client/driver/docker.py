@@ -255,9 +255,7 @@ class DockerDriver(Driver):
         if auth_dir:
             # The pull happened inside `docker run`; credentials must not
             # stay at rest in the alloc dir.
-            import shutil as _shutil
-
-            _shutil.rmtree(auth_dir, ignore_errors=True)
+            shutil.rmtree(auth_dir, ignore_errors=True)
         if out.returncode != 0:
             raise RuntimeError(f"docker run failed: {out.stderr.strip()}")
         log_cfg = task.LogConfig

@@ -111,6 +111,13 @@ def _network(node: Node, config) -> bool:
         speed = getattr(config, "network_speed", 0) or 1000
         node.Resources.Networks.append(NetworkResource(
             Device="eth0", CIDR=f"{ip}/32", IP=ip, MBits=speed))
+    else:
+        # Periodic re-run after an IP change: the advertised attribute and
+        # the schedulable network resource must agree.
+        net = node.Resources.Networks[0]
+        if net.IP != ip:
+            net.IP = ip
+            net.CIDR = f"{ip}/32"
     return True
 
 
@@ -161,50 +168,71 @@ def _metadata_get(url: str, timeout: float = 0.5,
         return resp.read().decode().strip()
 
 
+def _env_metadata_fingerprint(node: Node, config, *, option_key: str,
+                              env_var: str, default_base: str,
+                              probe: str, keys, headers: Dict[str, str],
+                              platform_name: str,
+                              attr_of: Callable[[str], str],
+                              value_of: Callable[[str], str],
+                              link_name: str, link_keys) -> bool:
+    """Shared cloud-metadata scaffolding: resolve the (overridable) base
+    URL, probe once to detect the platform, then fetch each key into
+    platform.<name>.* attributes and assemble the node Link."""
+    base = ((config.read_option(option_key)
+             if config is not None else "")
+            or os.environ.get(env_var, ""))
+    explicit = bool(base)
+    base = base or default_base
+    if not base.endswith("/"):
+        base += "/"
+    try:
+        _metadata_get(base + probe, timeout=2.0 if explicit else 0.3,
+                      headers=headers)
+    except Exception:
+        return False  # not on this platform
+    for key, unique in keys:
+        try:
+            value = value_of(_metadata_get(base + key, headers=headers))
+        except Exception:
+            continue
+        prefix = (f"unique.platform.{platform_name}." if unique
+                  else f"platform.{platform_name}.")
+        node.Attributes[f"{prefix}{attr_of(key)}"] = value
+    parts = [node.Attributes.get(k) for k in link_keys]
+    if all(parts):
+        node.Links[link_name] = ".".join(parts)
+    return True
+
+
 def _env_aws(node: Node, config) -> bool:
     """EC2 metadata service (reference: fingerprint/env_aws.go). The base
     URL is overridable (client option / env var) so tests and non-standard
     environments can point it at a mock."""
-    base = ((config.read_option("fingerprint.env_aws.url")
-             if config is not None else "")
-            or os.environ.get("NOMAD_TPU_AWS_METADATA_URL", ""))
-    explicit = bool(base)
-    base = base or "http://169.254.169.254/latest/meta-data/"
-    if not base.endswith("/"):
-        base += "/"
     # IMDSv2 (token-required is the EC2 launch default now): try for a
     # session token; fall back to v1-style unauthenticated GETs.
     headers: Dict[str, str] = {}
     try:
         import urllib.request
 
-        token_url = base.split("/latest/")[0] + "/latest/api/token"
         req = urllib.request.Request(
-            token_url, method="PUT",
+            "http://169.254.169.254/latest/api/token", method="PUT",
             headers={"X-aws-ec2-metadata-token-ttl-seconds": "300"})
         with urllib.request.urlopen(req, timeout=0.3) as resp:
             headers = {"X-aws-ec2-metadata-token":
                        resp.read().decode().strip()}
     except Exception:
         pass
-    try:
-        _metadata_get(base + "ami-id", timeout=2.0 if explicit else 0.3,
-                      headers=headers)
-    except Exception:
-        return False  # not on EC2 (reference: isAWS probe)
-    for key, unique in _AWS_KEYS:
-        try:
-            value = _metadata_get(base + key, headers=headers)
-        except Exception:
-            continue
-        attr = key.replace("/", ".")
-        prefix = "unique.platform.aws." if unique else "platform.aws."
-        node.Attributes[f"{prefix}{attr}"] = value
-    instance = node.Attributes.get("unique.platform.aws.instance-id")
-    zone = node.Attributes.get("platform.aws.placement.availability-zone")
-    if instance and zone:
-        node.Links["aws.ec2"] = f"{zone}.{instance}"
-    return True
+    return _env_metadata_fingerprint(
+        node, config, option_key="fingerprint.env_aws.url",
+        env_var="NOMAD_TPU_AWS_METADATA_URL",
+        default_base="http://169.254.169.254/latest/meta-data/",
+        probe="ami-id", keys=_AWS_KEYS, headers=headers,
+        platform_name="aws",
+        attr_of=lambda key: key.replace("/", "."),
+        value_of=lambda v: v,
+        link_name="aws.ec2",
+        link_keys=("platform.aws.placement.availability-zone",
+                   "unique.platform.aws.instance-id"))
 
 
 _GCE_KEYS = (
@@ -217,35 +245,19 @@ _GCE_KEYS = (
 
 def _env_gce(node: Node, config) -> bool:
     """GCE metadata service (reference: fingerprint/env_gce.go); requires
-    the Metadata-Flavor header."""
-    base = ((config.read_option("fingerprint.env_gce.url")
-             if config is not None else "")
-            or os.environ.get("NOMAD_TPU_GCE_METADATA_URL", ""))
-    explicit = bool(base)
-    base = base or "http://169.254.169.254/computeMetadata/v1/"
-    if not base.endswith("/"):
-        base += "/"
-    headers = {"Metadata-Flavor": "Google"}
-    try:
-        _metadata_get(base + "instance/id",
-                      timeout=2.0 if explicit else 0.3, headers=headers)
-    except Exception:
-        return False
-    for key, unique in _GCE_KEYS:
-        try:
-            value = _metadata_get(base + key, headers=headers)
-        except Exception:
-            continue
-        # zone/machine-type come as full resource paths; keep the leaf.
-        value = value.rsplit("/", 1)[-1]
-        attr = key.split("/", 1)[1].replace("/", ".")
-        prefix = "unique.platform.gce." if unique else "platform.gce."
-        node.Attributes[f"{prefix}{attr}"] = value
-    instance = node.Attributes.get("unique.platform.gce.id")
-    zone = node.Attributes.get("platform.gce.zone")
-    if instance and zone:
-        node.Links["gce"] = f"{zone}.{instance}"
-    return True
+    the Metadata-Flavor header. zone/machine-type come as full resource
+    paths; only the leaf is kept."""
+    return _env_metadata_fingerprint(
+        node, config, option_key="fingerprint.env_gce.url",
+        env_var="NOMAD_TPU_GCE_METADATA_URL",
+        default_base="http://169.254.169.254/computeMetadata/v1/",
+        probe="instance/id", keys=_GCE_KEYS,
+        headers={"Metadata-Flavor": "Google"},
+        platform_name="gce",
+        attr_of=lambda key: key.split("/", 1)[1].replace("/", "."),
+        value_of=lambda v: v.rsplit("/", 1)[-1],
+        link_name="gce",
+        link_keys=("platform.gce.zone", "unique.platform.gce.id"))
 
 
 BUILTIN_FINGERPRINTERS: List[Callable] = [

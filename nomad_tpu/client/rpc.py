@@ -153,9 +153,13 @@ class RpcProxy:
                     # added/removed servers during the unlocked ping window,
                     # and a removed server must stay removed.
                     order = [s for s in order if s in self._servers]
+                    if not order or order[0] != addr:
+                        # The pinged server itself was removed: don't promote
+                        # a server whose health was never tested.
+                        return None
                     extra = [s for s in self._servers if s not in order]
                     self._servers = order + extra
-                    return self._servers[0] if self._servers else None
+                    return addr
         return None
 
 
@@ -190,6 +194,10 @@ class NetServerChannel:
 
     def close(self) -> None:
         self._stop_rebalance.set()
+        try:
+            self.pool.close()
+        except Exception:
+            pass
 
     def _ping(self, addr: str) -> bool:
         try:

@@ -1,0 +1,96 @@
+"""Computed-class memoization: correctness parity + work bound
+(reference: scheduler/stack_test.go:13-53's paired with/without-computed-
+class benchmark — here asserted as invariants instead of timings)."""
+
+import numpy as np
+
+from nomad_tpu import mock
+from nomad_tpu.structs import Constraint, compute_node_class
+from nomad_tpu.tensor import TensorIndex
+from nomad_tpu.tensor import constraints as cons_mod
+from nomad_tpu.tensor.constraints import (
+    ClassEligibility,
+    node_meets_constraints,
+)
+
+
+def _mixed_nodes(n=120, n_classes=4):
+    nodes = []
+    for i in range(n):
+        node = mock.node()
+        node.Meta["rack"] = f"r{i % n_classes}"
+        compute_node_class(node)
+        nodes.append(node)
+    return nodes
+
+
+class TestComputedClassParity:
+    def test_masks_match_per_node_evaluation(self):
+        """Class-memoized eligibility must equal brute-force per-node
+        constraint evaluation for memoizable constraints."""
+        nodes = _mixed_nodes()
+        tindex = TensorIndex()
+        for node in nodes:
+            tindex.nt.upsert_node(node)
+        elig = ClassEligibility(tindex.nt, nodes)
+        constraints = [
+            Constraint(LTarget="${meta.rack}", RTarget="r1", Operand="="),
+            Constraint(LTarget="${attr.arch}", RTarget="x86",
+                       Operand="="),
+        ]
+        mask, _, _ = elig.job_mask("job-x", constraints)
+        for node in nodes:
+            row = tindex.nt.row_of[node.ID]
+            assert mask[row] == node_meets_constraints(node, constraints), \
+                node.Meta
+        # Exactly the r1 class is eligible.
+        eligible = {nodes[i].Meta["rack"]
+                    for i, node in enumerate(nodes)
+                    if mask[tindex.nt.row_of[node.ID]]}
+        assert eligible == {"r1"}
+
+    def test_constraint_evaluations_scale_with_classes_not_nodes(self):
+        """The with-computed-class path evaluates constraints once per
+        CLASS; without memoization it would be once per NODE (the 10-100x
+        the reference's paired benchmark demonstrates)."""
+        nodes = _mixed_nodes(n=200, n_classes=5)
+        tindex = TensorIndex()
+        for node in nodes:
+            tindex.nt.upsert_node(node)
+        elig = ClassEligibility(tindex.nt, nodes)
+
+        calls = {"n": 0}
+        orig = cons_mod.node_meets_constraints
+
+        def counting(node, constraints):
+            calls["n"] += 1
+            return orig(node, constraints)
+
+        cons_mod.node_meets_constraints = counting
+        try:
+            constraints = [Constraint(LTarget="${meta.rack}", RTarget="r2",
+                                      Operand="=")]
+            elig.job_mask("job-y", constraints)
+        finally:
+            cons_mod.node_meets_constraints = orig
+        assert 0 < calls["n"] <= 5, calls  # one per class, never per node
+
+    def test_escaped_constraints_fall_back_per_node(self):
+        """unique.* targets can't memoize by class: each node is evaluated
+        individually and the mask stays exact."""
+        nodes = _mixed_nodes(n=20, n_classes=2)
+        tindex = TensorIndex()
+        for node in nodes:
+            tindex.nt.upsert_node(node)
+        elig = ClassEligibility(tindex.nt, nodes)
+        target = nodes[7]
+        constraints = [Constraint(
+            LTarget="${attr.unique.hostname}",
+            RTarget=target.Attributes.get("unique.hostname", ""),
+            Operand="=")]
+        mask, _, escaped = elig.job_mask("job-z", constraints)
+        expected = np.zeros_like(mask)
+        for node in nodes:
+            if node_meets_constraints(node, constraints):
+                expected[tindex.nt.row_of[node.ID]] = True
+        assert (mask == expected).all()

@@ -1,0 +1,143 @@
+"""Diff-engine edge matrix (reference: nomad/structs/diff_test.go's wider
+case grid — group rename, periodic/update-strategy/log/artifact/restart
+changes, None<->object transitions, service add/remove)."""
+
+from nomad_tpu import mock
+from nomad_tpu.structs import (
+    PeriodicConfig,
+    RestartPolicy,
+    Service,
+    ServiceCheck,
+    TaskArtifact,
+    UpdateStrategy,
+)
+from nomad_tpu.structs.diff import (
+    DiffTypeAdded,
+    DiffTypeDeleted,
+    DiffTypeEdited,
+    DiffTypeNone,
+    job_diff,
+)
+from nomad_tpu.structs.structs import SECOND, LogConfig
+
+
+def _obj(diff, name):
+    return next((o for o in diff.Objects if o.Name == name), None)
+
+
+def _tg(jd, name):
+    return next((g for g in jd.TaskGroups if g.Name == name), None)
+
+
+def _task(gd, name):
+    return next((t for t in gd.Tasks if t.Name == name), None)
+
+
+class TestGroupMatrix:
+    def test_group_rename_is_delete_plus_add(self):
+        old = mock.job()
+        new = old.copy()
+        new.TaskGroups[0].Name = "renamed"
+        jd = job_diff(old, new)
+        assert jd.Type == DiffTypeEdited
+        assert _tg(jd, old.TaskGroups[0].Name).Type == DiffTypeDeleted
+        assert _tg(jd, "renamed").Type == DiffTypeAdded
+
+    def test_restart_policy_change(self):
+        old = mock.job()
+        old.TaskGroups[0].RestartPolicy = RestartPolicy(
+            Attempts=2, Interval=60 * SECOND, Delay=5 * SECOND, Mode="fail")
+        new = old.copy()
+        new.TaskGroups[0].RestartPolicy.Attempts = 9
+        jd = job_diff(old, new)
+        gd = _tg(jd, old.TaskGroups[0].Name)
+        rp = _obj(gd, "RestartPolicy")
+        assert rp is not None and rp.Type == DiffTypeEdited
+        field = next(f for f in rp.Fields if f.Name == "Attempts")
+        assert field.Old == "2" and field.New == "9"
+
+
+class TestJobLevelMatrix:
+    def test_periodic_added(self):
+        old = mock.job()
+        new = old.copy()
+        new.Periodic = PeriodicConfig(Enabled=True, Spec="*/15 * * * *",
+                                      SpecType="cron")
+        jd = job_diff(old, new)
+        per = _obj(jd, "Periodic")
+        assert per is not None and per.Type == DiffTypeAdded
+
+    def test_update_strategy_edited(self):
+        old = mock.job()
+        old.Update = UpdateStrategy(Stagger=10 * SECOND, MaxParallel=1)
+        new = old.copy()
+        new.Update.MaxParallel = 4
+        jd = job_diff(old, new)
+        upd = _obj(jd, "Update")
+        assert upd is not None and upd.Type == DiffTypeEdited
+
+    def test_update_strategy_removed(self):
+        old = mock.job()
+        old.Update = UpdateStrategy(Stagger=10 * SECOND, MaxParallel=1)
+        new = old.copy()
+        new.Update = None
+        jd = job_diff(old, new)
+        upd = _obj(jd, "Update")
+        assert upd is not None and upd.Type == DiffTypeDeleted
+
+
+class TestTaskMatrix:
+    def _task_diff(self, mutate):
+        old = mock.job()
+        new = old.copy()
+        mutate(new.TaskGroups[0].Tasks[0])
+        jd = job_diff(old, new)
+        gd = _tg(jd, old.TaskGroups[0].Name)
+        return _task(gd, old.TaskGroups[0].Tasks[0].Name)
+
+    def test_log_config_edited(self):
+        def mutate(task):
+            task.LogConfig = LogConfig(MaxFiles=3, MaxFileSizeMB=5)
+        td = self._task_diff(mutate)
+        lc = _obj(td, "LogConfig")
+        assert lc is not None and lc.Type in (DiffTypeEdited, DiffTypeAdded)
+
+    def test_artifact_added(self):
+        def mutate(task):
+            task.Artifacts.append(TaskArtifact(
+                GetterSource="http://example.com/x.tgz"))
+        td = self._task_diff(mutate)
+        art = _obj(td, "Artifact")
+        assert art is not None and art.Type == DiffTypeAdded
+
+    def test_service_added_and_removed(self):
+        old = mock.job()
+        old.TaskGroups[0].Tasks[0].Services = [Service(
+            Name="old-svc", PortLabel="main")]
+        new = old.copy()
+        new.TaskGroups[0].Tasks[0].Services = [Service(
+            Name="new-svc", PortLabel="main")]
+        jd = job_diff(old, new)
+        gd = _tg(jd, old.TaskGroups[0].Name)
+        td = _task(gd, old.TaskGroups[0].Tasks[0].Name)
+        names = {(o.Name, o.Type) for o in td.Objects}
+        assert ("Service", DiffTypeAdded) in names
+        assert ("Service", DiffTypeDeleted) in names
+
+    def test_check_interval_edit_nested(self):
+        old = mock.job()
+        old.TaskGroups[0].Tasks[0].Services = [Service(
+            Name="svc", PortLabel="main",
+            Checks=[ServiceCheck(Name="c", Type="tcp",
+                                 Interval=10 * SECOND,
+                                 Timeout=2 * SECOND)])]
+        new = old.copy()
+        new.TaskGroups[0].Tasks[0].Services[0].Checks[0].Interval = \
+            30 * SECOND
+        jd = job_diff(old, new)
+        gd = _tg(jd, old.TaskGroups[0].Name)
+        td = _task(gd, old.TaskGroups[0].Tasks[0].Name)
+        svc = _obj(td, "Service")
+        assert svc is not None and svc.Type == DiffTypeEdited
+        chk = _obj(svc, "Check")
+        assert chk is not None and chk.Type == DiffTypeEdited

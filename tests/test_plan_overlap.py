@@ -147,10 +147,13 @@ class TestContentionStorm:
     def test_overlap_beats_serial_with_slow_applies(self):
         """With consensus latency, the overlapped applier sustains strictly
         higher applied-plans/sec than the serial one-at-a-time path."""
-        def run(serial: bool, delay=0.012, n_plans=12):
+        def run(serial: bool, delay=0.02, n_plans=12):
             fsm = FSM()
             raft = SlowRaft(fsm, delay=delay)
-            nodes = _register_nodes(raft._inner, 24, cpu=100000)
+            # Enough nodes that per-plan verification is non-trivial: the
+            # overlap's win is exactly the verify time hidden inside apply
+            # latency, and it must dominate scheduler/CI noise.
+            nodes = _register_nodes(raft._inner, 64, cpu=100000)
             queue = PlanQueue()
             queue.set_enabled(True)
             applier = PlanApplier(queue, raft, pool_size=4)

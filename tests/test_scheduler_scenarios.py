@@ -1,0 +1,320 @@
+"""Deep scheduler scenario matrix (shaped after the reference's
+scheduler/generic_sched_test.go scenarios not yet covered by
+tests/test_scheduler.py: count-zero, alloc-fail metrics, mixed
+feasible/infeasible groups, blocked-eval processing/reuse, node-limited
+count increases, drain under an update strategy, batch rerun semantics)."""
+
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.scheduler import Harness
+from nomad_tpu.structs import Constraint, Resources, TaskGroup, UpdateStrategy
+from nomad_tpu.structs.structs import (
+    SECOND,
+    AllocClientStatusComplete,
+    AllocClientStatusFailed,
+    AllocClientStatusRunning,
+    AllocDesiredStatusRun,
+    AllocDesiredStatusStop,
+    EvalStatusBlocked,
+    EvalStatusComplete,
+    EvalStatusFailed,
+    EvalStatusPending,
+    EvalTriggerJobRegister,
+    EvalTriggerNodeUpdate,
+    JobTypeBatch,
+    NodeStatusDown,
+)
+
+
+def make_eval(job, trigger=EvalTriggerJobRegister,
+              status=EvalStatusPending):
+    ev = mock.eval()
+    ev.JobID = job.ID
+    ev.Type = job.Type
+    ev.TriggeredBy = trigger
+    ev.Status = status
+    return ev
+
+
+def placed_allocs(plan):
+    return [a for allocs in plan.NodeAllocation.values() for a in allocs]
+
+
+class TestRegisterEdges:
+    def test_count_zero_is_noop_complete(self):
+        """(reference: TestServiceSched_JobRegister_CountZero)"""
+        h = Harness()
+        for _ in range(3):
+            h.upsert("node", mock.node())
+        job = mock.job()
+        job.TaskGroups[0].Count = 0
+        job.init_fields()
+        h.upsert("job", job)
+        h.process("service", make_eval(job))
+        assert h.plans == []  # nothing to place -> no plan submitted
+        assert h.evals[-1].Status == EvalStatusComplete
+
+    def test_alloc_fail_fills_metrics(self):
+        """Nodes exist but are too small: FailedTGAllocs carries the
+        dimension-exhaustion diagnosis and a blocked eval is created
+        (reference: TestServiceSched_JobRegister_AllocFail +
+        CreateBlockedEval)."""
+        h = Harness()
+        for _ in range(2):
+            node = mock.node()
+            node.Resources.MemoryMB = 16  # too small for the mock task
+            h.upsert("node", node)
+        job = mock.job()
+        job.TaskGroups[0].Count = 1
+        h.upsert("job", job)
+        h.process("service", make_eval(job))
+
+        assert h.plans == []
+        final = h.evals[-1]
+        assert final.Status == EvalStatusComplete
+        metric = final.FailedTGAllocs[job.TaskGroups[0].Name]
+        assert metric.NodesEvaluated > 0
+        assert any("memory" in dim for dim in metric.DimensionExhausted)
+        # Blocked eval chained for when capacity frees.
+        blocked = [e for e in h.creates
+                   if e.Status == EvalStatusBlocked]
+        assert len(blocked) == 1
+        assert final.BlockedEval == blocked[0].ID
+        # Class eligibility captured (all classes ineligible).
+        assert blocked[0].ClassEligibility or blocked[0].EscapedComputedClass
+
+    def test_feasible_and_infeasible_groups(self):
+        """One group places, the other can't: plan carries the feasible
+        placements AND the eval records the infeasible group's failure
+        (reference: TestServiceSched_JobRegister_FeasibleAndInfeasibleTG)."""
+        h = Harness()
+        for _ in range(2):
+            h.upsert("node", mock.node())
+        job = mock.job()
+        feasible = job.TaskGroups[0]
+        feasible.Count = 2
+        infeasible = feasible.copy()
+        infeasible.Name = "hopeless"
+        infeasible.Count = 1
+        infeasible.Constraints = [Constraint(
+            LTarget="${attr.kernel.name}", RTarget="plan9", Operand="=")]
+        job.TaskGroups.append(infeasible)
+        job.init_fields()
+        h.upsert("job", job)
+        h.process("service", make_eval(job))
+
+        assert len(h.plans) == 1
+        placed = placed_allocs(h.plans[0])
+        assert len(placed) == 2
+        assert all(a.TaskGroup == feasible.Name for a in placed)
+        final = h.evals[-1]
+        assert final.Status == EvalStatusComplete
+        assert set(final.FailedTGAllocs) == {"hopeless"}
+
+
+class TestBlockedEvalLifecycle:
+    def _blocked_setup(self):
+        """A job blocked on capacity: one tiny node, count 2 big asks."""
+        h = Harness()
+        node = mock.node()
+        node.Resources.CPU = 700
+        node.Resources.MemoryMB = 300
+        h.upsert("node", node)
+        job = mock.job()
+        job.TaskGroups[0].Count = 2
+        task = job.TaskGroups[0].Tasks[0]
+        task.Resources.CPU = 500
+        task.Resources.MemoryMB = 256
+        job.init_fields()
+        h.upsert("job", job)
+        h.process("service", make_eval(job))
+        assert len(h.creates) == 1  # blocked follow-up
+        return h, job, h.creates[0]
+
+    def test_blocked_eval_places_when_capacity_arrives(self):
+        """Processing the blocked eval after a node joins places the
+        remainder (reference: TestServiceSched_EvaluateBlockedEval +
+        unblock flow)."""
+        h, job, blocked = self._blocked_setup()
+        h.upsert("node", mock.node())  # capacity arrives
+        h.process("service", blocked)
+        total = len(h.state.allocs_by_job(job.ID))
+        assert total == 2
+        assert h.evals[-1].Status == EvalStatusComplete
+        # Fully placed: no re-block.
+        assert len(h.creates) == 1
+        assert h.reblocks == []
+
+    def test_blocked_eval_still_short_reblocks(self):
+        """A blocked eval that STILL can't fully place is re-blocked with
+        refreshed class eligibility, not completed and not duplicated
+        (reference: blocked-eval reuse, TestServiceSched_EvaluateBlockedEval
+        remaining-capacity variant)."""
+        h, job, blocked = self._blocked_setup()
+        h.process("service", blocked)  # no new capacity
+        assert h.reblocks, "expected the eval to re-block"
+        assert h.reblocks[-1].ID == blocked.ID
+        # Not completed, no second blocked eval created.
+        assert len(h.creates) == 1
+
+    def test_blocked_eval_finished_completes(self):
+        """(reference: TestServiceSched_EvaluateBlockedEval_Finished)"""
+        h, job, blocked = self._blocked_setup()
+        big = mock.node()
+        h.upsert("node", big)
+        h.process("service", blocked)
+        final = h.evals[-1]
+        assert final.Status == EvalStatusComplete
+        assert not final.FailedTGAllocs
+
+
+class TestModifyEdges:
+    def test_modify_count_zero_stops_all(self):
+        """(reference: TestServiceSched_JobModify_CountZero)"""
+        h = Harness()
+        nodes = [mock.node() for _ in range(3)]
+        for n in nodes:
+            h.upsert("node", n)
+        job = mock.job()
+        job.TaskGroups[0].Count = 3
+        h.upsert("job", job)
+        h.process("service", make_eval(job))
+        assert len(h.state.allocs_by_job(job.ID)) == 3
+
+        update = job.copy()
+        update.TaskGroups[0].Count = 0
+        update.init_fields()
+        h.upsert("job", update)
+        h.process("service", make_eval(update))
+        allocs = h.state.allocs_by_job(job.ID)
+        stopped = [a for a in allocs
+                   if a.DesiredStatus == AllocDesiredStatusStop]
+        assert len(stopped) == 3
+        assert h.evals[-1].Status == EvalStatusComplete
+
+    def test_incr_count_beyond_capacity_partial_and_blocked(self):
+        """Count increase that outgrows the cluster places what fits and
+        blocks the rest (reference:
+        TestServiceSched_JobModify_IncrCount_NodeLimit)."""
+        h = Harness()
+        node = mock.node()
+        # Room for exactly two 1000MHz asks (mock nodes reserve 100MHz).
+        node.Resources.CPU = 2200
+        node.Resources.MemoryMB = 4096
+        h.upsert("node", node)
+        job = mock.job()
+        job.TaskGroups[0].Count = 1
+        task = job.TaskGroups[0].Tasks[0]
+        task.Resources.CPU = 1000
+        task.Resources.MemoryMB = 256
+        job.init_fields()
+        h.upsert("job", job)
+        h.process("service", make_eval(job))
+        assert len(h.state.allocs_by_job(job.ID)) == 1
+
+        update = job.copy()
+        update.TaskGroups[0].Count = 5
+        update.init_fields()
+        h.upsert("job", update)
+        h.process("service", make_eval(update))
+        run_allocs = [a for a in h.state.allocs_by_job(job.ID)
+                      if a.DesiredStatus == AllocDesiredStatusRun]
+        assert 1 < len(run_allocs) < 5  # partial: capacity for 2 x 1000MHz
+        final = h.evals[-1]
+        assert final.FailedTGAllocs
+        assert any(e.Status == EvalStatusBlocked for e in h.creates)
+
+
+class TestDrainWithUpdateStrategy:
+    def test_drain_migrates_respecting_stagger(self):
+        """Draining with max_parallel=1 migrates one alloc per pass and
+        chains a rolling-update follow-up eval with the stagger wait
+        (reference: TestServiceSched_NodeDrain_UpdateStrategy)."""
+        h = Harness()
+        drain_node = mock.node()
+        h.upsert("node", drain_node)
+        for _ in range(2):
+            h.upsert("node", mock.node())
+        job = mock.job()
+        job.TaskGroups[0].Count = 2
+        job.Update = UpdateStrategy(Stagger=30 * SECOND, MaxParallel=1)
+        job.init_fields()
+        h.upsert("job", job)
+        h.process("service", make_eval(job))
+        assert len(h.state.allocs_by_job(job.ID)) == 2
+
+        # Drain every node that got an alloc... drain just one that did.
+        victim_id = h.state.allocs_by_job(job.ID)[0].NodeID
+        h.state.update_node_drain(h._next_index(), victim_id, True)
+        on_victim = [a for a in h.state.allocs_by_job(job.ID)
+                     if a.NodeID == victim_id]
+        if len(on_victim) < 2:
+            # Force both allocs onto the drained node's fate: drain all
+            # nodes carrying allocs so two migrations are needed.
+            for a in h.state.allocs_by_job(job.ID):
+                h.state.update_node_drain(h._next_index(), a.NodeID, True)
+
+        h.process("service", make_eval(job, EvalTriggerNodeUpdate))
+        # Only max_parallel=1 migration this pass; a follow-up eval with
+        # the stagger wait carries the rest.
+        stops = [a for plan in h.plans for allocs in plan.NodeUpdate.values()
+                 for a in allocs]
+        assert len(stops) >= 1
+        follow = [e for e in h.creates if e.Wait == 30 * SECOND]
+        assert follow, "expected a stagger follow-up eval"
+
+
+class TestBatchRerunSemantics:
+    def _run_one(self, client_status):
+        h = Harness()
+        node = mock.node()
+        h.upsert("node", node)
+        job = mock.job()
+        job.Type = JobTypeBatch
+        job.TaskGroups[0].Count = 1
+        job.init_fields()
+        h.upsert("job", job)
+        h.process("batch", make_eval(job))
+        allocs = h.state.allocs_by_job(job.ID)
+        assert len(allocs) == 1
+        done = allocs[0].copy()
+        done.ClientStatus = client_status
+        h.upsert("allocs", [done])
+        return h, job
+
+    def test_failed_alloc_is_replaced(self):
+        """(reference: TestBatchSched_Run_FailedAlloc)"""
+        h, job = self._run_one(AllocClientStatusFailed)
+        h.process("batch", make_eval(job))
+        run = [a for a in h.state.allocs_by_job(job.ID)
+               if a.DesiredStatus == AllocDesiredStatusRun
+               and a.ClientStatus != AllocClientStatusFailed]
+        assert len(run) == 1
+
+    def test_successful_alloc_not_rerun(self):
+        """(reference: TestBatchSched_ReRun_SuccessfullyFinishedAlloc)"""
+        h, job = self._run_one(AllocClientStatusComplete)
+        h.process("batch", make_eval(job))
+        assert len(h.state.allocs_by_job(job.ID)) == 1  # nothing new
+
+    def test_drained_alloc_is_migrated(self):
+        """(reference: TestBatchSched_Run_DrainedAlloc)"""
+        h = Harness()
+        n1, n2 = mock.node(), mock.node()
+        h.upsert("node", n1)
+        h.upsert("node", n2)
+        job = mock.job()
+        job.Type = JobTypeBatch
+        job.TaskGroups[0].Count = 1
+        job.init_fields()
+        h.upsert("job", job)
+        h.process("batch", make_eval(job))
+        alloc = h.state.allocs_by_job(job.ID)[0]
+        h.state.update_node_drain(h._next_index(), alloc.NodeID, True)
+        h.process("batch", make_eval(job, EvalTriggerNodeUpdate))
+        allocs = h.state.allocs_by_job(job.ID)
+        migrated = [a for a in allocs
+                    if a.DesiredStatus == AllocDesiredStatusRun
+                    and a.NodeID != alloc.NodeID]
+        assert len(migrated) == 1

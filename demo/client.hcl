@@ -1,0 +1,16 @@
+name = "client1"
+bind_addr = "127.0.0.1"
+data_dir = "/tmp/nomad-tpu-demo/client"
+
+ports {
+  http = 4650
+}
+
+client {
+  enabled = true
+  server_discovery_url = "http://127.0.0.1:4646"
+
+  options {
+    "driver.raw_exec.enable" = "1"
+  }
+}

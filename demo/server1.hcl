@@ -1,0 +1,15 @@
+name = "server1"
+bind_addr = "127.0.0.1"
+data_dir = "/tmp/nomad-tpu-demo/server1"
+
+ports {
+  http = 4646
+  rpc = 4701
+  serf = 4801
+}
+
+server {
+  enabled = true
+  bootstrap_expect = 3
+
+}

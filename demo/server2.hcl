@@ -1,0 +1,15 @@
+name = "server2"
+bind_addr = "127.0.0.1"
+data_dir = "/tmp/nomad-tpu-demo/server2"
+
+ports {
+  http = 4647
+  rpc = 4702
+  serf = 4802
+}
+
+server {
+  enabled = true
+  bootstrap_expect = 3
+  start_join = ["127.0.0.1:4801"]
+}

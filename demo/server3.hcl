@@ -1,0 +1,15 @@
+name = "server3"
+bind_addr = "127.0.0.1"
+data_dir = "/tmp/nomad-tpu-demo/server3"
+
+ports {
+  http = 4648
+  rpc = 4703
+  serf = 4803
+}
+
+server {
+  enabled = true
+  bootstrap_expect = 3
+  start_join = ["127.0.0.1:4801"]
+}

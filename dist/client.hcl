@@ -1,0 +1,16 @@
+# nomad-tpu client agent (reference shape: dist/client.hcl)
+bind_addr = "127.0.0.1"
+data_dir = "/var/lib/nomad-tpu"
+
+client {
+  enabled = true
+  # Static server RPC addresses...
+  servers = ["10.1.0.1:4647", "10.1.0.2:4647", "10.1.0.3:4647"]
+  # ...or bootstrap them from any agent's HTTP API via the service
+  # registry instead:
+  # server_discovery_url = "http://10.1.0.1:4646"
+
+  options {
+    "driver.raw_exec.enable" = "1"
+  }
+}

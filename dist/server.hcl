@@ -1,0 +1,25 @@
+# nomad-tpu server agent (reference shape: dist/server.hcl)
+bind_addr = "0.0.0.0"
+data_dir = "/var/lib/nomad-tpu"
+
+ports {
+  http = 4646
+  rpc = 4647
+  serf = 4648
+}
+
+# Every server needs a UNIQUE name (defaults to the hostname).
+name = "server-1"
+
+server {
+  enabled = true
+  bootstrap_expect = 3
+  # Seed gossip with any existing server's serf address; every server
+  # found this way is added to the raft peer set automatically.
+  start_join = ["10.1.0.1:4648"]
+}
+
+telemetry {
+  # statsd_address = "127.0.0.1:8125"
+  collection_interval = "10s"
+}

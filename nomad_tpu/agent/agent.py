@@ -325,8 +325,14 @@ class Agent:
         return [f"{self.config.bind_addr}:{port}"]
 
     def leader_address(self) -> str:
+        """The current raft leader, or "" when the cluster has no leader
+        (a dormant bootstrap-expect quorum, an election in flight). Never
+        guess: reporting ourselves as leader masks a cluster that hasn't
+        actually formed."""
+        if self.cluster is None and self.server is not None:
+            return self.server_addresses()[0]  # dev mode: always leader
         if self.server is not None:
             leader = getattr(self.server.raft, "leader_id", None)
             if leader:
                 return leader
-        return self.server_addresses()[0]
+        return ""

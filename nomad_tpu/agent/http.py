@@ -530,9 +530,7 @@ def route(agent, method: str, path: str, query, get_body):
             return rpc("Status.Leader", {}), None
         return agent.leader_address(), None
     if path == "/v1/status/peers":
-        if remote:
-            return rpc("Status.Peers", {}), None
-        return [agent.leader_address()], None
+        return rpc("Status.Peers", {}), None
     if path == "/v1/regions":
         # gossip-derived region list when federated (reference:
         # Region.List over the serf peers map, region_endpoint.go)

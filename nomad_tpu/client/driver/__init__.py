@@ -4,6 +4,12 @@ A Driver validates config, fingerprints its availability onto the node
 (`driver.<name>` attribute), starts tasks, and re-opens handles after agent
 restart. Built-ins: raw_exec, exec (cgroup/chroot isolation), java, qemu,
 docker, and mock_driver for tests.
+
+Deliberate exclusion: the reference's rkt driver (client/driver/rkt.go) is
+not reproduced. The rkt project was archived in 2020 and its container
+images/CLI are unavailable on modern systems; its use cases are covered by
+the docker and exec drivers. The Driver interface is the extension seam if
+an equivalent is ever needed.
 """
 
 from .base import Driver, DriverContext, DriverHandle, ExecContext, WaitResult  # noqa: F401

@@ -209,13 +209,21 @@ def _env_aws(node: Node, config) -> bool:
     URL is overridable (client option / env var) so tests and non-standard
     environments can point it at a mock."""
     # IMDSv2 (token-required is the EC2 launch default now): try for a
-    # session token; fall back to v1-style unauthenticated GETs.
+    # session token; fall back to v1-style unauthenticated GETs. The token
+    # URL derives from the same (overridable) base so mocks stay in charge.
+    base = ((config.read_option("fingerprint.env_aws.url")
+             if config is not None else "")
+            or os.environ.get("NOMAD_TPU_AWS_METADATA_URL", "")
+            or "http://169.254.169.254/latest/meta-data/")
     headers: Dict[str, str] = {}
     try:
+        import urllib.parse as _parse
         import urllib.request
 
+        root = _parse.urlsplit(base)
+        token_url = f"{root.scheme}://{root.netloc}/latest/api/token"
         req = urllib.request.Request(
-            "http://169.254.169.254/latest/api/token", method="PUT",
+            token_url, method="PUT",
             headers={"X-aws-ec2-metadata-token-ttl-seconds": "300"})
         with urllib.request.urlopen(req, timeout=0.3) as resp:
             headers = {"X-aws-ec2-metadata-token":

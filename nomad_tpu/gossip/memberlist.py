@@ -437,6 +437,16 @@ class Memberlist:
                 # A statement about us we didn't make: refute if it's old
                 # news (e.g. a stale address) by out-incarnating it.
                 me = self._members[self.name]
+                if (addr, port) != (me.addr, me.port) \
+                        and inc >= me.incarnation:
+                    # A FRESH claim from a different address is a genuine
+                    # name conflict; stale echoes of our own old address
+                    # (inc < ours) are routine refutation traffic.
+                    LOG.warning(
+                        "%s: ANOTHER member is gossiping under our name from "
+                        "%s:%s — member names must be unique per region "
+                        "(set a distinct `name` in each agent config)",
+                        self.name, addr, port)
                 if inc > me.incarnation and not self._left:
                     self._incarnation = inc + 1
                     me.incarnation = self._incarnation

@@ -64,7 +64,7 @@ class ClusterServer:
         self.endpoints.membership = self.membership
         self.membership.start()
         if join:
-            self.membership.join(join)
+            self.membership.retry_join(join)
         return self.membership
 
     def start(self) -> None:

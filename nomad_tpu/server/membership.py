@@ -132,6 +132,31 @@ class ServerMembership:
             self.reconcile()
         return n
 
+    def retry_join(self, seeds: List[str], interval: float = 5.0,
+                   max_attempts: int = 60) -> None:
+        """Keep trying the seed list until one join lands (reference:
+        retry_join, command/agent/command.go retryJoin) — on a cold cluster
+        boot the seed server may simply not be listening yet. Runs on its
+        own daemon thread: joins block on TCP dials and on raft work, which
+        must not occupy the shared timer wheel's callback workers."""
+        def loop() -> None:
+            for attempt in range(max_attempts):
+                if self._stop.is_set():
+                    return
+                try:
+                    if self.join(seeds) > 0:
+                        return
+                except Exception:
+                    pass
+                LOG.info("%s: join %s failed; retrying in %.0fs",
+                         self.gossip_name, seeds, interval)
+                if self._stop.wait(interval):
+                    return
+            LOG.warning("%s: giving up joining %s", self.gossip_name, seeds)
+
+        threading.Thread(target=loop, daemon=True,
+                         name=f"retry-join-{self.gossip_name}").start()
+
     def leave(self) -> None:
         self.memberlist.leave()
         self._stop.set()

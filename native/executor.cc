@@ -1,0 +1,494 @@
+// nomad-executor: the native task supervisor.
+//
+// The reference runs tasks under a native (Go) executor re-exec'd as a
+// separate plugin process (client/driver/executor/ + plugins.go); this is
+// the same runtime component in C++. Contract-compatible with the Python
+// fallback (nomad_tpu/client/executor.py):
+//
+//   argv[1] = <spec.json>   {command, args, env, cwd, user?, task_name,
+//                            log_dir, max_files, max_file_size_mb,
+//                            cgroup?: {cpu_shares, memory_mb}, chroot?}
+//   writes  <task>.executor_state.json  {executor_pid, pid, pgid, started_at}
+//           <task>.exit_status.json     {exit_code, signal, finished_at}
+//   logs    <log_dir>/<task>.stdout.N / .stderr.N, size-rotated
+//   signals SIGTERM/SIGINT forwarded to the task's process group
+//
+// Build: make -C native   (pure standard library + POSIX; no dependencies)
+
+#include <cerrno>
+#include <cctype>
+#include <csignal>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <ctime>
+#include <fcntl.h>
+#include <grp.h>
+#include <map>
+#include <memory>
+#include <poll.h>
+#include <pwd.h>
+#include <string>
+#include <sys/stat.h>
+#include <sys/types.h>
+#include <sys/wait.h>
+#include <unistd.h>
+#include <vector>
+
+// ---------------------------------------------------------------- tiny JSON
+// Parses the executor spec subset: objects, arrays, strings (with escapes),
+// numbers, booleans, null.
+struct JValue {
+  enum Kind { Null, Bool, Num, Str, Arr, Obj } kind = Null;
+  bool b = false;
+  double num = 0;
+  std::string str;
+  std::vector<JValue> arr;
+  std::map<std::string, JValue> obj;
+
+  const JValue *get(const std::string &key) const {
+    auto it = obj.find(key);
+    return it == obj.end() ? nullptr : &it->second;
+  }
+  std::string get_str(const std::string &key, const std::string &dflt = "") const {
+    const JValue *v = get(key);
+    return (v && v->kind == Str) ? v->str : dflt;
+  }
+  long get_int(const std::string &key, long dflt) const {
+    const JValue *v = get(key);
+    return (v && v->kind == Num) ? (long)v->num : dflt;
+  }
+};
+
+struct JParser {
+  const char *p, *end;
+  explicit JParser(const std::string &s) : p(s.data()), end(s.data() + s.size()) {}
+
+  void skip_ws() { while (p < end && isspace((unsigned char)*p)) p++; }
+  bool fail(const char *msg) {
+    fprintf(stderr, "executor: bad spec json: %s\n", msg);
+    exit(2);
+  }
+  JValue parse() {
+    skip_ws();
+    if (p >= end) fail("eof");
+    char c = *p;
+    if (c == '{') return parse_obj();
+    if (c == '[') return parse_arr();
+    if (c == '"') return parse_str();
+    if (c == 't' || c == 'f') return parse_bool();
+    if (c == 'n') { p += 4; return JValue{}; }
+    return parse_num();
+  }
+  JValue parse_obj() {
+    JValue v; v.kind = JValue::Obj; p++;  // '{'
+    skip_ws();
+    if (p < end && *p == '}') { p++; return v; }
+    while (p < end) {
+      skip_ws();
+      JValue key = parse_str();
+      skip_ws();
+      if (p >= end || *p != ':') fail("expected ':'");
+      p++;
+      v.obj[key.str] = parse();
+      skip_ws();
+      if (p < end && *p == ',') { p++; continue; }
+      if (p < end && *p == '}') { p++; break; }
+      fail("expected ',' or '}'");
+    }
+    return v;
+  }
+  JValue parse_arr() {
+    JValue v; v.kind = JValue::Arr; p++;  // '['
+    skip_ws();
+    if (p < end && *p == ']') { p++; return v; }
+    while (p < end) {
+      v.arr.push_back(parse());
+      skip_ws();
+      if (p < end && *p == ',') { p++; continue; }
+      if (p < end && *p == ']') { p++; break; }
+      fail("expected ',' or ']'");
+    }
+    return v;
+  }
+  JValue parse_str() {
+    if (*p != '"') fail("expected string");
+    p++;
+    JValue v; v.kind = JValue::Str;
+    while (p < end && *p != '"') {
+      if (*p == '\\' && p + 1 < end) {
+        p++;
+        switch (*p) {
+          case 'n': v.str += '\n'; break;
+          case 't': v.str += '\t'; break;
+          case 'r': v.str += '\r'; break;
+          case 'b': v.str += '\b'; break;
+          case 'f': v.str += '\f'; break;
+          case '/': v.str += '/'; break;
+          case '\\': v.str += '\\'; break;
+          case '"': v.str += '"'; break;
+          case 'u': {
+            if (p + 4 >= end) fail("bad \\u");
+            unsigned cp = (unsigned)strtoul(std::string(p + 1, p + 5).c_str(),
+                                            nullptr, 16);
+            p += 4;
+            // UTF-8 encode (surrogate pairs for env values are not expected
+            // from the Python json emitter's ascii output for BMP chars;
+            // handle pairs anyway).
+            if (cp >= 0xD800 && cp <= 0xDBFF && p + 6 < end && p[1] == '\\'
+                && p[2] == 'u') {
+              unsigned lo = (unsigned)strtoul(std::string(p + 3, p + 7).c_str(),
+                                              nullptr, 16);
+              p += 6;
+              cp = 0x10000 + ((cp - 0xD800) << 10) + (lo - 0xDC00);
+            }
+            if (cp < 0x80) v.str += (char)cp;
+            else if (cp < 0x800) {
+              v.str += (char)(0xC0 | (cp >> 6));
+              v.str += (char)(0x80 | (cp & 0x3F));
+            } else if (cp < 0x10000) {
+              v.str += (char)(0xE0 | (cp >> 12));
+              v.str += (char)(0x80 | ((cp >> 6) & 0x3F));
+              v.str += (char)(0x80 | (cp & 0x3F));
+            } else {
+              v.str += (char)(0xF0 | (cp >> 18));
+              v.str += (char)(0x80 | ((cp >> 12) & 0x3F));
+              v.str += (char)(0x80 | ((cp >> 6) & 0x3F));
+              v.str += (char)(0x80 | (cp & 0x3F));
+            }
+            break;
+          }
+          default: v.str += *p;
+        }
+      } else {
+        v.str += *p;
+      }
+      p++;
+    }
+    if (p >= end) fail("unterminated string");
+    p++;  // closing quote
+    return v;
+  }
+  JValue parse_bool() {
+    JValue v; v.kind = JValue::Bool;
+    if (*p == 't') { v.b = true; p += 4; } else { v.b = false; p += 5; }
+    return v;
+  }
+  JValue parse_num() {
+    JValue v; v.kind = JValue::Num;
+    char *np = nullptr;
+    v.num = strtod(p, &np);
+    if (np == p) fail("bad number");
+    p = np;
+    return v;
+  }
+};
+
+static std::string json_escape(const std::string &s) {
+  std::string out;
+  for (char c : s) {
+    switch (c) {
+      case '"': out += "\\\""; break;
+      case '\\': out += "\\\\"; break;
+      case '\n': out += "\\n"; break;
+      case '\t': out += "\\t"; break;
+      case '\r': out += "\\r"; break;
+      default:
+        if ((unsigned char)c < 0x20) {
+          char buf[8];
+          snprintf(buf, sizeof buf, "\\u%04x", c);
+          out += buf;
+        } else out += c;
+    }
+  }
+  return out;
+}
+
+// ------------------------------------------------------------- log rotator
+// Mirrors nomad_tpu/client/logs.py FileRotator: <base>.N files, rotate at
+// max_size bytes, prune the oldest beyond max_files.
+class Rotator {
+ public:
+  Rotator(std::string dir, std::string base, int max_files, long max_size)
+      : dir_(std::move(dir)), base_(std::move(base)),
+        max_files_(max_files < 1 ? 1 : max_files),
+        max_size_(max_size < 1 ? 1 : max_size) {
+    index_ = find_latest_index();
+    open_current();
+  }
+  ~Rotator() { if (fd_ >= 0) close(fd_); }
+
+  void write(const char *buf, ssize_t n) {
+    if (fd_ < 0) return;
+    if (written_ + n > max_size_) rotate();
+    ssize_t off = 0;
+    while (off < n) {
+      ssize_t w = ::write(fd_, buf + off, (size_t)(n - off));
+      if (w <= 0) return;
+      off += w;
+    }
+    written_ += n;
+  }
+
+ private:
+  std::string file(int index) const {
+    return dir_ + "/" + base_ + "." + std::to_string(index);
+  }
+  int find_latest_index() const {
+    // Cheap probe: walk indexes upward until a file is missing.
+    int best = 0;
+    for (int i = 0; i < 100000; i++) {
+      struct stat st;
+      if (stat(file(i).c_str(), &st) == 0) best = i; else if (i > best) break;
+    }
+    return best;
+  }
+  void open_current() {
+    mkdir(dir_.c_str(), 0755);
+    // O_CLOEXEC: the task must not inherit writable fds to its own logs.
+    fd_ = open(file(index_).c_str(),
+               O_WRONLY | O_CREAT | O_APPEND | O_CLOEXEC, 0644);
+    written_ = 0;
+    if (fd_ >= 0) {
+      struct stat st;
+      if (fstat(fd_, &st) == 0) written_ = st.st_size;
+    }
+  }
+  void rotate() {
+    if (fd_ >= 0) close(fd_);
+    index_++;
+    int prune = index_ - max_files_;
+    if (prune >= 0) unlink(file(prune).c_str());
+    open_current();
+  }
+
+  std::string dir_, base_;
+  int max_files_;
+  long max_size_;
+  int index_ = 0;
+  int fd_ = -1;
+  long written_ = 0;
+};
+
+// ---------------------------------------------------------------- cgroups
+static std::string cgroup_path(const std::string &task) {
+  return "/sys/fs/cgroup/nomad_tpu_" + task + "_" + std::to_string(getpid());
+}
+
+static void write_file(const std::string &path, const std::string &value) {
+  int fd = open(path.c_str(), O_WRONLY);
+  if (fd < 0) return;
+  ssize_t unused = ::write(fd, value.data(), value.size());
+  (void)unused;
+  close(fd);
+}
+
+static void apply_cgroup(const JValue *cfg, const std::string &task, pid_t pid) {
+  if (!cfg || cfg->kind != JValue::Obj) return;
+  std::string path = cgroup_path(task);
+  if (mkdir(path.c_str(), 0755) != 0 && errno != EEXIST) return;
+  long mem_mb = cfg->get_int("memory_mb", 0);
+  if (mem_mb > 0)
+    write_file(path + "/memory.max", std::to_string(mem_mb * 1024 * 1024));
+  long cpu = cfg->get_int("cpu_shares", 0);
+  if (cpu > 0) {
+    if (cpu < 1) cpu = 1;
+    if (cpu > 10000) cpu = 10000;
+    write_file(path + "/cpu.weight", std::to_string(cpu));
+  }
+  write_file(path + "/cgroup.procs", std::to_string(pid));
+}
+
+static void cleanup_cgroup(const std::string &task) {
+  rmdir(cgroup_path(task).c_str());
+}
+
+// ------------------------------------------------------------------- main
+static pid_t g_child_pgid = 0;
+static void forward_signal(int signum) {
+  if (g_child_pgid > 0) kill(-g_child_pgid, signum);
+}
+
+static void write_atomic(const std::string &path, const std::string &content) {
+  std::string tmp = path + ".tmp";
+  int fd = open(tmp.c_str(), O_WRONLY | O_CREAT | O_TRUNC, 0644);
+  if (fd < 0) return;
+  ssize_t unused = ::write(fd, content.data(), content.size());
+  (void)unused;
+  close(fd);
+  rename(tmp.c_str(), path.c_str());
+}
+
+int main(int argc, char **argv) {
+  if (argc < 2) {
+    fprintf(stderr, "usage: nomad-executor <spec.json>\n");
+    return 2;
+  }
+  // Read the spec.
+  FILE *f = fopen(argv[1], "rb");
+  if (!f) { perror("executor: open spec"); return 2; }
+  std::string text;
+  char buf[65536];
+  size_t n;
+  while ((n = fread(buf, 1, sizeof buf, f)) > 0) text.append(buf, n);
+  fclose(f);
+  JValue spec = JParser(text).parse();
+
+  std::string task = spec.get_str("task_name", "task");
+  std::string base_dir = argv[1];
+  size_t slash = base_dir.rfind('/');
+  base_dir = (slash == std::string::npos) ? "." : base_dir.substr(0, slash);
+  std::string state_path = base_dir + "/" + task + ".executor_state.json";
+  std::string exit_path = base_dir + "/" + task + ".exit_status.json";
+
+  std::string log_dir = spec.get_str("log_dir", base_dir);
+  long max_files = spec.get_int("max_files", 10);
+  long max_size = spec.get_int("max_file_size_mb", 10) * 1024 * 1024;
+  Rotator out(log_dir, task + ".stdout", (int)max_files, max_size);
+  Rotator err(log_dir, task + ".stderr", (int)max_files, max_size);
+
+  int out_pipe[2], err_pipe[2];
+  if (pipe(out_pipe) != 0 || pipe(err_pipe) != 0) {
+    perror("executor: pipe");
+    return 2;
+  }
+
+  pid_t pid = fork();
+  if (pid < 0) { perror("executor: fork"); return 2; }
+  if (pid == 0) {
+    // Child: own session/pgid, optional chroot + setuid, exec the task.
+    setsid();
+    dup2(out_pipe[1], 1);
+    dup2(err_pipe[1], 2);
+    close(out_pipe[0]); close(out_pipe[1]);
+    close(err_pipe[0]); close(err_pipe[1]);
+
+    std::string root = spec.get_str("chroot");
+    if (!root.empty()) {
+      if (chroot(root.c_str()) != 0 || chdir("/") != 0) {
+        perror("executor: chroot");
+        _exit(125);
+      }
+    }
+    std::string user = spec.get_str("user");
+    if (!user.empty()) {
+      struct passwd *pw = getpwnam(user.c_str());
+      if (!pw || setgid(pw->pw_gid) != 0 || setuid(pw->pw_uid) != 0) {
+        fprintf(stderr, "executor: cannot become user %s\n", user.c_str());
+        _exit(125);
+      }
+    }
+    std::string cwd = spec.get_str("cwd");
+    if (!cwd.empty() && chdir(cwd.c_str()) != 0) {
+      perror("executor: chdir");
+      _exit(125);
+    }
+
+    // argv
+    std::vector<std::string> args_s{spec.get_str("command")};
+    const JValue *jargs = spec.get("args");
+    if (jargs && jargs->kind == JValue::Arr)
+      for (const auto &a : jargs->arr) args_s.push_back(a.str);
+    std::vector<char *> args_c;
+    for (auto &s : args_s) args_c.push_back(const_cast<char *>(s.c_str()));
+    args_c.push_back(nullptr);
+
+    // env
+    std::vector<std::string> env_s;
+    const JValue *jenv = spec.get("env");
+    if (jenv && jenv->kind == JValue::Obj)
+      for (const auto &kv : jenv->obj)
+        env_s.push_back(kv.first + "=" + kv.second.str);
+    std::vector<char *> env_c;
+    for (auto &s : env_s) env_c.push_back(const_cast<char *>(s.c_str()));
+    env_c.push_back(nullptr);
+
+    // execvpe: PATH-resolve bare command names exactly like the Python
+    // supervisor's subprocess.Popen does.
+    execvpe(args_c[0], args_c.data(),
+            (jenv && jenv->kind == JValue::Obj) ? env_c.data() : environ);
+    fprintf(stderr, "executor: exec %s: %s\n", args_c[0], strerror(errno));
+    _exit(127);
+  }
+
+  // Parent (the supervisor).
+  close(out_pipe[1]);
+  close(err_pipe[1]);
+  apply_cgroup(spec.get("cgroup"), task, pid);
+
+  g_child_pgid = pid;
+  struct sigaction sa;
+  memset(&sa, 0, sizeof sa);
+  sa.sa_handler = forward_signal;
+  sigaction(SIGTERM, &sa, nullptr);
+  sigaction(SIGINT, &sa, nullptr);
+
+  {
+    char state[256];
+    snprintf(state, sizeof state,
+             "{\"executor_pid\": %d, \"pid\": %d, \"pgid\": %d, "
+             "\"started_at\": %ld, \"native\": true}",
+             getpid(), pid, pid, (long)time(nullptr));
+    write_atomic(state_path, state);
+  }
+
+  // Pump both pipes until EOF — but report the CHILD's exit even while a
+  // grandchild keeps the pipes open (matching the Python supervisor, which
+  // reports on proc.wait() and gives the pumps a bounded grace period).
+  struct pollfd fds[2] = {{out_pipe[0], POLLIN, 0}, {err_pipe[0], POLLIN, 0}};
+  Rotator *rots[2] = {&out, &err};
+  int open_fds = 2;
+  char io[65536];
+  int status = 0;
+  bool reaped = false;
+  time_t drain_deadline = 0;
+  while (open_fds > 0) {
+    if (!reaped) {
+      pid_t r = waitpid(pid, &status, WNOHANG);
+      if (r == pid) {
+        reaped = true;
+        drain_deadline = time(nullptr) + 5;  // grace for buffered output
+      }
+    } else if (time(nullptr) >= drain_deadline) {
+      break;
+    }
+    int rc = poll(fds, 2, 200);
+    if (rc < 0) {
+      if (errno == EINTR) continue;
+      break;
+    }
+    if (rc == 0) continue;
+    for (int i = 0; i < 2; i++) {
+      if (fds[i].fd < 0) continue;
+      if (fds[i].revents & (POLLIN | POLLHUP)) {
+        ssize_t r = read(fds[i].fd, io, sizeof io);
+        if (r > 0) {
+          rots[i]->write(io, r);
+        } else if (r == 0 || (r < 0 && errno != EINTR)) {
+          close(fds[i].fd);
+          fds[i].fd = -1;
+          open_fds--;
+        }
+      } else if (fds[i].revents & (POLLERR | POLLNVAL)) {
+        close(fds[i].fd);
+        fds[i].fd = -1;
+        open_fds--;
+      }
+    }
+  }
+
+  if (!reaped)
+    while (waitpid(pid, &status, 0) < 0 && errno == EINTR) {}
+  int exit_code = 0, sig = 0;
+  if (WIFEXITED(status)) exit_code = WEXITSTATUS(status);
+  else if (WIFSIGNALED(status)) sig = WTERMSIG(status);
+
+  char result[192];
+  snprintf(result, sizeof result,
+           "{\"exit_code\": %d, \"signal\": %d, \"finished_at\": %ld}",
+           exit_code, sig, (long)time(nullptr));
+  write_atomic(exit_path, result);
+  cleanup_cgroup(task);
+  (void)json_escape;  // reserved for richer state payloads
+  return 0;
+}

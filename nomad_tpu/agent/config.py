@@ -24,13 +24,38 @@ from .agent import AgentConfig
 
 
 def load_config_file(path: str) -> AgentConfig:
+    """One file, or a DIRECTORY of .hcl/.json files merged in sorted order
+    (later files override; nested blocks merge key-wise) — the reference
+    accepts config directories the same way (command/agent/config.go
+    LoadConfigDir), and the shipped systemd unit points at /etc/nomad-tpu."""
+    import os
+
+    if os.path.isdir(path):
+        merged: dict = {}
+        for name in sorted(os.listdir(path)):
+            if not (name.endswith(".hcl") or name.endswith(".json")):
+                continue
+            _merge(merged, _parse_one(os.path.join(path, name)))
+        if not merged:
+            raise ValueError(f"no .hcl/.json config files in {path}")
+        return config_from_dict(merged)
+    return config_from_dict(_parse_one(path))
+
+
+def _parse_one(path: str) -> dict:
     with open(path) as f:
         text = f.read()
     if path.endswith(".json"):
-        data = json.loads(text)
-    else:
-        data = parse_hcl(text)
-    return config_from_dict(data)
+        return json.loads(text)
+    return parse_hcl(text)
+
+
+def _merge(base: dict, extra: dict) -> None:
+    for key, value in extra.items():
+        if isinstance(value, dict) and isinstance(base.get(key), dict):
+            _merge(base[key], value)
+        else:
+            base[key] = value
 
 
 def config_from_dict(data: dict) -> AgentConfig:

@@ -221,9 +221,26 @@ def build_executor_spec(ctx: "ExecContext", task: Task, command: str,
     return spec
 
 
+def native_executor_path() -> str:
+    """The compiled native supervisor, when present (native/executor.cc,
+    built by `make -C native`). Override with NOMAD_TPU_EXECUTOR=/path or
+    disable with NOMAD_TPU_EXECUTOR=python."""
+    override = os.environ.get("NOMAD_TPU_EXECUTOR", "")
+    if override == "python":
+        return ""
+    if override:
+        return override if os.access(override, os.X_OK) else ""
+    candidate = os.path.join(_repo_root(), "native", "bin", "nomad-executor")
+    return candidate if os.access(candidate, os.X_OK) else ""
+
+
 def launch_executor(state_dir: str, task_name: str, spec: Dict[str, Any]
                     ) -> ExecutorHandle:
-    """Write the spec and start the detached executor."""
+    """Write the spec and start the detached executor — the native C++
+    supervisor when built (the reference's executor is likewise a native
+    re-exec'd process, client/driver/executor/), the Python implementation
+    otherwise. Both speak the same spec/state/exit file contract, so
+    reattach works across either."""
     os.makedirs(state_dir, exist_ok=True)
     spec_path = os.path.join(state_dir, f"{task_name}.executor_spec.json")
     spec = dict(spec, task_name=task_name)
@@ -235,8 +252,13 @@ def launch_executor(state_dir: str, task_name: str, spec: Dict[str, Any]
             os.unlink(os.path.join(state_dir, f"{task_name}.{suffix}"))
         except FileNotFoundError:
             pass
+    native = native_executor_path()
+    if native:
+        cmd = [native, spec_path]
+    else:
+        cmd = [sys.executable, "-m", "nomad_tpu.client.executor", spec_path]
     proc = subprocess.Popen(
-        [sys.executable, "-m", "nomad_tpu.client.executor", spec_path],
+        cmd,
         start_new_session=True,
         stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
         env=dict(os.environ,

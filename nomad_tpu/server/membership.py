@@ -133,23 +133,30 @@ class ServerMembership:
         return n
 
     def retry_join(self, seeds: List[str], interval: float = 5.0,
-                   max_attempts: int = 60) -> None:
+                   max_attempts: int = 0) -> None:
         """Keep trying the seed list until one join lands (reference:
-        retry_join, command/agent/command.go retryJoin) — on a cold cluster
-        boot the seed server may simply not be listening yet. Runs on its
-        own daemon thread: joins block on TCP dials and on raft work, which
-        must not occupy the shared timer wheel's callback workers."""
+        retry_join, command/agent/command.go retryJoin — which retries
+        FOREVER by default; max_attempts=0 here does the same, a positive
+        value bounds it for tests). Runs on its own daemon thread: joins
+        block on TCP dials and on raft work, which must not occupy the
+        shared timer wheel's callback workers."""
         def loop() -> None:
-            for attempt in range(max_attempts):
-                if self._stop.is_set():
-                    return
+            attempt = 0
+            while not self._stop.is_set():
+                attempt += 1
                 try:
                     if self.join(seeds) > 0:
                         return
                 except Exception:
                     pass
-                LOG.info("%s: join %s failed; retrying in %.0fs",
-                         self.gossip_name, seeds, interval)
+                if max_attempts and attempt >= max_attempts:
+                    break
+                # Log the first few and then once a minute: a seed that is
+                # down for hours must not flood the log.
+                if attempt <= 3 or attempt % max(1, int(60 / interval)) == 0:
+                    LOG.info("%s: join %s failed (attempt %d); retrying "
+                             "every %.0fs", self.gossip_name, seeds, attempt,
+                             interval)
                 if self._stop.wait(interval):
                     return
             LOG.warning("%s: giving up joining %s", self.gossip_name, seeds)

@@ -22,3 +22,38 @@ import jax  # noqa: E402
 jax.config.update("jax_platforms", "cpu")
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+# Build the native executor once if the toolchain is present; tests fall
+# back to the Python supervisor when it isn't (same file contract).
+def _ensure_native_executor():
+    import shutil
+    import subprocess
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    binary = os.path.join(root, "native", "bin", "nomad-executor")
+    stamp = os.path.join(root, "native", "bin", ".build_failed")
+    source = os.path.join(root, "native", "executor.cc")
+    if os.path.exists(binary) or shutil.which("g++") is None:
+        return
+    # Don't re-pay a failed build on every pytest start: skip while the
+    # failure stamp is newer than the source.
+    try:
+        if os.path.getmtime(stamp) >= os.path.getmtime(source):
+            return
+    except OSError:
+        pass
+    try:
+        out = subprocess.run(["make", "-C", os.path.join(root, "native")],
+                             capture_output=True, text=True, timeout=120)
+        if out.returncode != 0:
+            os.makedirs(os.path.dirname(stamp), exist_ok=True)
+            with open(stamp, "w") as f:
+                f.write(out.stderr[-4000:])
+            print("WARNING: native executor build failed; driver tests use "
+                  f"the Python supervisor (see {stamp})")
+    except Exception:
+        pass
+
+
+_ensure_native_executor()

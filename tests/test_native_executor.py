@@ -1,0 +1,151 @@
+"""Native C++ executor (native/executor.cc): same spec/state/exit contract
+as the Python supervisor, exercised through the real driver path."""
+
+import json
+import os
+import signal
+import subprocess
+import time
+
+import pytest
+
+from nomad_tpu.client.driver.base import native_executor_path
+
+NATIVE = native_executor_path()
+
+pytestmark = pytest.mark.skipif(
+    not NATIVE, reason="native executor not built (make -C native)")
+
+
+def wait_for(fn, timeout=10.0, interval=0.05):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if fn():
+            return True
+        time.sleep(interval)
+    return False
+
+
+def launch(tmp_path, task="t1", **spec_extra):
+    spec = {
+        "task_name": task,
+        "command": "/bin/sh",
+        "args": ["-c", "echo out-line; echo err-line >&2; sleep 30"],
+        "env": {"FOO": "bar"},
+        "cwd": str(tmp_path),
+        "log_dir": str(tmp_path / "logs"),
+        "max_files": 2,
+        "max_file_size_mb": 1,
+    }
+    spec.update(spec_extra)
+    spec_path = tmp_path / f"{task}.executor_spec.json"
+    spec_path.write_text(json.dumps(spec))
+    proc = subprocess.Popen([NATIVE, str(spec_path)],
+                            start_new_session=True)
+    return proc, tmp_path / f"{task}.executor_state.json", \
+        tmp_path / f"{task}.exit_status.json"
+
+
+class TestNativeExecutor:
+    def test_runs_logs_and_reports_exit(self, tmp_path):
+        proc, state_path, exit_path = launch(
+            tmp_path, args=["-c", "echo out-line; echo err-line >&2; exit 3"])
+        assert wait_for(state_path.exists)
+        state = json.loads(state_path.read_text())
+        assert state["pid"] == state["pgid"]
+        assert state["native"] is True
+        assert wait_for(exit_path.exists)
+        result = json.loads(exit_path.read_text())
+        assert result["exit_code"] == 3 and result["signal"] == 0
+        out = (tmp_path / "logs" / "t1.stdout.0").read_text()
+        err = (tmp_path / "logs" / "t1.stderr.0").read_text()
+        assert out == "out-line\n" and err == "err-line\n"
+        proc.wait(timeout=5)
+
+    def test_env_reaches_task(self, tmp_path):
+        proc, state_path, exit_path = launch(
+            tmp_path, args=["-c", "echo val=$FOO"])
+        assert wait_for(exit_path.exists)
+        assert "val=bar" in (tmp_path / "logs" / "t1.stdout.0").read_text()
+        proc.wait(timeout=5)
+
+    def test_sigterm_forwards_to_group(self, tmp_path):
+        proc, state_path, exit_path = launch(tmp_path)
+        assert wait_for(state_path.exists)
+        pgid = json.loads(state_path.read_text())["pgid"]
+        os.killpg(pgid, signal.SIGTERM)
+        assert wait_for(exit_path.exists)
+        result = json.loads(exit_path.read_text())
+        assert result["signal"] == signal.SIGTERM
+        proc.wait(timeout=5)
+
+    def test_log_rotation(self, tmp_path):
+        # ~3MB of output with 1MB files, keep 2.
+        proc, state_path, exit_path = launch(
+            tmp_path,
+            args=["-c", "yes 0123456789012345678901234567890123456789 "
+                        "| head -c 3000000"])
+        assert wait_for(exit_path.exists, timeout=20)
+        logs = sorted(p.name for p in (tmp_path / "logs").iterdir()
+                      if p.name.startswith("t1.stdout"))
+        assert len(logs) <= 2
+        assert "t1.stdout.2" in logs  # rotated twice, oldest pruned
+        proc.wait(timeout=5)
+
+    def test_exec_failure_reports(self, tmp_path):
+        proc, state_path, exit_path = launch(
+            tmp_path, command="/does/not/exist", args=[])
+        assert wait_for(exit_path.exists)
+        assert json.loads(exit_path.read_text())["exit_code"] == 127
+        proc.wait(timeout=5)
+
+
+class TestNativeThroughDriver:
+    def test_raw_exec_uses_native_and_reattaches(self, tmp_path, monkeypatch):
+        """The full driver path on the native supervisor: start, read logs,
+        reattach via handle id, kill via the handle."""
+        from nomad_tpu import mock
+        from nomad_tpu.client.allocdir import AllocDir
+        from nomad_tpu.client.driver import new_driver
+        from nomad_tpu.client.driver.base import DriverContext, ExecContext
+        from nomad_tpu.client.env import TaskEnv
+
+        class Cfg:
+            state_dir = str(tmp_path / "state")
+            alloc_dir = str(tmp_path / "alloc")
+            options = {"driver.raw_exec.enable": "1"}
+
+            def read_option(self, k, d=""):
+                return self.options.get(k, d)
+
+        alloc = mock.alloc()
+        task = alloc.Job.TaskGroups[0].Tasks[0]
+        task.Driver = "raw_exec"
+        task.Config = {"command": "/bin/sleep", "args": ["30"]}
+        adir = AllocDir(str(tmp_path / "alloc" / alloc.ID))
+        adir.build([task.Name])
+        env = TaskEnv(task=task, alloc=alloc)
+        ctx = ExecContext(alloc_dir=adir, alloc_id=alloc.ID, task_env=env)
+        driver = new_driver("raw_exec", DriverContext(task_name=task.Name,
+                                                      config=Cfg()))
+        handle = driver.start(ctx, task)
+        try:
+            # The state file records the native supervisor.
+            import glob
+
+            state_files = glob.glob(
+                str(tmp_path / "**" / "*.executor_state.json"),
+                recursive=True)
+            assert state_files
+            assert json.loads(open(state_files[0]).read()).get("native")
+
+            # Reattach by handle id.
+            handle2 = driver.open(ctx, handle.id())
+            assert handle2.wait(timeout=0.3) is None  # still running
+
+            # Stats flow through the pid tree.
+            assert wait_for(lambda: handle.stats() is not None)
+        finally:
+            handle.kill(kill_timeout=2.0)
+        result = handle.wait(timeout=10)
+        assert result is not None and result.signal in (0, signal.SIGTERM)

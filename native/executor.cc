@@ -363,6 +363,14 @@ int main(int argc, char **argv) {
     close(out_pipe[0]); close(out_pipe[1]);
     close(err_pipe[0]); close(err_pipe[1]);
 
+    // Match the Python supervisor's ordering (CPython's child does
+    // chdir(cwd) before preexec_fn): host-path cwd first, then chroot,
+    // leaving a chrooted task at "/".
+    std::string cwd = spec.get_str("cwd");
+    if (!cwd.empty() && chdir(cwd.c_str()) != 0) {
+      perror("executor: chdir");
+      _exit(125);
+    }
     std::string root = spec.get_str("chroot");
     if (!root.empty()) {
       if (chroot(root.c_str()) != 0 || chdir("/") != 0) {
@@ -378,12 +386,6 @@ int main(int argc, char **argv) {
         _exit(125);
       }
     }
-    std::string cwd = spec.get_str("cwd");
-    if (!cwd.empty() && chdir(cwd.c_str()) != 0) {
-      perror("executor: chdir");
-      _exit(125);
-    }
-
     // argv
     std::vector<std::string> args_s{spec.get_str("command")};
     const JValue *jargs = spec.get("args");

@@ -54,6 +54,8 @@ class AgentConfig:
     # Telemetry (reference: command/agent/config.go Telemetry block)
     statsd_addr: str = ""
     telemetry_interval: float = 10.0
+    # Route agent logs to syslog too (reference: enable_syslog)
+    enable_syslog: bool = False
 
     @staticmethod
     def dev() -> "AgentConfig":

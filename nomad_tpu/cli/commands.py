@@ -131,6 +131,17 @@ def main(argv) -> int:
     p.add_argument("name", nargs="?",
                    help="show instances of one service")
 
+    p = sub.add_parser("monitor",
+                       help="follow an evaluation to completion")
+    _add_meta(p)
+    p.add_argument("eval_id")
+
+    p = sub.add_parser("client-config",
+                       help="show the client agent's server list")
+    _add_meta(p)
+    p.add_argument("-servers", action="store_true",
+                   help="print the known server addresses")
+
     args = parser.parse_args(argv)
     if args.command is None:
         parser.print_help()
@@ -149,10 +160,21 @@ def main(argv) -> int:
 
 def cmd_agent(args) -> int:
     import logging
+    import logging.handlers
 
-    logging.basicConfig(
-        level=logging.INFO,
-        format="%(asctime)s [%(levelname)s] %(name)s: %(message)s")
+    # Gated boot logging (reference: gated-writer + command.go:241-281):
+    # records buffer in memory until the agent is up, then flush — a failed
+    # boot dumps everything, a clean boot prints in one block after the
+    # startup banner.
+    root = logging.getLogger()
+    root.setLevel(logging.INFO)
+    stream = logging.StreamHandler()
+    stream.setFormatter(logging.Formatter(
+        "%(asctime)s [%(levelname)s] %(name)s: %(message)s"))
+    gate = logging.handlers.MemoryHandler(capacity=10000,
+                                          flushLevel=logging.CRITICAL,
+                                          target=stream)
+    root.addHandler(gate)
     from nomad_tpu.agent import Agent, AgentConfig
 
     if args.config:
@@ -188,12 +210,56 @@ def cmd_agent(args) -> int:
         config.servers = [s.strip() for s in args.servers.split(",") if s]
 
     agent = Agent(config)
-    agent.start()
+    try:
+        agent.start()
+    finally:
+        # Always release the gate — a FAILED boot must dump its buffered
+        # logs with the traceback, not swallow them.
+        gate.flush()
+        root.removeHandler(gate)
+        root.addHandler(stream)
     mode = ("dev" if args.dev else
             "+".join(m for m, on in (("server", config.server_enabled),
                                      ("client", config.client_enabled)) if on))
     print(f"==> nomad-tpu agent started ({mode}) on "
           f"http://{config.bind_addr}:{agent.http.port}")
+    if getattr(config, "enable_syslog", False):
+        try:
+            syslog = logging.handlers.SysLogHandler(address="/dev/log")
+            syslog.setFormatter(logging.Formatter(
+                "nomad-tpu[%(process)d]: %(name)s: %(message)s"))
+            root.addHandler(syslog)
+        except OSError:
+            logging.getLogger("nomad.agent").warning(
+                "syslog requested but /dev/log unavailable")
+
+    # SIGHUP: re-read the config file and apply what is reloadable at
+    # runtime (telemetry sinks) — reference: command.go handleReload.
+    def reload(signum, frame):
+        log = logging.getLogger("nomad.agent")
+        if not args.config:
+            log.info("SIGHUP received; no config file to reload")
+            return
+        try:
+            from nomad_tpu.agent.config import load_config_file
+
+            fresh = load_config_file(args.config)
+        except Exception:
+            log.exception("SIGHUP reload failed; keeping current config")
+            return
+        from nomad_tpu.telemetry import metrics
+
+        metrics.configure(statsd_addr=fresh.statsd_addr,
+                          collection_interval=fresh.telemetry_interval,
+                          host_label=fresh.node_name or config.node_name)
+        config.statsd_addr = fresh.statsd_addr
+        config.telemetry_interval = fresh.telemetry_interval
+        log.info("SIGHUP: config reloaded (telemetry applied; topology "
+                 "changes need a restart)")
+
+    import signal as _signal
+
+    _signal.signal(_signal.SIGHUP, reload)
     try:
         while True:
             time.sleep(1)
@@ -596,6 +662,26 @@ def cmd_system_gc(args) -> int:
     client = _client(args)
     client.system.garbage_collect()
     print("System GC triggered")
+    return 0
+
+
+def cmd_monitor(args) -> int:
+    """Standalone eval monitor (reference: command/monitor.go — the same
+    follower `run` uses after submit)."""
+    client = _client(args)
+    return _monitor_eval(client, args.eval_id)
+
+
+def cmd_client_config(args) -> int:
+    """(reference: command/client_config.go: -servers prints the client's
+    server list; without the flag, the agent's client configuration)"""
+    client = _client(args)
+    if args.servers:
+        for s in client.agent.servers():
+            print(s)
+        return 0
+    info = client.agent.self()
+    print(json.dumps(info.get("config", info), indent=2))
     return 0
 
 

@@ -229,7 +229,11 @@ def native_executor_path() -> str:
     if override == "python":
         return ""
     if override:
-        return override if os.access(override, os.X_OK) else ""
+        if not os.access(override, os.X_OK):
+            # An explicit override must never silently degrade.
+            raise RuntimeError(
+                f"NOMAD_TPU_EXECUTOR={override!r} is not an executable file")
+        return override
     candidate = os.path.join(_repo_root(), "native", "bin", "nomad-executor")
     return candidate if os.access(candidate, os.X_OK) else ""
 

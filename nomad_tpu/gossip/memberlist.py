@@ -146,7 +146,6 @@ class Memberlist:
 
         self._shutdown = threading.Event()
         self._left = False
-        self._name_conflicts = 0
         self._threads: List[threading.Thread] = []
 
     # ------------------------------------------------------------ lifecycle
@@ -439,19 +438,17 @@ class Memberlist:
                 # news (e.g. a stale address) by out-incarnating it.
                 me = self._members[self.name]
                 if (addr, port) != (me.addr, me.port) \
-                        and inc >= me.incarnation:
-                    # A fresh conflicting claim. Once is normal after OUR
-                    # restart (peers echo our old record until the first
-                    # refutation lands); a live imposter keeps re-asserting
-                    # itself past the refutation, so warn from the second
-                    # fresh claim on.
-                    self._name_conflicts += 1
-                    if self._name_conflicts >= 2:
-                        LOG.warning(
-                            "%s: ANOTHER member is gossiping under our name "
-                            "from %s:%s — member names must be unique per "
-                            "region (set a distinct `name` in each agent "
-                            "config)", self.name, addr, port)
+                        and inc > me.incarnation and me.incarnation > 0:
+                    # Post-restart echoes of our stale record arrive while
+                    # our incarnation is still 0 and are refuted silently;
+                    # only a claim that OUT-INCARNATES a refutation we
+                    # already issued means a live node is fighting us for
+                    # the name.
+                    LOG.warning(
+                        "%s: ANOTHER member is gossiping under our name "
+                        "from %s:%s — member names must be unique per "
+                        "region (set a distinct `name` in each agent "
+                        "config)", self.name, addr, port)
                 if inc > me.incarnation and not self._left:
                     self._incarnation = inc + 1
                     me.incarnation = self._incarnation

@@ -70,10 +70,13 @@ class TestNativeExecutor:
         proc.wait(timeout=5)
 
     def test_sigterm_forwards_to_group(self, tmp_path):
+        """Signal the SUPERVISOR: it must forward to the task's process
+        group (the kill protocol the task runner uses)."""
         proc, state_path, exit_path = launch(tmp_path)
         assert wait_for(state_path.exists)
-        pgid = json.loads(state_path.read_text())["pgid"]
-        os.killpg(pgid, signal.SIGTERM)
+        state = json.loads(state_path.read_text())
+        assert state["executor_pid"] == proc.pid
+        os.kill(proc.pid, signal.SIGTERM)  # executor, not the task
         assert wait_for(exit_path.exists)
         result = json.loads(exit_path.read_text())
         assert result["signal"] == signal.SIGTERM

@@ -131,7 +131,10 @@ def bench_server_e2e(nodes, n_evals):
         placed = sum(
             1 for eid in eval_ids
             for a in srv.state.allocs_by_eval(eid))
-        stats = dict(srv.workers[0].stats)
+        stats: dict = {}
+        for w in srv.workers:
+            for k, v in w.stats.items():
+                stats[k] = stats.get(k, 0) + v
         return n_evals / elapsed, placed, stats
     finally:
         srv.shutdown()

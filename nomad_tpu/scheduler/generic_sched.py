@@ -136,15 +136,21 @@ def build_placement_allocs(eval: Evaluation, job: Job, ctx: EvalContext,
                            failed_tg_allocs: Dict[str, AllocMetric]) -> None:
     """Turn stack selections into plan allocations; coalesce failures per TG
     (reference per-alloc loop: generic_sched.go:392-443)."""
+    # Scoring finished before this runs, so the eval's metrics are final:
+    # one immutable snapshot shared by every placed alloc (a copy per alloc
+    # would walk the accumulated per-node Scores map P times — O(P^2)).
+    shared_metric = None
     for tup, option in zip(place, options):
         if option is not None:
+            if shared_metric is None:
+                shared_metric = ctx.metrics.copy()
             alloc = Allocation(
                 ID=generate_uuid(),
                 EvalID=eval.ID,
                 Name=tup.Name,
                 JobID=job.ID,
                 TaskGroup=tup.TaskGroup.Name,
-                Metrics=ctx.metrics.copy(),
+                Metrics=shared_metric,
                 NodeID=option.node.ID,
                 TaskResources=option.task_resources,
                 DesiredStatus=AllocDesiredStatusRun,

@@ -11,7 +11,7 @@ from __future__ import annotations
 import copy
 import re
 import time as _time
-import uuid as _uuid
+import os
 from dataclasses import dataclass, field, replace
 from typing import Any, Dict, List, Optional
 
@@ -119,8 +119,13 @@ EvalIdNotBlocked = ""
 
 
 def generate_uuid() -> str:
-    """Random UUID for IDs (reference: structs.go GenerateUUID)."""
-    return str(_uuid.uuid4())
+    """Random UUID for IDs (reference: structs.go GenerateUUID, which
+    likewise formats crypto/rand bytes directly). Skips uuid.UUID object
+    construction — IDs are minted per placement on the scheduling path."""
+    h = os.urandom(16).hex()
+    # RFC 4122 v4 shape (version/variant nibbles fixed).
+    return (f"{h[:8]}-{h[8:12]}-4{h[13:16]}-"
+            f"{'89ab'[int(h[16], 16) & 3]}{h[17:20]}-{h[20:]}")
 
 
 class ValidationError(Exception):

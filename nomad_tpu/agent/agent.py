@@ -56,6 +56,8 @@ class AgentConfig:
     telemetry_interval: float = 10.0
     # Route agent logs to syslog too (reference: enable_syslog)
     enable_syslog: bool = False
+    # Expose /v1/agent/debug/* (reference: enable_debug gating pprof)
+    enable_debug: bool = False
 
     @staticmethod
     def dev() -> "AgentConfig":
@@ -63,6 +65,7 @@ class AgentConfig:
             server_enabled=True,
             client_enabled=True,
             dev_mode=True,
+            enable_debug=True,
             options={"driver.raw_exec.enable": "true"},
         )
 

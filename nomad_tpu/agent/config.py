@@ -65,6 +65,7 @@ def config_from_dict(data: dict) -> AgentConfig:
     cfg.node_name = data.get("name", cfg.node_name)
     cfg.data_dir = data.get("data_dir", cfg.data_dir)
     cfg.enable_syslog = bool(data.get("enable_syslog", cfg.enable_syslog))
+    cfg.enable_debug = bool(data.get("enable_debug", cfg.enable_debug))
     cfg.bind_addr = data.get("bind_addr", cfg.bind_addr)
     ports = data.get("ports") or {}
     cfg.http_port = int(ports.get("http", cfg.http_port))

@@ -12,7 +12,9 @@ from __future__ import annotations
 import json
 import logging
 import re
+import sys
 import threading
+import traceback
 import time
 import urllib.parse
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -510,6 +512,23 @@ def route(agent, method: str, path: str, query, get_body):
         return out, None
     if path == "/v1/agent/members":
         return agent.members(), None
+    if path == "/v1/agent/debug/stacks":
+        # The runtime-profiling hook, gated exactly like the reference's
+        # pprof routes (command/agent/http.go registers them only when
+        # debug is enabled): stack traces leak code structure, so the
+        # agent must opt in.
+        if not getattr(agent.config, "enable_debug", False):
+            raise CodedError(404, "debug endpoints disabled "
+                                  "(set enable_debug)")
+        frames = sys._current_frames()
+        stacks = {}
+        for t in threading.enumerate():
+            frame = frames.get(t.ident)
+            if frame is None:
+                continue
+            stacks[f"{t.name} ({t.ident})"] = traceback.format_stack(frame)
+        return stacks, None
+
     if path == "/v1/agent/metrics":
         # In-memory telemetry snapshot (reference shape: go-metrics
         # DisplayMetrics behind the agent metrics endpoint).

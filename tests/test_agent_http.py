@@ -207,3 +207,12 @@ job "multi" {
         assert [g.Name for g in job.TaskGroups] == ["a", "b"]
         assert [t.Name for t in job.TaskGroups[0].Tasks] == ["t1", "t2"]
         assert job.TaskGroups[0].Count == 2
+
+
+def test_debug_stacks(dev_agent):
+    """Thread-stack dump endpoint (the pprof-analogue debug hook; enabled
+    in dev mode, gated behind enable_debug otherwise)."""
+    agent, api = dev_agent
+    stacks, _ = api.get("/v1/agent/debug/stacks")
+    assert any("MainThread" in k for k in stacks)
+    assert all(isinstance(v, list) for v in stacks.values())

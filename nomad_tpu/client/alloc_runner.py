@@ -106,6 +106,39 @@ class AllocRunner:
             self.alloc = alloc
         if alloc.terminal_status():
             self.destroy_tasks()
+            return
+        self._apply_inplace_update(alloc)
+
+    def _apply_inplace_update(self, alloc: Allocation) -> None:
+        """In-place updates change non-destructive task fields (services,
+        tags, checks) without restarting the task: refresh each runner's
+        task definition and re-sync its registrations (reference: the
+        consul syncer re-diffs on alloc updates)."""
+        tg = (alloc.Job.lookup_task_group(alloc.TaskGroup)
+              if alloc.Job is not None else None)
+        if tg is None:
+            return
+        by_name = {t.Name: t for t in tg.Tasks}
+        with self._lock:
+            runners = dict(self.task_runners)
+            states = {name: ts.State for name, ts in self.task_states.items()}
+        for name, runner in runners.items():
+            new_task = by_name.get(name)
+            if new_task is None:
+                continue
+            new_task = new_task.copy()
+            assigned = alloc.TaskResources.get(name)
+            if assigned is not None:
+                new_task.Resources = assigned
+            runner.task = new_task
+            if (self.service_manager is not None
+                    and states.get(name) == TaskStateRunning):
+                try:
+                    self.service_manager.register_task(alloc, new_task)
+                except Exception:
+                    logger.exception(
+                        "alloc %s: service re-sync for %s failed",
+                        alloc.ID, name)
 
     def destroy_tasks(self) -> None:
         with self._lock:

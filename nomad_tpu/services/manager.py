@@ -39,6 +39,12 @@ from .checks import run_check
 logger = logging.getLogger("nomad.services")
 
 SYNC_INTERVAL = 0.5  # debounced push cadence (reference syncs each 5s +jitter)
+# Anti-entropy: periodically re-push EVERYTHING, dirty or not. Heals
+# server-side drift the client can't observe — e.g. the registry marking a
+# down node's services critical; when the node recovers, the next full sync
+# restores true statuses (reference: the syncer's periodic full
+# reconciliation, syncer.go:772-836).
+FULL_SYNC_INTERVAL = 30.0
 
 
 class _Check:
@@ -67,6 +73,22 @@ class _Instance:
         self.env = env
 
 
+def _same_registration(prev: _Instance, reg: ServiceRegistration,
+                       svc) -> bool:
+    """True when the new definition matches the live instance: tags,
+    address/port, and every check SPEC (not check state). Unchanged
+    definitions keep their check state, counters, and timers."""
+    p = prev.reg
+    if (p.Tags, p.Address, p.Port) != (reg.Tags, reg.Address, reg.Port):
+        return False
+    spec = [(c.Name, c.Type, c.Command, tuple(c.Args), c.Path, c.Protocol,
+             c.Interval, c.Timeout) for c in svc.Checks]
+    have = [(c.spec.Name, c.spec.Type, c.spec.Command, tuple(c.spec.Args),
+             c.spec.Path, c.spec.Protocol, c.spec.Interval, c.spec.Timeout)
+            for c in prev.checks]
+    return spec == have
+
+
 class ServiceManager:
     def __init__(self, node,
                  sync_fn: Callable[[List[ServiceRegistration], List[str]],
@@ -93,10 +115,18 @@ class ServiceManager:
     def register_task(self, alloc: Allocation, task: Task,
                       cwd: Optional[str] = None,
                       env: Optional[dict] = None) -> None:
-        """Register the task's services (idempotent; called on task start)."""
-        if not task.Services:
-            return
+        """Register the task's services — idempotent, and RECONCILING: a
+        service dropped from the task definition (in-place update) is
+        deregistered (reference: the Consul syncer diffs desired vs
+        registered, syncer.go:574-674)."""
         with self._lock:
+            wanted = {f"_nomad-task-{alloc.ID}-{task.Name}-{svc.Name}"
+                      for svc in task.Services}
+            for rid, inst in list(self._instances.items()):
+                if (inst.alloc_id == alloc.ID
+                        and inst.task_name == task.Name
+                        and rid not in wanted):
+                    self._drop(rid)
             for svc in task.Services:
                 address, port = self._resolve(task, svc.PortLabel)
                 reg = ServiceRegistration(
@@ -104,10 +134,26 @@ class ServiceManager:
                     ServiceName=svc.Name, Tags=list(svc.Tags),
                     JobID=alloc.JobID, AllocID=alloc.ID, TaskName=task.Name,
                     NodeID=self.node.ID, Address=address, Port=port)
+                prev = self._instances.get(reg.ID)
+                inst_cwd, inst_env = cwd, env
+                if prev is not None:
+                    if _same_registration(prev, reg, svc):
+                        continue  # unchanged: keep check state and timers
+                    # Definition changed (in-place update): keep the script
+                    # check context unless the caller re-supplied it, and
+                    # retire the old instance's check timers. Locals only —
+                    # one service's preserved context must not leak into
+                    # its siblings.
+                    if inst_cwd is None:
+                        inst_cwd = prev.cwd
+                    if inst_env is None:
+                        inst_env = prev.env
+                    self._drop(reg.ID)
                 checks = [_Check(c) for c in svc.Checks]
                 reg.Checks = [c.state for c in checks]
                 reg.Status = reg.derive_status()
-                inst = _Instance(reg, checks, alloc.ID, task.Name, cwd, env)
+                inst = _Instance(reg, checks, alloc.ID, task.Name,
+                                 inst_cwd, inst_env)
                 self._instances[reg.ID] = inst
                 self._deletes.discard(reg.ID)
                 self._dirty.add(reg.ID)
@@ -205,7 +251,12 @@ class ServiceManager:
 
     # ------------------------------------------------------------------- sync
     def _sync_loop(self) -> None:
+        last_full = time.monotonic()
         while not self._stop.wait(SYNC_INTERVAL):
+            if time.monotonic() - last_full >= FULL_SYNC_INTERVAL:
+                last_full = time.monotonic()
+                with self._lock:
+                    self._dirty.update(self._instances)
             self._flush()
 
     def _flush(self) -> None:

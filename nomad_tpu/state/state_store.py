@@ -35,6 +35,7 @@ from nomad_tpu.structs import (
 from nomad_tpu.structs.structs import (
     AllocClientStatusFailed,
     AllocClientStatusRunning,
+    CheckStatusCritical,
     EvalStatusBlocked,
     JobStatusDead,
     JobStatusPending,
@@ -334,7 +335,28 @@ class StateStore(_ReadAPI):
             node.Status = status
             node.ModifyIndex = index
             self._tables["nodes"].write(index, node_id, node)
-            self._commit(index, ["nodes"], Items([Item(node=node_id)]))
+            watch_items = Items([Item(node=node_id)])
+            tables = ["nodes"]
+            # A down node can't run its checks: its service instances must
+            # stop being served as healthy (the reference gets this from
+            # Consul's serfHealth check; the replicated registry marks them
+            # critical explicitly). When the node recovers, its service
+            # manager's periodic full sync restores the true statuses
+            # (services/manager.py FULL_SYNC_INTERVAL).
+            if status == NodeStatusDown:
+                for reg in self._members("service_node", node_id, "services"):
+                    if reg.Status == CheckStatusCritical:
+                        continue
+                    down = reg.copy()
+                    down.Status = CheckStatusCritical
+                    for check in down.Checks:
+                        check.Status = CheckStatusCritical
+                        check.Output = "node down"
+                    down.ModifyIndex = index
+                    self._tables["services"].write(index, down.ID, down)
+                    watch_items.add(Item(service_name=down.ServiceName))
+                    tables.append("services")
+            self._commit(index, tables, watch_items)
             self._emit([("node", existing, node)])
 
     def update_node_drain(self, index: int, node_id: str, drain: bool) -> None:

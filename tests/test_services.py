@@ -63,6 +63,24 @@ class TestRegistryState:
         # idempotent double-delete
         fsm.apply(12, MessageType.ServiceSync, {"Deletes": ["r1"]})
 
+    def test_node_down_marks_services_critical(self):
+        """A down node's instances must stop being served as healthy (the
+        reference relies on Consul's serfHealth for this)."""
+        from nomad_tpu.structs.structs import NodeStatusDown
+
+        fsm = FSM()
+        node = mock.node()
+        fsm.apply(5, MessageType.NodeRegister, {"Node": node})
+        fsm.apply(6, MessageType.ServiceSync, {"Upserts": [reg(
+            node=node.ID, Status=CheckStatusPassing,
+            Checks=[CheckState(Name="c", Status=CheckStatusPassing)])]})
+        fsm.apply(7, MessageType.NodeUpdateStatus,
+                  {"NodeID": node.ID, "Status": NodeStatusDown})
+        got = fsm.state.services_by_name("web")[0]
+        assert got.Status == CheckStatusCritical
+        assert got.Checks[0].Output == "node down"
+        assert got.ModifyIndex == 7  # blocking watchers see the transition
+
     def test_node_delete_cascades_services(self):
         fsm = FSM()
         node = mock.node()
@@ -177,6 +195,34 @@ class TestServiceManager:
 
         mgr.deregister_task(alloc.ID, task.Name)
         assert wait_for(lambda: any(de for _, de in synced))
+        mgr.shutdown()
+
+    def test_reregistration_reconciles(self):
+        """An in-place update re-registers with the new definition and
+        deregisters services dropped from the task (reference: the syncer's
+        desired-vs-registered diff)."""
+        synced = []
+        mgr = ServiceManager(_node(), lambda up, de: synced.append((up, de)))
+        alloc = mock.alloc()
+        task = alloc.Job.TaskGroups[0].Tasks[0]
+        task.Services = [Service(Name="web", PortLabel=""),
+                         Service(Name="old", PortLabel="")]
+        mgr.register_task(alloc, task)
+
+        updated = task.copy()
+        updated.Services = [Service(Name="web", PortLabel="",
+                                    Tags=["v2"])]
+        mgr.register_task(alloc, updated)
+
+        def flat():
+            ups = {r.ID: r for up, _ in synced for r in up}
+            des = {d for _, de in synced for d in de}
+            return ups, des
+        assert wait_for(lambda: any("old" in d for d in flat()[1]))
+        ups, des = flat()
+        web_id = f"_nomad-task-{alloc.ID}-{task.Name}-web"
+        assert ups[web_id].Tags == ["v2"] or wait_for(
+            lambda: flat()[0][web_id].Tags == ["v2"])
         mgr.shutdown()
 
     def test_check_failure_triggers_restart(self, http_target):

@@ -87,6 +87,7 @@ class _FastEval:
     failed_tg_allocs: Dict[str, AllocMetric] = field(default_factory=dict)
     pending: object = None        # PendingPlan once enqueued
     fallback: bool = False
+    stale: bool = False           # redelivered mid-window: abandoned
 
 
 class PipelinedWorker(Worker):
@@ -139,6 +140,26 @@ class PipelinedWorker(Worker):
 
     # ------------------------------------------------------------ the window
     def _process_window(self, batch: List[Tuple[Evaluation, str]]) -> None:
+        # The window is in hand: push every eval's nack deadline out NOW.
+        # Filling + dispatching + draining a cold window (first compiles)
+        # can exceed the redelivery timeout (reference: worker.go heartbeats
+        # the broker via OutstandingReset during long scheduling). An eval
+        # already redelivered belongs to another worker — drop it here
+        # rather than paying a device dispatch that the token check will
+        # reject anyway.
+        from .eval_broker import NotOutstandingError, TokenMismatchError
+
+        live: List[Tuple[Evaluation, str]] = []
+        for ev, token in batch:
+            try:
+                self.eval_broker.outstanding_reset(ev.ID, token)
+                live.append((ev, token))
+            except (NotOutstandingError, TokenMismatchError) as e:
+                logger.debug("window drop: eval %s redelivered (%s)",
+                             ev.ID, e)
+        batch = live
+        if not batch:
+            return
         self._wait_for_index(max(ev.ModifyIndex for ev, _ in batch))
         snap = self.raft.fsm.state.snapshot()
 
@@ -277,6 +298,12 @@ class PipelinedWorker(Worker):
                 self.eval_broker.outstanding_reset(rec.ev.ID, rec.token)
                 if not rec.plan.is_no_op():
                     rec.pending = self.plan_queue.enqueue(rec.plan)
+            except (NotOutstandingError, TokenMismatchError) as e:
+                # Redelivered mid-window: another worker owns this eval
+                # now — abandon it entirely (no fallback re-run, no ack).
+                logger.debug("eval %s redelivered mid-window (%s)",
+                             rec.ev.ID, e)
+                rec.stale = True
             except Exception:
                 logger.exception("plan enqueue failed for eval %s", rec.ev.ID)
                 rec.fallback = True
@@ -285,7 +312,7 @@ class PipelinedWorker(Worker):
         eval_updates: List[Evaluation] = []
         done: List[_FastEval] = []
         for rec in fast:
-            if rec.fallback:
+            if rec.fallback or rec.stale:
                 continue
             if rec.pending is not None:
                 try:
@@ -313,6 +340,8 @@ class PipelinedWorker(Worker):
             if rec.fallback:
                 self.stats["fallback"] += 1
                 self._process_slow(rec.ev, rec.token)
+            elif rec.stale:
+                self.stats["stale"] = self.stats.get("stale", 0) + 1
 
     def _status_evals(self, rec: _FastEval) -> List[Evaluation]:
         """Terminal status (+ blocked follow-up) for one fast eval, matching

@@ -32,7 +32,7 @@ from nomad_tpu.structs.structs import EvalStatusBlocked
 from nomad_tpu.tensor import TensorIndex
 
 from .blocked_evals import BlockedEvals
-from .eval_broker import EvalBroker
+from .eval_broker import EvalBroker, NotOutstandingError, TokenMismatchError
 from .fsm import DevRaft, MessageType
 from .plan_queue import PlanQueue
 
@@ -126,19 +126,38 @@ class RemoteBackend:
         return (from_dict(Evaluation, ev) if ev else None), \
             resp.get("Token", "")
 
+    @staticmethod
+    def _retype(exc) -> None:
+        """Surface broker races as their typed exceptions: over the wire
+        they arrive as RPCError with the class name in remote_type, and
+        callers distinguish normal redelivery races from real failures."""
+        remote = getattr(exc, "remote_type", "")
+        if remote == "NotOutstandingError":
+            raise NotOutstandingError(str(exc)) from exc
+        if remote == "TokenMismatchError":
+            raise TokenMismatchError(str(exc)) from exc
+
     def ack(self, eval_id: str, token: str) -> None:
         leader = self._leader()
         if leader is None:
             raise RuntimeError("no leader for eval ack")
-        self.pool.call(leader, "Eval.Ack",
-                       {"EvalID": eval_id, "Token": token})
+        try:
+            self.pool.call(leader, "Eval.Ack",
+                           {"EvalID": eval_id, "Token": token})
+        except Exception as exc:
+            self._retype(exc)
+            raise
 
     def nack(self, eval_id: str, token: str) -> None:
         leader = self._leader()
         if leader is None:
             raise RuntimeError("no leader for eval nack")
-        self.pool.call(leader, "Eval.Nack",
-                       {"EvalID": eval_id, "Token": token})
+        try:
+            self.pool.call(leader, "Eval.Nack",
+                           {"EvalID": eval_id, "Token": token})
+        except Exception as exc:
+            self._retype(exc)
+            raise
 
     def submit_plan(self, plan: Plan) -> Optional[PlanResult]:
         leader = self._leader()
@@ -290,12 +309,18 @@ class Worker:
     def _send_ack(self, eval_id: str, token: str) -> None:
         try:
             self.backend.ack(eval_id, token)
+        except (NotOutstandingError, TokenMismatchError) as e:
+            # Normal races: broker teardown on leadership loss, or the eval
+            # was redelivered after a nack timeout and someone else owns it.
+            logger.debug("worker: ack skipped for %s: %s", eval_id, e)
         except Exception:
             logger.exception("worker: ack failed for %s", eval_id)
 
     def _send_nack(self, eval_id: str, token: str) -> None:
         try:
             self.backend.nack(eval_id, token)
+        except (NotOutstandingError, TokenMismatchError) as e:
+            logger.debug("worker: nack skipped for %s: %s", eval_id, e)
         except Exception:
             logger.exception("worker: nack failed for %s", eval_id)
 

@@ -92,7 +92,10 @@ def _make_handler(agent):
 
         def _dispatch(self) -> None:
             parsed = urllib.parse.urlparse(self.path)
-            query = urllib.parse.parse_qs(parsed.query)
+            # keep_blank_values: bare flags like `?stale` must survive
+            # parsing (parse_qs drops blank-valued params by default).
+            query = urllib.parse.parse_qs(parsed.query,
+                                          keep_blank_values=True)
             try:
                 result = route(agent, self.command, parsed.path, query,
                                self._body)
@@ -170,13 +173,19 @@ def route(agent, method: str, path: str, query, get_body):
     server = agent.server
     client = agent.client
     state = server.state if server is not None else None
-    # A request naming another region — or hitting a client-only agent —
-    # is served over RPC (with region/leader forwarding) instead of local
-    # state (reference: every HTTP handler goes through agent.RPC;
-    # local-state reads here are the AllowStale fast path).
+    # A request naming another region, hitting a client-only agent, or
+    # needing CONSISTENT reads on a follower is served over RPC (with
+    # region/leader forwarding) instead of local state (reference: every
+    # HTTP handler goes through agent.RPC and server.forward; `?stale`
+    # opts into the local-replica fast path, command/agent/http.go
+    # parseConsistency + nomad/rpc.go:177-221). Without the forward, a
+    # read right after a write could miss it on a follower that hasn't
+    # replicated yet.
     q_region = query.get("region", [""])[0]
-    remote = server is None or (bool(q_region)
-                                and q_region != agent.region())
+    stale_ok = "stale" in query and query["stale"][0] not in ("false", "0")
+    remote = (server is None
+              or (bool(q_region) and q_region != agent.region())
+              or (not stale_ok and not server.is_leader()))
 
     def rpc(method_name: str, body: dict):
         if q_region:
@@ -189,7 +198,12 @@ def route(agent, method: str, path: str, query, get_body):
         body = dict(body)
         if min_index:
             body["MinQueryIndex"] = min_index
-            body["MaxQueryTime"] = wait or MAX_WAIT
+            # Forward `wait` verbatim: index-without-wait returns
+            # immediately on the local path and must do the same when the
+            # read happens to route through a follower.
+            body["MaxQueryTime"] = wait
+        if stale_ok:
+            body["AllowStale"] = True
         resp = rpc(method_name, body)
         return resp.get(key), resp.get("Index", 0)
 

@@ -297,11 +297,19 @@ def cmd_run(args) -> int:
 
 
 def _monitor_eval(client: Client, eval_id: str) -> int:
-    """(reference: command/monitor.go)"""
+    """(reference: command/monitor.go — tolerates transient not-found and
+    leaderless windows while the eval replicates/an election settles)"""
     seen_status = ""
     deadline = time.time() + 300
+    grace = time.time() + 10
     while time.time() < deadline:
-        ev, _ = client.evaluations.info(eval_id)
+        try:
+            ev, _ = client.evaluations.info(eval_id)
+        except APIError:
+            if time.time() < grace:
+                time.sleep(0.25)
+                continue
+            raise
         if ev["Status"] != seen_status:
             seen_status = ev["Status"]
             print(f'    Evaluation status: {seen_status}')

@@ -260,14 +260,19 @@ class NetServerChannel:
 
     def get_client_allocs(self, node_id: str, min_index: int,
                           max_wait: float) -> Tuple[Dict[str, int], int]:
+        # AllowStale: the min-index protocol already tolerates replica
+        # lag, and stale watches let any server carry the long-poll load
+        # instead of funnelling every client onto the leader (reference:
+        # watchAllocations sets AllowStale, client.go:1010).
         resp = self._call("Node.GetClientAllocs",
                           {"NodeID": node_id, "MinQueryIndex": min_index,
-                           "MaxQueryTime": max_wait},
+                           "MaxQueryTime": max_wait, "AllowStale": True},
                           timeout=max_wait + 10.0)
         return resp["Allocs"], resp["Index"]
 
     def get_allocs(self, alloc_ids: List[str]) -> List[Allocation]:
-        resp = self._call("Alloc.GetAllocs", {"AllocIDs": alloc_ids})
+        resp = self._call("Alloc.GetAllocs", {"AllocIDs": alloc_ids,
+                                              "AllowStale": True})
         return [from_dict(Allocation, a) for a in resp["Allocs"]]
 
     def update_allocs(self, allocs: List[Allocation]) -> None:

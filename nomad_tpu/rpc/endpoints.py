@@ -123,6 +123,19 @@ class Endpoints:
         # populated by ClusterServer.enable_gossip (server/membership.py)
         self.membership = None
 
+    # Read RPCs that forward to the leader unless the caller passes
+    # AllowStale (reference: every endpoint's `if done, err := s.forward(...)`
+    # prologue + QueryOptions.AllowStale — a follower's replica may lag the
+    # write the caller just made).
+    _READ_FORWARD = frozenset({
+        "Job.GetJob", "Job.List", "Job.Allocations", "Job.Evaluations",
+        "Node.GetNode", "Node.List", "Node.GetAllocs",
+        "Node.GetClientAllocs",
+        "Eval.GetEval", "Eval.List", "Eval.Allocations",
+        "Alloc.List", "Alloc.GetAlloc", "Alloc.GetAllocs",
+        "Service.List", "Service.GetService",
+    })
+
     # ------------------------------------------------------------- dispatch
     def handle(self, method: str, body: Any) -> Any:
         """Every RPC is timed under nomad.rpc.<Method> (reference: the
@@ -134,6 +147,12 @@ class Endpoints:
             region = body.get("Region") or self.server.config.region
             if region != self.server.config.region:
                 return self._forward_region(region, method, body)
+            if (method in self._READ_FORWARD
+                    and not body.get("AllowStale")
+                    and not body.get("Forwarded")
+                    and not self.server.is_leader()):
+                return self._forward_leader(method, body,
+                                            NotLeaderError(None))
             try:
                 return self._methods[method](body)
             except NotLeaderError as exc:

@@ -112,6 +112,42 @@ class TestGossipBootstrap:
             for n in nodes:
                 n.shutdown()
 
+    def test_follower_reads_are_consistent(self):
+        """A read on a follower right after a write must see it: reads
+        forward to the leader unless AllowStale (reference: the s.forward
+        prologue on every read endpoint + QueryOptions.AllowStale)."""
+        nodes = [boot("s0", expect=3)]
+        nodes.append(boot("s1", expect=3, join=[gossip_addr(nodes[0])]))
+        nodes.append(boot("s2", expect=3, join=[gossip_addr(nodes[0])]))
+        try:
+            assert wait_for(lambda: leader_of(nodes) is not None)
+            # Read-your-writes holds against a STABLE leader; an election
+            # mid-sequence (suite-load jitter) legitimately defers applies,
+            # so retry the whole write+read pair if leadership moved.
+            for _ in range(3):
+                leader = leader_of(nodes)
+                if leader is None:
+                    time.sleep(0.2)
+                    continue
+                follower = [n for n in nodes if n is not leader][0]
+                job = mock.job()
+                resp = follower.endpoints.handle("Job.Register",
+                                                 {"Job": to_dict(job)})
+                eval_id = resp["EvalID"]
+                # Immediately, through the SAME follower, no AllowStale.
+                got = follower.endpoints.handle("Eval.GetEval",
+                                                {"EvalID": eval_id})
+                if got["Eval"] is None and leader_of(nodes) is not leader:
+                    continue  # leadership moved mid-pair: retry
+                assert got["Eval"] is not None
+                assert got["Eval"]["ID"] == eval_id
+                break
+            else:
+                raise AssertionError("no stable leadership window")
+        finally:
+            for n in nodes:
+                n.shutdown()
+
     def test_server_members_rpc(self):
         nodes = [boot("s0", expect=1)]
         try:

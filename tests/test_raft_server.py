@@ -56,10 +56,14 @@ class TestReplicatedServer:
     def test_leader_establishes_singletons(self):
         transport, servers = make_servers(3)
         try:
-            assert wait_for(lambda: leader_of(servers) is not None)
+            # Leadership AND the (async) singleton establishment must both
+            # land; under suite load the gap between them stretches.
+            def leader_ready():
+                l = leader_of(servers)
+                return (l is not None and l.eval_broker.enabled()
+                        and l.plan_queue.enabled())
+            assert wait_for(leader_ready)
             leader = leader_of(servers)
-            assert leader.eval_broker.enabled()
-            assert leader.plan_queue.enabled()
             followers = [s for s in servers if s is not leader]
             # A follower that transiently won an early election revokes its
             # singletons once it steps down; convergence is async.

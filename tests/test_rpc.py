@@ -237,11 +237,14 @@ class TestWireClient:
                 pool.close()
             # Client pulls allocs over the blocking query, runs them with
             # the mock driver, and syncs status back over the wire.
+            # Generous: under full-suite load the scheduling round trip +
+            # mock task execution can stretch well past the isolated-run
+            # time (election jitter, GIL pressure from parallel compiles).
             assert wait_for(lambda: (
                 (allocs := leader.server.state.allocs_by_job(job.ID))
                 and len(allocs) == 2
                 and all(a.ClientStatus in ("running", "complete")
-                        for a in allocs)), timeout=30)
+                        for a in allocs)), timeout=60)
         finally:
             client.shutdown()
 

@@ -210,9 +210,16 @@ def main():
         "placements_per_eval": PER_EVAL,
         "e2e_placed": e2e_placed,
         "e2e_worker_stats": worker_stats,
+        "e2e_placements_sec": (e2e_psec := round(e2e_evals_sec * PER_EVAL,
+                                                 2)),
         "placer_only_evals_sec": round(placer_evals_sec, 2),
         "placer_p50_eval_latency_ms": round(p50 * 1e3, 2),
         "cpu_reference_evals_sec": round(cpu_evals_sec, 2),
+        # Absolute anchor (a RATIO): the reference's C1M challenge
+        # sustained ~3,300 placements/sec across a 5,000-host cluster
+        # (BASELINE.md). This is ONE chip driving a full commit path vs
+        # their whole fleet.
+        "e2e_vs_c1m_ratio": round(e2e_psec / 3300.0, 2),
         "backend": _backend(),
     }
 

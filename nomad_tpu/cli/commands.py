@@ -301,10 +301,11 @@ def _monitor_eval(client: Client, eval_id: str) -> int:
     leaderless windows while the eval replicates/an election settles)"""
     seen_status = ""
     deadline = time.time() + 300
-    grace = time.time() + 10
+    grace = time.time() + 10  # slides: resets on every successful poll
     while time.time() < deadline:
         try:
             ev, _ = client.evaluations.info(eval_id)
+            grace = time.time() + 10
         except APIError:
             if time.time() < grace:
                 time.sleep(0.25)

@@ -193,7 +193,14 @@ class Client:
                 if self._shutdown.wait(1.0):
                     return
                 continue
-            min_index = max(min_index, index)
+            if index <= min_index:
+                # Timed-out blocking query (or a stale replica that hasn't
+                # caught up): the snapshot may be incomplete, and treating
+                # it as authoritative would "remove" — i.e. KILL — live
+                # allocations (reference: client.go:1045 skips on unchanged
+                # index).
+                continue
+            min_index = index
 
             with self._alloc_lock:
                 existing = {aid: r.alloc.AllocModifyIndex

@@ -1,0 +1,23 @@
+"""Shared test helpers (one wait_for instead of a copy per file)."""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Optional
+
+
+def wait_for(cond: Callable[[], object], timeout: float = 40.0,
+             interval: float = 0.05, msg: Optional[str] = None) -> bool:
+    """Poll `cond` until truthy. Returns True on success; on timeout,
+    fails the test when `msg` is given, else returns False (callers
+    assert). Generous default: full-suite runs share a loaded machine."""
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(interval)
+    if msg is not None:
+        import pytest
+
+        pytest.fail(f"timeout waiting for {msg}")
+    return False

@@ -12,14 +12,7 @@ from nomad_tpu.jobspec import parse_duration, parse_job
 from nomad_tpu.structs.structs import SECOND, MINUTE
 
 
-def wait_for(cond, timeout=30.0, interval=0.1):
-    deadline = time.time() + timeout
-    while time.time() < deadline:
-        if cond():
-            return True
-        time.sleep(interval)
-    return False
-
+from helpers import wait_for  # noqa: E402
 
 @pytest.fixture(scope="module")
 def dev_agent(tmp_path_factory):

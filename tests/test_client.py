@@ -25,14 +25,7 @@ from nomad_tpu.structs.structs import (
 )
 
 
-def wait_for(cond, timeout=30.0, interval=0.1):
-    deadline = time.time() + timeout
-    while time.time() < deadline:
-        if cond():
-            return True
-        time.sleep(interval)
-    return False
-
+from helpers import wait_for  # noqa: E402
 
 class TestFingerprint:
     def test_basics(self):

@@ -9,14 +9,7 @@ import time
 from nomad_tpu.client.stats import TaskStatsTracker, sample_pid_tree
 
 
-def wait_for(fn, timeout=10.0, interval=0.05):
-    deadline = time.monotonic() + timeout
-    while time.monotonic() < deadline:
-        if fn():
-            return True
-        time.sleep(interval)
-    return False
-
+from helpers import wait_for  # noqa: E402
 
 class TestPidTreeSampling:
     def test_samples_own_process_group(self):

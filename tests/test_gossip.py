@@ -19,14 +19,7 @@ from nomad_tpu.gossip import (
 )
 
 
-def wait_for(cond, timeout=5.0, msg="condition"):
-    deadline = time.monotonic() + timeout
-    while time.monotonic() < deadline:
-        if cond():
-            return
-        time.sleep(0.01)
-    pytest.fail(f"timeout waiting for {msg}")
-
+from helpers import wait_for  # noqa: E402
 
 def make(name, events=None, tags=None):
     cb = None

@@ -16,14 +16,7 @@ from nomad_tpu.server.server import ServerConfig
 from nomad_tpu.structs import to_dict
 
 
-def wait_for(cond, timeout=15.0, interval=0.02):
-    deadline = time.monotonic() + timeout
-    while time.monotonic() < deadline:
-        if cond():
-            return True
-        time.sleep(interval)
-    return False
-
+from helpers import wait_for  # noqa: E402
 
 FAST = RaftConfig(heartbeat_interval=0.02, election_timeout_min=0.08,
                   election_timeout_max=0.16, apply_timeout=5.0)

@@ -17,14 +17,7 @@ pytestmark = pytest.mark.skipif(
     not NATIVE, reason="native executor not built (make -C native)")
 
 
-def wait_for(fn, timeout=10.0, interval=0.05):
-    deadline = time.monotonic() + timeout
-    while time.monotonic() < deadline:
-        if fn():
-            return True
-        time.sleep(interval)
-    return False
-
+from helpers import wait_for  # noqa: E402
 
 def launch(tmp_path, task="t1", **spec_extra):
     spec = {

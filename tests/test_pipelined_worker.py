@@ -17,14 +17,7 @@ from nomad_tpu.structs.structs import EvalStatusComplete
 from nomad_tpu.tensor.node_table import alloc_vec, resources_vec
 
 
-def wait_for(cond, timeout=15.0, interval=0.01):
-    deadline = time.monotonic() + timeout
-    while time.monotonic() < deadline:
-        if cond():
-            return True
-        time.sleep(interval)
-    return cond()
-
+from helpers import wait_for  # noqa: E402
 
 def simple_job(count=4, cpu=None, mem=None):
     """mock.job() without networks (ports are host-side; these tests target

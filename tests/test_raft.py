@@ -26,14 +26,7 @@ from nomad_tpu.raft.log import EntryType
 from nomad_tpu.raft.transport import BoundTransport
 
 
-def wait_for(cond, timeout=10.0, interval=0.01):
-    deadline = time.monotonic() + timeout
-    while time.monotonic() < deadline:
-        if cond():
-            return True
-        time.sleep(interval)
-    return False
-
+from helpers import wait_for  # noqa: E402
 
 FAST = RaftConfig(heartbeat_interval=0.02, election_timeout_min=0.06,
                   election_timeout_max=0.12, apply_timeout=5.0)

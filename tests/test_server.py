@@ -30,14 +30,7 @@ from nomad_tpu.structs.structs import (
 )
 
 
-def wait_for(cond, timeout=15.0, interval=0.05):
-    deadline = time.time() + timeout
-    while time.time() < deadline:
-        if cond():
-            return True
-        time.sleep(interval)
-    return False
-
+from helpers import wait_for  # noqa: E402
 
 class TestEvalBroker:
     def _broker(self, **kw):

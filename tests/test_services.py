@@ -33,14 +33,7 @@ from nomad_tpu.structs.structs import (
 )
 
 
-def wait_for(fn, timeout=10.0, interval=0.05):
-    deadline = time.monotonic() + timeout
-    while time.monotonic() < deadline:
-        if fn():
-            return True
-        time.sleep(interval)
-    return False
-
+from helpers import wait_for  # noqa: E402
 
 def reg(id_="r1", name="web", node="n1", alloc="a1", **kw):
     return ServiceRegistration(ID=id_, ServiceName=name, NodeID=node,

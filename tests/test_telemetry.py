@@ -11,14 +11,7 @@ from nomad_tpu.structs.structs import EvalStatusComplete
 from nomad_tpu.telemetry.metrics import InMemSink, MetricsRegistry, StatsdSink
 
 
-def wait_for(cond, timeout=15.0, interval=0.05):
-    deadline = time.time() + timeout
-    while time.time() < deadline:
-        if cond():
-            return True
-        time.sleep(interval)
-    return False
-
+from helpers import wait_for  # noqa: E402
 
 class TestInMemSink:
     def test_gauge_keeps_last_value(self):

@@ -124,9 +124,18 @@ def bench_server_e2e(nodes, n_evals):
         run(3)
         run(3)
 
-        t0 = time.perf_counter()
-        eval_ids = run(n_evals)
-        elapsed = time.perf_counter() - t0
+        # Median of three timed reps: the remote-attached TPU's round-trip
+        # latency wanders between runs, and a single sample can be off 2x
+        # in either direction. Reps accumulate allocations in the cluster
+        # (like a real registration storm would); at the default shapes the
+        # node pool has >100x headroom, so fill effects are negligible.
+        rates = []
+        eval_ids = []
+        for _ in range(3):
+            t0 = time.perf_counter()
+            eval_ids = run(n_evals)
+            rates.append(n_evals / (time.perf_counter() - t0))
+        rate = sorted(rates)[1]
 
         placed = sum(
             1 for eid in eval_ids
@@ -135,7 +144,10 @@ def bench_server_e2e(nodes, n_evals):
         for w in srv.workers:
             for k, v in w.stats.items():
                 stats[k] = stats.get(k, 0) + v
-        return n_evals / elapsed, placed, stats
+        # Counters below cover ALL timed reps (3x n_evals evals).
+        stats["timed_reps"] = len(rates)
+        stats["rep_rates"] = [round(r, 1) for r in rates]
+        return rate, placed, stats
     finally:
         srv.shutdown()
 

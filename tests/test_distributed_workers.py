@@ -4,7 +4,6 @@ throughput (reference shapes: nomad/worker.go:101-130 workers on every
 server, plan_endpoint.go:16 Plan.Submit, eval_endpoint.go:68 Eval.Dequeue,
 leader.go:110-116 leader worker pausing)."""
 
-import time
 
 import pytest
 

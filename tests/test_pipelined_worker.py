@@ -6,7 +6,6 @@ exhaustion through the fast path, and parity of outcomes with the per-eval
 GenericScheduler (reference behavior model: nomad/worker.go + the plan
 applier's re-verification making optimistic chaining safe)."""
 
-import time
 
 import numpy as np
 import pytest

@@ -7,7 +7,6 @@ timeouts (reference: server_test.go:46-52 tightens Raft the same way).
 """
 
 import threading
-import time
 
 import msgpack
 import pytest

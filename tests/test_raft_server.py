@@ -8,7 +8,6 @@ tensor index); followers replicate the FSM so failover rehydrates everything
 from local state.
 """
 
-import time
 
 import pytest
 

@@ -8,7 +8,6 @@ check runners, check-driven restarts, server self-registration.
 import http.server
 import json
 import threading
-import time
 import urllib.request
 
 import pytest

@@ -70,9 +70,45 @@ class AgentConfig:
         )
 
 
+class LogRing(logging.Handler):
+    """Bounded in-memory ring of recent formatted log lines, serving the
+    /v1/agent/monitor endpoint (the reference streams agent logs through
+    log_writer.go; a polled ring is the same capability over plain HTTP)."""
+
+    def __init__(self, capacity: int = 2000):
+        super().__init__()
+        from collections import deque
+
+        self._lines = deque(maxlen=capacity)
+        self._seq = 0
+        self.setFormatter(logging.Formatter(
+            "%(asctime)s [%(levelname)s] %(name)s: %(message)s"))
+
+    def emit(self, record: logging.LogRecord) -> None:
+        try:
+            line = self.format(record)
+        except Exception:
+            return
+        # One lock for seq+append: a concurrent tail() must never see a
+        # Seq whose line isn't in the ring yet (the poller would use it as
+        # a cursor and skip that line forever).
+        with self.lock:
+            self._seq += 1
+            self._lines.append((self._seq, line))
+
+    def tail(self, lines: int = 200, after: int = 0):
+        with self.lock:
+            snapshot = list(self._lines)
+            seq = self._seq
+        out = [(s, line) for s, line in snapshot if s > after]
+        return (out[-lines:] if lines > 0 else []), seq
+
+
 class Agent:
     def __init__(self, config: AgentConfig):
         self.config = config
+        self.log_ring = LogRing()
+        logging.getLogger().addHandler(self.log_ring)
         self.server: Optional[Server] = None
         self.cluster = None  # ClusterServer in networked mode
         self.client: Optional[Client] = None
@@ -225,6 +261,7 @@ class Agent:
         self.client.start()
 
     def shutdown(self) -> None:
+        logging.getLogger().removeHandler(self.log_ring)
         if getattr(self, "_server_service_node_id", None):
             # Graceful departure: pull this server's registry entries so
             # bootstrapping clients stop being handed its addresses. (A

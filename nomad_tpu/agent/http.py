@@ -526,6 +526,15 @@ def route(agent, method: str, path: str, query, get_body):
         return out, None
     if path == "/v1/agent/members":
         return agent.members(), None
+    if path == "/v1/agent/monitor":
+        # Recent agent log lines; `after=<seq>` polls incrementally
+        # (reference capability: the log streaming behind `nomad monitor`
+        # / log_writer.go).
+        lines = int(query.get("lines", ["200"])[0])
+        after = int(query.get("after", ["0"])[0])
+        entries, seq = agent.log_ring.tail(lines, after)
+        return {"Lines": [line for _, line in entries], "Seq": seq}, None
+
     if path == "/v1/agent/debug/stacks":
         # The runtime-profiling hook, gated exactly like the reference's
         # pprof routes (command/agent/http.go registers them only when

@@ -257,9 +257,18 @@ def cmd_agent(args) -> int:
         log.info("SIGHUP: config reloaded (telemetry applied; topology "
                  "changes need a restart)")
 
+    # SIGUSR1: dump the in-memory telemetry snapshot to the log
+    # (reference: the in-mem sink's signal-triggered dump).
+    def dump_metrics(signum, frame):
+        from nomad_tpu.telemetry import metrics
+
+        logging.getLogger("nomad.agent").info(
+            "metrics snapshot: %s", json.dumps(metrics.snapshot()))
+
     import signal as _signal
 
     _signal.signal(_signal.SIGHUP, reload)
+    _signal.signal(_signal.SIGUSR1, dump_metrics)
     try:
         while True:
             time.sleep(1)

@@ -209,3 +209,18 @@ def test_debug_stacks(dev_agent):
     stacks, _ = api.get("/v1/agent/debug/stacks")
     assert any("MainThread" in k for k in stacks)
     assert all(isinstance(v, list) for v in stacks.values())
+
+
+def test_agent_monitor_ring(dev_agent):
+    """Recent-log endpoint with incremental polling."""
+    import logging
+
+    agent, api = dev_agent
+    logging.getLogger("nomad.test").warning("monitor-marker-1")
+    out, _ = api.get("/v1/agent/monitor")
+    assert any("monitor-marker-1" in l for l in out["Lines"])
+    seq = out["Seq"]
+    logging.getLogger("nomad.test").warning("monitor-marker-2")
+    out2, _ = api.get(f"/v1/agent/monitor?after={seq}")
+    assert any("monitor-marker-2" in l for l in out2["Lines"])
+    assert not any("monitor-marker-1" in l for l in out2["Lines"])

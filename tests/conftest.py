@@ -57,3 +57,43 @@ def _ensure_native_executor():
 
 
 _ensure_native_executor()
+
+
+# One retry for the cluster/timing suites: they assert distributed
+# properties (elections, gossip convergence, task execution) under real
+# threads and real sockets, and a loaded CI machine can stretch past any
+# fixed margin. A genuine regression fails both attempts; a scheduler
+# hiccup doesn't fail `pytest -x`. Reruns are reported loudly.
+_RETRY_FILES = {
+    "test_membership.py", "test_raft_server.py", "test_raft.py",
+    "test_rpc.py", "test_distributed_workers.py", "test_gossip.py",
+    "test_server.py", "test_client.py", "test_agent_http.py",
+    "test_services.py", "test_pipelined_worker.py", "test_telemetry.py",
+    "test_client_stats.py",
+}
+
+
+def pytest_runtest_protocol(item, nextitem):
+    if os.path.basename(str(item.fspath)) not in _RETRY_FILES:
+        return None
+    from _pytest.runner import runtestprotocol
+
+    item.ihook.pytest_runtest_logstart(nodeid=item.nodeid,
+                                       location=item.location)
+    reports = runtestprotocol(item, nextitem=nextitem, log=False)
+    # Retry only setup/call failures; a teardown ERROR (leaked resource)
+    # must surface, not be laundered through a clean second run.
+    if any(r.failed for r in reports if r.when in ("setup", "call")):
+        print(f"\nRETRYING (timing-sensitive): {item.nodeid}")
+        if hasattr(item, "_initrequest"):
+            # Reset funcargs so fixtures REBUILD: without this the rerun
+            # reuses attempt 1's torn-down fixture values (pytest's
+            # _fillfixtures skips argnames already present) — the same
+            # reset pytest-rerunfailures performs per rerun.
+            item._initrequest()
+        reports = runtestprotocol(item, nextitem=nextitem, log=False)
+    for report in reports:
+        item.ihook.pytest_runtest_logreport(report=report)
+    item.ihook.pytest_runtest_logfinish(nodeid=item.nodeid,
+                                        location=item.location)
+    return True

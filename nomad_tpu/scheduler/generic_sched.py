@@ -54,6 +54,7 @@ from .util import (
     BLOCKED_EVAL_MAX_PLAN,
     AllocTuple,
     desired_updates,
+    attempt_inplace_updates,
     diff_allocs,
     evict_and_place,
     materialize_task_groups,
@@ -325,42 +326,8 @@ class GenericScheduler:
                         ) -> tuple[List[AllocTuple], List[AllocTuple]]:
         """In-place where the TG didn't materially change (reference:
         util.go:389-468). Returns (destructive, inplace)."""
-        destructive: List[AllocTuple] = []
-        inplace: List[AllocTuple] = []
-        for tup in updates:
-            existing_tg = (tup.Alloc.Job.lookup_task_group(tup.TaskGroup.Name)
-                           if tup.Alloc.Job is not None else None)
-            if existing_tg is None or tasks_updated(tup.TaskGroup, existing_tg):
-                destructive.append(tup)
-                continue
-            node = self.state.node_by_id(tup.Alloc.NodeID)
-            if node is None:
-                destructive.append(tup)
-                continue
-            # Stage an eviction so the current alloc is discounted in the fit.
-            self.plan.append_update(tup.Alloc, AllocDesiredStatusStop,
-                                    ALLOC_IN_PLACE)
-            option = self.stack.select_on_node(tup.TaskGroup, node)
-            self.plan.pop_update(tup.Alloc)
-            if option is None:
-                destructive.append(tup)
-                continue
-            # Networks are not updatable in place; restore existing offers.
-            for task_name, resources in option.task_resources.items():
-                existing_res = tup.Alloc.TaskResources.get(task_name)
-                if existing_res is not None:
-                    resources.Networks = existing_res.Networks
-            new_alloc = tup.Alloc.copy()
-            new_alloc.EvalID = self.eval.ID
-            new_alloc.Job = None  # the plan carries the job
-            new_alloc.Resources = None  # computed at plan apply
-            new_alloc.TaskResources = option.task_resources
-            new_alloc.Metrics = self.ctx.metrics.copy()
-            new_alloc.DesiredStatus = AllocDesiredStatusRun
-            new_alloc.ClientStatus = AllocClientStatusPending
-            self.plan.append_alloc(new_alloc)
-            inplace.append(tup)
-        return destructive, inplace
+        return attempt_inplace_updates(self.state, self.plan, self.stack,
+                                       self.eval.ID, self.ctx, updates)
 
     def _compute_placements(self, place: List[AllocTuple]) -> None:
         """Batched placement: ONE device program for the whole list

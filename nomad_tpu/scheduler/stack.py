@@ -511,16 +511,21 @@ class GenericStack:
 
         assert self.job is not None
         nt = self.tindex.nt
+        m = self.ctx.metrics
         row = nt.row_of.get(node.ID)
         if row is None:
             return None
+        m.NodesEvaluated += 1
         cons = task_group_constraints(tg)
         if not nt.ready[row]:
+            m.NodesFiltered += 1
             return None
         if not node_meets_constraints(node, self.job.Constraints):
+            m.filter_node(node, "job constraints")  # increments NodesFiltered
             return None
         if not (node_meets_constraints(node, cons.constraints)
                 and node_has_drivers(node, cons.drivers)):
+            m.filter_node(node, "group constraints")
             return None
         # Usage: committed minus in-plan evictions on this node.
         usage = nt.usage[row].copy()
@@ -530,7 +535,13 @@ class GenericStack:
         for alloc in self.ctx.plan.NodeAllocation.get(node.ID, ()):
             usage += alloc_vec(alloc)
         demand = resources_vec(cons.size)
-        if np.any(nt.capacity[row] - usage < demand):
+        lacking = nt.capacity[row] - usage < demand
+        if np.any(lacking):
+            m.NodesExhausted += 1
+            for d in np.flatnonzero(lacking):
+                name = DIM_NAMES[int(d)]
+                m.DimensionExhausted[name] = (
+                    m.DimensionExhausted.get(name, 0) + 1)
             return None
         util2 = usage[:2] + demand[:2]
         with np.errstate(divide="ignore", invalid="ignore"):

@@ -43,6 +43,7 @@ from .util import (
     ALLOC_NODE_TAINTED,
     ALLOC_NOT_NEEDED,
     ALLOC_UPDATING,
+    attempt_inplace_updates,
     diff_system_allocs,
     progress_made,
     ready_nodes_in_dcs,
@@ -146,8 +147,13 @@ class SystemScheduler:
             desc = ALLOC_NODE_TAINTED if tainted.get(tup.Alloc.NodeID) \
                 else ALLOC_NOT_NEEDED
             self.plan.append_update(tup.Alloc, AllocDesiredStatusStop, desc)
-        for tup in diff.update:
-            # System jobs update destructively: stop + replace on same node.
+        # In-place first (non-destructive changes keep the running alloc,
+        # reference: system_sched.go computeJobAllocs -> inplaceUpdate);
+        # the rest stop + replace on the same node.
+        destructive, _ = attempt_inplace_updates(
+            self.state, self.plan, self.stack.inner, self.eval.ID, self.ctx,
+            diff.update)
+        for tup in destructive:
             self.plan.append_update(tup.Alloc, AllocDesiredStatusStop,
                                     ALLOC_UPDATING)
             diff.place.append(tup)

@@ -292,3 +292,51 @@ def set_status(planner, eval, next_eval, spawned_blocked, tg_metrics,
     if spawned_blocked is not None:
         new_eval.BlockedEval = spawned_blocked.ID
     planner.update_eval(new_eval)
+
+
+def attempt_inplace_updates(state, plan, stack, eval_id, ctx, updates):
+    """Split updated allocs into (destructive, inplace); in-place winners
+    are appended to the plan with refreshed resources (reference:
+    inplaceUpdate, util.go:389-468). `stack` must expose select_on_node.
+    Shared by the generic and system schedulers."""
+    from nomad_tpu.structs.structs import (
+        AllocClientStatusPending,
+        AllocDesiredStatusRun,
+        AllocDesiredStatusStop,
+    )
+
+    destructive = []
+    inplace = []
+    for tup in updates:
+        existing_tg = (tup.Alloc.Job.lookup_task_group(tup.TaskGroup.Name)
+                       if tup.Alloc.Job is not None else None)
+        if existing_tg is None or tasks_updated(tup.TaskGroup, existing_tg):
+            destructive.append(tup)
+            continue
+        node = state.node_by_id(tup.Alloc.NodeID)
+        if node is None:
+            destructive.append(tup)
+            continue
+        # Stage an eviction so the current alloc is discounted in the fit.
+        plan.append_update(tup.Alloc, AllocDesiredStatusStop, ALLOC_IN_PLACE)
+        option = stack.select_on_node(tup.TaskGroup, node)
+        plan.pop_update(tup.Alloc)
+        if option is None:
+            destructive.append(tup)
+            continue
+        # Networks are not updatable in place; restore existing offers.
+        for task_name, resources in option.task_resources.items():
+            existing_res = tup.Alloc.TaskResources.get(task_name)
+            if existing_res is not None:
+                resources.Networks = existing_res.Networks
+        new_alloc = tup.Alloc.copy()
+        new_alloc.EvalID = eval_id
+        new_alloc.Job = None  # the plan carries the job
+        new_alloc.Resources = None  # computed at plan apply
+        new_alloc.TaskResources = option.task_resources
+        new_alloc.Metrics = ctx.metrics.copy()
+        new_alloc.DesiredStatus = AllocDesiredStatusRun
+        new_alloc.ClientStatus = AllocClientStatusPending
+        plan.append_alloc(new_alloc)
+        inplace.append(tup)
+    return destructive, inplace

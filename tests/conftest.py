@@ -82,16 +82,20 @@ def pytest_runtest_protocol(item, nextitem):
                                        location=item.location)
     reports = runtestprotocol(item, nextitem=nextitem, log=False)
     # Retry only setup/call failures; a teardown ERROR (leaked resource)
-    # must surface, not be laundered through a clean second run.
+    # must surface, not be laundered through a clean second run — attempt
+    # 1's teardown failures are re-logged alongside attempt 2.
     if any(r.failed for r in reports if r.when in ("setup", "call")):
         print(f"\nRETRYING (timing-sensitive): {item.nodeid}")
+        teardown_errors = [r for r in reports
+                           if r.when == "teardown" and r.failed]
         if hasattr(item, "_initrequest"):
             # Reset funcargs so fixtures REBUILD: without this the rerun
             # reuses attempt 1's torn-down fixture values (pytest's
             # _fillfixtures skips argnames already present) — the same
             # reset pytest-rerunfailures performs per rerun.
             item._initrequest()
-        reports = runtestprotocol(item, nextitem=nextitem, log=False)
+        reports = teardown_errors + runtestprotocol(item, nextitem=nextitem,
+                                                    log=False)
     for report in reports:
         item.ihook.pytest_runtest_logreport(report=report)
     item.ihook.pytest_runtest_logfinish(nodeid=item.nodeid,

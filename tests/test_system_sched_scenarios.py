@@ -1,0 +1,156 @@
+"""System scheduler scenario depth (reference: the system_sched_test.go
+grid not yet covered by tests/test_scheduler.py: add-node incremental
+placement, alloc-fail metrics, modify in-place vs destructive, deregister,
+drain migration)."""
+
+from nomad_tpu import mock
+from nomad_tpu.scheduler import Harness
+from nomad_tpu.structs.structs import (
+    AllocDesiredStatusRun,
+    AllocDesiredStatusStop,
+    EvalStatusComplete,
+    EvalStatusPending,
+    EvalTriggerJobDeregister,
+    EvalTriggerJobRegister,
+    EvalTriggerNodeUpdate,
+)
+
+
+def make_eval(job, trigger=EvalTriggerJobRegister):
+    ev = mock.eval()
+    ev.JobID = job.ID
+    ev.Type = job.Type
+    ev.TriggeredBy = trigger
+    ev.Status = EvalStatusPending
+    return ev
+
+
+def placed(h):
+    return [a for p in h.plans for allocs in p.NodeAllocation.values()
+            for a in allocs]
+
+
+def stops(h):
+    return [a for p in h.plans for allocs in p.NodeUpdate.values()
+            for a in allocs]
+
+
+class TestSystemSchedScenarios:
+    def _register(self, h, job):
+        h.upsert("job", job)
+        h.process("system", make_eval(job))
+
+    def test_add_node_places_only_there(self):
+        """A node joining gets the system job WITHOUT touching existing
+        allocs (reference: TestSystemSched_JobRegister_AddNode)."""
+        h = Harness()
+        for _ in range(4):
+            h.upsert("node", mock.node())
+        job = mock.system_job()
+        self._register(h, job)
+        assert len(h.state.allocs_by_job(job.ID)) == 4
+
+        newcomer = mock.node()
+        h.upsert("node", newcomer)
+        h.plans.clear()
+        h.process("system", make_eval(job, EvalTriggerNodeUpdate))
+        new_placed = placed(h)
+        assert len(new_placed) == 1
+        assert new_placed[0].NodeID == newcomer.ID
+        assert stops(h) == []  # existing allocs untouched
+        assert len(h.state.allocs_by_job(job.ID)) == 5
+
+    def test_alloc_fail_records_metrics(self):
+        """Node too small: the eval carries FailedTGAllocs with the
+        exhausted dimension (reference: TestSystemSched_JobRegister_
+        AllocFail)."""
+        h = Harness()
+        node = mock.node()
+        node.Resources.CPU = 60  # below the system job's ask + reserved
+        h.upsert("node", node)
+        job = mock.system_job()
+        self._register(h, job)
+        final = h.evals[-1]
+        assert final.Status == EvalStatusComplete
+        assert final.FailedTGAllocs
+        metric = next(iter(final.FailedTGAllocs.values()))
+        assert metric.NodesEvaluated >= 1
+
+    def test_modify_destructive_replaces_everywhere(self):
+        """A changed task config stops and replaces the alloc on every node
+        (reference: TestSystemSched_JobModify)."""
+        h = Harness()
+        for _ in range(3):
+            h.upsert("node", mock.node())
+        job = mock.system_job()
+        self._register(h, job)
+        assert len(h.state.allocs_by_job(job.ID)) == 3
+
+        update = job.copy()
+        update.TaskGroups[0].Tasks[0].Config = {"command": "/bin/other"}
+        update.init_fields()
+        h.upsert("job", update)
+        h.plans.clear()
+        h.process("system", make_eval(update))
+        assert len(stops(h)) == 3
+        assert len(placed(h)) == 3
+        run_allocs = [a for a in h.state.allocs_by_job(job.ID)
+                      if a.DesiredStatus == AllocDesiredStatusRun]
+        assert len(run_allocs) == 3
+
+    def test_modify_inplace_keeps_allocs(self):
+        """A non-destructive change updates in place: no stops, no new
+        placements (reference: TestSystemSched_JobModify_InPlace)."""
+        h = Harness()
+        for _ in range(3):
+            h.upsert("node", mock.node())
+        job = mock.system_job()
+        self._register(h, job)
+        before = {a.ID for a in h.state.allocs_by_job(job.ID)}
+
+        update = job.copy()
+        from nomad_tpu.structs import Constraint
+
+        update.Constraints = list(update.Constraints) + [Constraint(
+            LTarget="${attr.kernel.name}", RTarget="linux", Operand="=")]
+        update.init_fields()
+        h.upsert("job", update)
+        h.plans.clear()
+        h.process("system", make_eval(update))
+        assert stops(h) == []
+        after = {a.ID for a in h.state.allocs_by_job(job.ID)
+                 if a.DesiredStatus == AllocDesiredStatusRun}
+        assert after == before  # same allocation IDs survive
+
+    def test_deregister_stops_all(self):
+        """(reference: TestSystemSched_JobDeregister)"""
+        h = Harness()
+        for _ in range(3):
+            h.upsert("node", mock.node())
+        job = mock.system_job()
+        self._register(h, job)
+        h.state.delete_job(h._next_index(), job.ID)
+        h.plans.clear()
+        h.process("system", make_eval(job, EvalTriggerJobDeregister))
+        assert len(stops(h)) == 3
+        live = [a for a in h.state.allocs_by_job(job.ID)
+                if a.DesiredStatus == AllocDesiredStatusRun]
+        assert live == []
+
+    def test_drain_stops_there_only(self):
+        """Draining one node stops its system alloc and leaves the others
+        (reference: TestSystemSched_NodeDrain)."""
+        h = Harness()
+        nodes = [mock.node() for _ in range(3)]
+        for n in nodes:
+            h.upsert("node", n)
+        job = mock.system_job()
+        self._register(h, job)
+        victim = nodes[0]
+        h.state.update_node_drain(h._next_index(), victim.ID, True)
+        h.plans.clear()
+        h.process("system", make_eval(job, EvalTriggerNodeUpdate))
+        stopped = stops(h)
+        assert len(stopped) == 1
+        assert stopped[0].NodeID == victim.ID
+        assert placed(h) == []  # system jobs don't migrate off-node

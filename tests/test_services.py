@@ -105,6 +105,57 @@ class TestRegistryState:
         assert ev.is_set()
 
 
+class TestRegistryWire:
+    def test_registration_codec_roundtrip(self):
+        """Nested CheckState survives both codec paths (dict + msgpack)."""
+        from nomad_tpu.structs import decode, encode
+
+        r = reg(Status=CheckStatusPassing,
+                Checks=[CheckState(Name="c", Type="tcp",
+                                   Status=CheckStatusPassing,
+                                   Output="ok", Timestamp=1.5)])
+        assert from_dict(ServiceRegistration, to_dict(r)) == r
+        assert decode(ServiceRegistration, encode(r)) == r
+
+    def test_sync_and_query_over_real_rpc(self):
+        """Service.Sync / Service.GetService over actual TCP framing — the
+        dev-agent path is in-process, so this is where msgpack-wire
+        serialization of registrations is exercised."""
+        from nomad_tpu.rpc.cluster import ClusterServer
+        from nomad_tpu.rpc.pool import ConnPool
+        from nomad_tpu.server import ServerConfig
+
+        cs = ClusterServer(ServerConfig(num_schedulers=0,
+                                        bootstrap_expect=1))
+        # Static single-node peer set: electable immediately, no gossip.
+        cs.connect([cs.addr])
+        cs.start()
+        pool = ConnPool()
+        try:
+            wait_for(lambda: cs.server.is_leader())
+            r = reg(Checks=[CheckState(Name="c", Type="http",
+                                       Status=CheckStatusCritical,
+                                       Output="boom")])
+            resp = pool.call(cs.addr, "Service.Sync",
+                             {"Upserts": [to_dict(r)], "Deletes": []})
+            assert resp["Index"] > 0
+            got = pool.call(cs.addr, "Service.GetService",
+                            {"ServiceName": "web"})
+            assert len(got["Services"]) == 1
+            wire_reg = from_dict(ServiceRegistration, got["Services"][0])
+            assert wire_reg.Checks[0].Output == "boom"
+            assert wire_reg.Checks[0].Status == CheckStatusCritical
+
+            pool.call(cs.addr, "Service.Sync",
+                      {"Upserts": [], "Deletes": [r.ID]})
+            got = pool.call(cs.addr, "Service.GetService",
+                            {"ServiceName": "web"})
+            assert got["Services"] == []
+        finally:
+            pool.close()
+            cs.shutdown()
+
+
 # -------------------------------------------------------------- check runners
 class _Handler(http.server.BaseHTTPRequestHandler):
     status_code = 200

@@ -206,6 +206,10 @@ def _parse_task(name: str, body: Dict[str, Any]) -> Task:
         task.LogConfig = LogConfig(
             MaxFiles=int(lb.get("max_files", 10)),
             MaxFileSizeMB=int(lb.get("max_file_size", 10)))
+    else:
+        # Every task gets a log budget (reference: parse.go assigns
+        # DefaultLogConfig so disk validation can account for it).
+        task.LogConfig = LogConfig()
     for ab in _as_list(body.get("artifact")):
         _check_keys(ab, {"source", "destination", "options"}, "artifact block")
         task.Artifacts.append(TaskArtifact(

@@ -1,0 +1,222 @@
+"""HCL jobspec parser grid (reference: jobspec/parse_test.go — the full
+fixture matrix: every block type, constraint sugar, strict keys, duration
+coercion, defaults)."""
+
+import pytest
+
+from nomad_tpu.jobspec import parse_job
+from nomad_tpu.jobspec.parse import JobSpecError
+from nomad_tpu.structs.structs import (
+    HOUR,
+    MINUTE,
+    SECOND,
+    ConstraintDistinctHosts,
+    ConstraintRegex,
+    ConstraintVersion,
+)
+
+FULL = '''
+job "binstore" {
+  region = "fringe"
+  type = "service"
+  priority = 52
+  all_at_once = true
+  datacenters = ["us2", "eu1"]
+
+  meta {
+    foo = "bar"
+  }
+
+  constraint {
+    attribute = "${attr.kernel.os}"
+    value = "windows"
+  }
+
+  update {
+    stagger = "60s"
+    max_parallel = 2
+  }
+
+  group "binsl" {
+    count = 5
+
+    restart {
+      attempts = 5
+      interval = "10m"
+      delay = "15s"
+      mode = "delay"
+    }
+
+    constraint {
+      attribute = "${attr.kernel.os}"
+      value = "linux"
+    }
+
+    meta {
+      elb_checks = "3"
+    }
+
+    task "binstore" {
+      driver = "docker"
+      user = "bob"
+
+      config {
+        image = "hashicorp/binstore"
+      }
+
+      env {
+        HELLO = "world"
+        LOREM = "ipsum"
+      }
+
+      service {
+        name = "binstore-check"
+        tags = ["foo", "bar"]
+        port = "http"
+        check {
+          name = "check-name"
+          type = "tcp"
+          interval = "10s"
+          timeout = "2s"
+        }
+      }
+
+      resources {
+        cpu = 500
+        memory = 128
+        network {
+          mbits = 100
+          port "http" {}
+          port "https" {}
+          port "admin" {
+            static = 8080
+          }
+        }
+      }
+
+      kill_timeout = "22s"
+
+      logs {
+        max_files = 10
+        max_file_size = 100
+      }
+
+      artifact {
+        source = "http://foo.com/artifact"
+        destination = "local/"
+      }
+    }
+  }
+}
+'''
+
+
+class TestFullJob:
+    def test_every_block(self):
+        job = parse_job(FULL)
+        assert job.ID == "binstore" and job.Region == "fringe"
+        assert job.Priority == 52 and job.AllAtOnce
+        assert job.Datacenters == ["us2", "eu1"]
+        assert job.Meta == {"foo": "bar"}
+        assert job.Constraints[0].LTarget == "${attr.kernel.os}"
+        assert job.Constraints[0].RTarget == "windows"
+        assert job.Update.Stagger == 60 * SECOND
+        assert job.Update.MaxParallel == 2
+
+        tg = job.TaskGroups[0]
+        assert tg.Name == "binsl" and tg.Count == 5
+        assert tg.RestartPolicy.Attempts == 5
+        assert tg.RestartPolicy.Interval == 10 * MINUTE
+        assert tg.RestartPolicy.Delay == 15 * SECOND
+        assert tg.Meta == {"elb_checks": "3"}
+
+        task = tg.Tasks[0]
+        assert task.Driver == "docker" and task.User == "bob"
+        assert task.Config["image"] == "hashicorp/binstore"
+        assert task.Env == {"HELLO": "world", "LOREM": "ipsum"}
+        assert task.KillTimeout == 22 * SECOND
+        assert task.LogConfig.MaxFiles == 10
+        assert task.LogConfig.MaxFileSizeMB == 100
+        assert task.Artifacts[0].GetterSource == "http://foo.com/artifact"
+
+        svc = task.Services[0]
+        assert svc.Name == "binstore-check"
+        assert svc.Tags == ["foo", "bar"] and svc.PortLabel == "http"
+        check = svc.Checks[0]
+        assert check.Type == "tcp" and check.Interval == 10 * SECOND
+
+        net = task.Resources.Networks[0]
+        assert net.MBits == 100
+        assert {p.Label for p in net.DynamicPorts} == {"http", "https"}
+        assert {(p.Label, p.Value) for p in net.ReservedPorts} == \
+            {("admin", 8080)}
+
+
+class TestConstraintSugar:
+    def _one(self, block):
+        job = parse_job('job "x" { %s group "g" { task "t" { '
+                        'driver = "raw_exec" } } }' % block)
+        return job.Constraints[0]
+
+    def test_version_sugar(self):
+        c = self._one('constraint { attribute = "${attr.nomad.version}" '
+                      'version = ">= 0.4" }')
+        assert c.Operand == ConstraintVersion and c.RTarget == ">= 0.4"
+
+    def test_regexp_sugar(self):
+        c = self._one('constraint { attribute = "${attr.arch}" '
+                      'regexp = "x86.*" }')
+        assert c.Operand == ConstraintRegex and c.RTarget == "x86.*"
+
+    def test_distinct_hosts_sugar(self):
+        c = self._one("constraint { distinct_hosts = true }")
+        assert c.Operand == ConstraintDistinctHosts
+
+
+class TestStrictness:
+    def test_unknown_job_key_rejected(self):
+        with pytest.raises(JobSpecError, match="invalid key"):
+            parse_job('job "x" { bogus = 1 group "g" { task "t" { '
+                      'driver = "raw_exec" } } }')
+
+    def test_unknown_task_key_rejected(self):
+        with pytest.raises(JobSpecError, match="invalid key"):
+            parse_job('job "x" { group "g" { task "t" { '
+                      'driver = "raw_exec" nonsense = true } } }')
+
+    def test_missing_job_block(self):
+        with pytest.raises(JobSpecError, match="'job' block not found"):
+            parse_job('group "g" {}')
+
+    def test_two_job_blocks_rejected(self):
+        with pytest.raises(JobSpecError):
+            parse_job('job "a" { } job "b" { }')
+
+
+class TestDefaults:
+    def test_bare_task_gets_defaults(self):
+        job = parse_job('job "x" { group "g" { task "t" { '
+                        'driver = "raw_exec" } } }')
+        task = job.TaskGroups[0].Tasks[0]
+        assert task.Resources is not None and task.Resources.CPU > 0
+        assert task.LogConfig is not None
+        assert job.Type == "service"
+        assert job.TaskGroups[0].Count == 1
+
+    def test_task_outside_group_gets_wrapped(self):
+        """A job-level task is wrapped in a group of the same name
+        (reference: parse.go's implicit group)."""
+        job = parse_job('job "x" { task "solo" { driver = "raw_exec" } }')
+        assert len(job.TaskGroups) == 1
+        assert job.TaskGroups[0].Name == "solo"
+        assert job.TaskGroups[0].Tasks[0].Name == "solo"
+
+    def test_periodic_block(self):
+        job = parse_job('job "x" { type = "batch" '
+                        'periodic { cron = "*/5 * * * *" '
+                        'prohibit_overlap = true } '
+                        'group "g" { task "t" { driver = "raw_exec" } } }')
+        assert job.Periodic is not None
+        assert job.Periodic.Spec == "*/5 * * * *"
+        assert job.Periodic.ProhibitOverlap is True
+        assert job.is_periodic()

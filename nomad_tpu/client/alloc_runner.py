@@ -134,7 +134,9 @@ class AllocRunner:
             if (self.service_manager is not None
                     and states.get(name) == TaskStateRunning):
                 try:
-                    self.service_manager.register_task(alloc, new_task)
+                    cwd, env = self._task_check_ctx(name, runner)
+                    self.service_manager.register_task(
+                        alloc, new_task, cwd=cwd, env=env)
                 except Exception:
                     logger.exception(
                         "alloc %s: service re-sync for %s failed",
@@ -240,18 +242,23 @@ class AllocRunner:
             return
         try:
             if state == TaskStateRunning:
-                env = runner.exec_ctx.task_env
-                task_dir = os.path.join(
-                    self.alloc_dir.task_dirs.get(task_name, ""), "local") \
-                    if self.alloc_dir is not None else None
+                cwd, env = self._task_check_ctx(task_name, runner)
                 self.service_manager.register_task(
-                    self.alloc, runner.task, cwd=task_dir,
-                    env=env.build_env() if env is not None else None)
+                    self.alloc, runner.task, cwd=cwd, env=env)
             else:
                 self.service_manager.deregister_task(self.alloc.ID, task_name)
         except Exception:
             logger.exception("alloc %s: service sync for task %s failed",
                              self.alloc.ID, task_name)
+
+    def _task_check_ctx(self, task_name, runner):
+        """cwd + env that a task's script checks should run under — the
+        task's local dir and its interpolated environment."""
+        env = runner.exec_ctx.task_env
+        cwd = os.path.join(
+            self.alloc_dir.task_dirs.get(task_name, ""), "local") \
+            if self.alloc_dir is not None else None
+        return cwd, env.build_env() if env is not None else None
 
     def _alloc_status(self) -> tuple:
         """Aggregate task states -> alloc client status

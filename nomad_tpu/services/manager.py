@@ -276,4 +276,10 @@ class ServiceManager:
                 for reg in upserts:
                     if reg.ID in self._instances:
                         self._dirty.add(reg.ID)
-                self._deletes.update(deletes)
+                # Only re-queue deletes still absent from _instances: a
+                # registration re-registered between the failed sync and the
+                # retry must not get a delete racing its upsert (the FSM
+                # applies upserts then deletes, which would deregister the
+                # live service until the next anti-entropy full sync).
+                self._deletes.update(
+                    rid for rid in deletes if rid not in self._instances)

@@ -297,11 +297,21 @@ class StateStore(_ReadAPI):
 
     # ------------------------------------------------------- service registry
     def upsert_services(self, index: int, regs: List) -> None:
-        """Write service registrations (client sync / server self-reg)."""
+        """Write service registrations (client sync / server self-reg).
+
+        Identical payloads are skipped entirely: clients re-push ALL of
+        their registrations every anti-entropy full sync, and rewriting
+        an unchanged registration would bump the services table index —
+        waking every blocking query on the name and replaying a no-op
+        through every watcher — at a cadence of once per 30s per node.
+        """
         with self._lock:
             watch_items = Items()
+            touched = False
             for reg in regs:
                 existing = self._get("services", reg.ID)
+                if existing is not None and self._service_equal(existing, reg):
+                    continue
                 reg.CreateIndex = (existing.CreateIndex if existing is not None
                                    else index)
                 reg.ModifyIndex = index
@@ -310,7 +320,22 @@ class StateStore(_ReadAPI):
                 self._member_add("service_node", reg.NodeID, reg.ID)
                 self._member_add("service_alloc", reg.AllocID, reg.ID)
                 watch_items.add(Item(service_name=reg.ServiceName))
-            self._commit(index, ["services"], watch_items)
+                touched = True
+            if touched:
+                self._commit(index, ["services"], watch_items)
+
+    @staticmethod
+    def _service_equal(a, b) -> bool:
+        """Content equality modulo raft indexes (which the store assigns)."""
+        return (a.ServiceName == b.ServiceName and a.Tags == b.Tags
+                and a.JobID == b.JobID and a.AllocID == b.AllocID
+                and a.TaskName == b.TaskName and a.NodeID == b.NodeID
+                and a.Address == b.Address and a.Port == b.Port
+                and a.Status == b.Status
+                # Modulo Timestamp: every check run re-stamps its state, so
+                # including it would defeat the dedup for any checked service.
+                and [(c.Name, c.Type, c.Status, c.Output) for c in a.Checks]
+                == [(c.Name, c.Type, c.Status, c.Output) for c in b.Checks])
 
     def delete_services(self, index: int, reg_ids: List[str]) -> None:
         with self._lock:

@@ -59,22 +59,28 @@ def _ensure_native_executor():
 _ensure_native_executor()
 
 
-# One retry for the cluster/timing suites: they assert distributed
-# properties (elections, gossip convergence, task execution) under real
-# threads and real sockets, and a loaded CI machine can stretch past any
-# fixed margin. A genuine regression fails both attempts; a scheduler
-# hiccup doesn't fail `pytest -x`. Reruns are reported loudly.
-_RETRY_FILES = {
-    "test_membership.py", "test_raft_server.py", "test_raft.py",
-    "test_rpc.py", "test_distributed_workers.py", "test_gossip.py",
-    "test_server.py", "test_client.py", "test_agent_http.py",
-    "test_services.py", "test_pipelined_worker.py", "test_telemetry.py",
-    "test_client_stats.py",
-}
+# One retry for timing-sensitive tests that OPT IN via
+# @pytest.mark.timing_retry (or a module-level `pytestmark`): they assert
+# distributed properties (elections, gossip convergence, task execution)
+# under real threads and real sockets, and a loaded CI machine can stretch
+# past any fixed margin. A genuine regression fails both attempts; a
+# scheduler hiccup doesn't fail `pytest -x`. Reruns are reported loudly.
+# Marker-based (not per-file) so that new deterministic logic in a file
+# that merely CONTAINS some timing tests isn't laundered through a rerun.
+# Deliberately UNMARKED: test_server.py, test_services.py,
+# test_pipelined_worker.py — the subsystems under heaviest active change;
+# a new ~50% race there must fail CI, not pass on the second try. Mark
+# individual tests in those files if a specific assertion proves flaky.
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "timing_retry: retry this timing-sensitive test once on failure")
 
 
 def pytest_runtest_protocol(item, nextitem):
-    if os.path.basename(str(item.fspath)) not in _RETRY_FILES:
+    if item.get_closest_marker("timing_retry") is None:
         return None
     from _pytest.runner import runtestprotocol
 

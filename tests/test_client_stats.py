@@ -2,6 +2,8 @@
 (reference: client/driver/executor/executor.go:36-41, /v1/client/allocation/
 <id>/stats)."""
 
+import pytest
+
 import os
 import subprocess
 import time
@@ -10,6 +12,8 @@ from nomad_tpu.client.stats import TaskStatsTracker, sample_pid_tree
 
 
 from helpers import wait_for  # noqa: E402
+
+pytestmark = pytest.mark.timing_retry  # real timers/sockets: one retry
 
 class TestPidTreeSampling:
     def test_samples_own_process_group(self):

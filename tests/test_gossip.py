@@ -21,6 +21,8 @@ from nomad_tpu.gossip import (
 
 from helpers import wait_for  # noqa: E402
 
+pytestmark = pytest.mark.timing_retry  # networked cluster suite: one retry
+
 def make(name, events=None, tags=None):
     cb = None
     if events is not None:

@@ -27,6 +27,8 @@ from nomad_tpu.raft.transport import BoundTransport
 
 from helpers import wait_for  # noqa: E402
 
+pytestmark = pytest.mark.timing_retry  # networked cluster suite: one retry
+
 FAST = RaftConfig(heartbeat_interval=0.02, election_timeout_min=0.06,
                   election_timeout_max=0.12, apply_timeout=5.0)
 

@@ -104,6 +104,36 @@ class TestRegistryState:
         fsm.apply(3, MessageType.ServiceSync, {"Upserts": [reg()]})
         assert ev.is_set()
 
+    def test_identical_upsert_is_a_noop(self):
+        """Anti-entropy full syncs re-push every registration ~30s; an
+        unchanged payload must not bump indexes or wake blocking watchers."""
+        from nomad_tpu.state.watch import Item
+
+        fsm = FSM()
+        first = reg(Status=CheckStatusPassing,
+                    Checks=[CheckState(Name="c", Status=CheckStatusPassing,
+                                       Timestamp=1.0)])
+        fsm.apply(10, MessageType.ServiceSync, {"Upserts": [first]})
+        ev = threading.Event()
+        fsm.state.watch([Item(service_name="web")], ev)
+
+        # Same content, fresh check timestamp (every run re-stamps it).
+        dup = reg(Status=CheckStatusPassing,
+                  Checks=[CheckState(Name="c", Status=CheckStatusPassing,
+                                     Timestamp=99.0)])
+        fsm.apply(11, MessageType.ServiceSync, {"Upserts": [dup]})
+        assert not ev.is_set()
+        assert fsm.state.get_index("services") == 10
+        assert fsm.state.service_by_id("r1").ModifyIndex == 10
+
+        # A REAL change (check went critical) still writes + notifies.
+        changed = reg(Status=CheckStatusCritical,
+                      Checks=[CheckState(Name="c",
+                                         Status=CheckStatusCritical)])
+        fsm.apply(12, MessageType.ServiceSync, {"Upserts": [changed]})
+        assert ev.is_set()
+        assert fsm.state.service_by_id("r1").ModifyIndex == 12
+
 
 class TestRegistryWire:
     def test_registration_codec_roundtrip(self):
@@ -266,6 +296,39 @@ class TestServiceManager:
         web_id = f"_nomad-task-{alloc.ID}-{task.Name}-web"
         assert ups[web_id].Tags == ["v2"] or wait_for(
             lambda: flat()[0][web_id].Tags == ["v2"])
+        mgr.shutdown()
+
+    def test_failed_flush_retry_skips_reregistered_deletes(self):
+        """A delete that failed to sync must NOT be retried once the same
+        ID has been re-registered — the upsert+delete pair would land in
+        one batch and the FSM (upserts, then deletes) would deregister the
+        live service until the next anti-entropy full sync."""
+        fail = [True]
+        synced = []
+
+        def sync_fn(up, de):
+            if fail[0]:
+                raise ConnectionError("leader unreachable")
+            synced.append((up, de))
+
+        mgr = ServiceManager(_node(), sync_fn)
+        alloc = mock.alloc()
+        task = alloc.Job.TaskGroups[0].Tasks[0]
+        task.Services = [Service(Name="web", PortLabel="")]
+        rid = f"_nomad-task-{alloc.ID}-{task.Name}-web"
+
+        mgr.register_task(alloc, task)
+        mgr._flush()                      # upsert lost (sync down)
+        mgr.deregister_task(alloc.ID, task.Name)
+        mgr._flush()                      # delete lost too, queued for retry
+        mgr.register_task(alloc, task)    # service comes back
+        fail[0] = False
+        mgr._flush()
+
+        ups = {r.ID for up, _ in synced for r in up}
+        des = {d for _, de in synced for d in de}
+        assert rid in ups
+        assert rid not in des             # stale delete was dropped
         mgr.shutdown()
 
     def test_check_failure_triggers_restart(self, http_target):

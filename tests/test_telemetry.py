@@ -2,6 +2,8 @@
 advancing through a real scheduling cycle (reference shapes: go-metrics
 inmem/statsd behavior; EmitStats gauges of eval_broker.go:650-662)."""
 
+import pytest
+
 import socket
 import time
 
@@ -12,6 +14,8 @@ from nomad_tpu.telemetry.metrics import InMemSink, MetricsRegistry, StatsdSink
 
 
 from helpers import wait_for  # noqa: E402
+
+pytestmark = pytest.mark.timing_retry  # real timers/sockets: one retry
 
 class TestInMemSink:
     def test_gauge_keeps_last_value(self):

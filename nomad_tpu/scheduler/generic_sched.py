@@ -169,13 +169,18 @@ def build_placement_allocs(eval: Evaluation, job: Job, ctx: EvalContext,
 class GenericScheduler:
     def __init__(self, state: State, planner: Planner,
                  tindex: Optional[TensorIndex], logger: logging.Logger,
-                 batch: bool, rng: Optional[random.Random] = None):
+                 batch: bool, rng: Optional[random.Random] = None,
+                 impl: str = "tpu"):
         self.state = state
         self.planner = planner
         self.tindex = tindex
         self.logger = logger
         self.batch = batch
         self.rng = rng or random.Random()
+        # "tpu" (device placement kernels) or "cpu-reference" (the
+        # reference's host-side iterator chain) — the benchmark denominator
+        # runs through this seam so both engines share every other stage.
+        self.impl = impl
 
         self.eval: Optional[Evaluation] = None
         self.job: Optional[Job] = None
@@ -245,9 +250,16 @@ class GenericScheduler:
         self.plan = self.eval.make_plan(self.job)
         self.failed_tg_allocs = {}
         self.ctx = EvalContext(self.state, self.plan, self.logger)
-        if self.tindex is None:
-            self.tindex = TensorIndex.from_state(self.state)
-        self.stack = GenericStack(self.ctx, self.tindex, self.batch, self.rng)
+        if self.impl == "cpu-reference":
+            from .cpu_reference import CPUReferenceServedStack
+
+            self.stack = CPUReferenceServedStack(self.ctx, self.batch,
+                                                 self.rng)
+        else:
+            if self.tindex is None:
+                self.tindex = TensorIndex.from_state(self.state)
+            self.stack = GenericStack(self.ctx, self.tindex, self.batch,
+                                      self.rng)
         if self.job is not None:
             self.stack.set_job(self.job)
 

@@ -47,31 +47,39 @@ class SetStatusError(Exception):
 
 
 def new_scheduler(name: str, state: State, planner: Planner,
-                  tindex=None, logger: Optional[logging.Logger] = None) -> Scheduler:
+                  tindex=None, logger: Optional[logging.Logger] = None,
+                  impl: str = "tpu") -> Scheduler:
     """(reference: scheduler.go:30-41 NewScheduler)
 
     tindex is the TensorIndex backing the placement kernels; when None, one is
-    built from the state snapshot (simple mode for tests/tools).
+    built from the state snapshot (simple mode for tests/tools). impl selects
+    the placement engine for the generic schedulers: "tpu" (device kernels)
+    or "cpu-reference" (host-side iterator chain, the benchmark denominator).
     """
     factory = BUILTIN_SCHEDULERS.get(name)
     if factory is None:
         raise ValueError(f"unknown scheduler '{name}'")
-    return factory(state, planner, tindex, logger or logging.getLogger("sched"))
+    return factory(state, planner, tindex,
+                   logger or logging.getLogger("sched"), impl)
 
 
-def _service(state, planner, tindex, logger):
+def _service(state, planner, tindex, logger, impl="tpu"):
     from .generic_sched import GenericScheduler
 
-    return GenericScheduler(state, planner, tindex, logger, batch=False)
+    return GenericScheduler(state, planner, tindex, logger, batch=False,
+                            impl=impl)
 
 
-def _batch(state, planner, tindex, logger):
+def _batch(state, planner, tindex, logger, impl="tpu"):
     from .generic_sched import GenericScheduler
 
-    return GenericScheduler(state, planner, tindex, logger, batch=True)
+    return GenericScheduler(state, planner, tindex, logger, batch=True,
+                            impl=impl)
 
 
-def _system(state, planner, tindex, logger):
+def _system(state, planner, tindex, logger, impl="tpu"):
+    # The system scheduler's per-node sweep is host-side already; it has no
+    # separate cpu-reference engine, so impl is accepted but moot.
     from .system_sched import SystemScheduler
 
     return SystemScheduler(state, planner, tindex, logger)

@@ -114,6 +114,10 @@ class PreparedBatch:
     noise_vec: np.ndarray         # [N] f32 tie-break jitter
     tg_mask_sums: np.ndarray      # [U] eligible-node count per unique TG
     cand_sum: int                 # candidate node count (metrics base)
+    # Memo of the resolved device-side inputs for the unmodified first
+    # dispatch (no bans/placed overlays): a window re-dispatching an
+    # identical prep skips the content-hash lookups entirely.
+    dev_inputs: Optional[tuple] = None
 
 
 def _pad_pow2(n: int, floor: int = 8) -> int:
@@ -310,6 +314,14 @@ class GenericStack:
             usage = usage.at[prep.evict_rows].add(-prep.evict_vecs)
         if placed_usage is not None and placed_usage.any():
             usage = usage + jnp.asarray(placed_usage)
+
+        pristine = (banned is None and placed_usage is None
+                    and placed_counts is None and placed_hosts is None
+                    and keep is None)
+        if pristine and prep.dev_inputs is not None:
+            return kernels.place_batch(d["capacity"], d["score_cap"], usage,
+                                       *prep.dev_inputs)
+
         masks = prep.tg_masks
         if banned is not None and banned.any():
             masks = masks & ~banned[None, :]
@@ -332,14 +344,16 @@ class GenericStack:
         # a registration storm re-dispatches with byte-identical masks/demands/
         # zero-count/zero-host arrays, so steady state pays ZERO host->device
         # puts per eval (each put is a full RTT on remote-attached TPUs).
-        return kernels.place_batch(
-            d["capacity"], d["score_cap"], usage, _dev_cache.get(masks),
-            _dev_cache.get(counts_now), _dev_cache.get(prep.demands),
-            _dev_cache.get(prep.tg_ids), _dev_cache.get(sel_valid),
-            _dev_cache.get(prep.noise_vec),
-            _dev_cache.get(np.float32(prep.penalty)),
-            _dev_cache.get(np.asarray(prep.distinct)),
-            _dev_cache.get(hosts))
+        dev = (_dev_cache.get(masks),
+               _dev_cache.get(counts_now), _dev_cache.get(prep.demands),
+               _dev_cache.get(prep.tg_ids), _dev_cache.get(sel_valid),
+               _dev_cache.get(prep.noise_vec),
+               _dev_cache.get(np.float32(prep.penalty)),
+               _dev_cache.get(np.asarray(prep.distinct)),
+               _dev_cache.get(hosts))
+        if pristine:
+            prep.dev_inputs = dev
+        return kernels.place_batch(d["capacity"], d["score_cap"], usage, *dev)
 
     def collect(self, prep: PreparedBatch, packed: np.ndarray,
                 results: List[Optional[SelectedOption]],
@@ -354,33 +368,65 @@ class GenericStack:
         scores = packed[:, 1]
         n_feasible = packed[:, 2].astype(np.int32)
 
+        # Hot loop: a storm window runs this for thousands of placements, so
+        # locals are hoisted and the accumulator writes are batched into one
+        # np.add.at per array after the loop.
+        node_of = nt.node_of
+        nodes_by_id = self._nodes_by_id
+        tg_index = prep.tg_index
+        tgs = prep.tgs
+        metrics_ = self.ctx.metrics
+        score_node = metrics_.score_node
+        chosen_list = chosen.tolist()
+        scores_list = scores.tolist()
+
         failed_rows: set = set()
         next_remaining: List[int] = []
-        for p in list(remaining):
-            row = int(chosen[p])
-            ti = prep.tg_index[prep.tgs[p].Name]
-            self._fill_metrics(prep, ti, int(n_feasible[p]))
+        placed_ps: List[int] = []
+        placed_rows: List[int] = []
+        last_fill = None
+
+        def flush_placed():
+            # Exhaustion diagnostics read placed_usage, so the batched
+            # accumulator writes must land before any _note_exhaustion.
+            if placed_rows:
+                rows_arr = np.asarray(placed_rows, dtype=np.int64)
+                np.add.at(placed_usage, rows_arr, prep.demands[placed_ps])
+                np.add.at(placed_counts, rows_arr, 1)
+                placed_hosts[rows_arr] = True
+                placed_ps.clear()
+                placed_rows.clear()
+
+        for p in remaining:
+            row = chosen_list[p]
+            ti = tg_index[tgs[p].Name]
+            last_fill = (ti, int(n_feasible[p]))
             if row < 0:
-                self._note_exhaustion(prep.tgs[p], prep.tg_masks[ti],
+                self._fill_metrics(prep, ti, int(n_feasible[p]))
+                flush_placed()
+                self._note_exhaustion(tgs[p], prep.tg_masks[ti],
                                       prep.tg_demands[ti], prep, placed_usage)
                 continue  # infeasible: stays None
-            node_id = nt.node_of[row]
-            node = self._nodes_by_id.get(node_id)
+            node = nodes_by_id.get(node_of[row])
             if node is None:
                 failed_rows.add(row)
                 next_remaining.append(p)
                 continue
-            option = self._assign_networks(node, prep.tgs[p],
-                                           float(scores[p]))
+            option = self._assign_networks(node, tgs[p], scores_list[p])
             if option is None:
                 failed_rows.add(row)
                 next_remaining.append(p)
                 continue
             results[p] = option
-            self.ctx.metrics.score_node(node, "binpack", float(scores[p]))
-            placed_usage[row] += prep.demands[p]
-            placed_counts[row] += 1
-            placed_hosts[row] = True
+            score_node(node, "binpack", scores_list[p])
+            placed_ps.append(p)
+            placed_rows.append(row)
+        if last_fill is not None:
+            # Metric fields are overwritten per placement, so only the last
+            # one's values survive the reference loop — reproduce that state
+            # with a single fill.
+            self._fill_metrics(prep, *last_fill)
+        flush_placed()
         return failed_rows, next_remaining
 
     # ------------------------------------------------------------- helpers

@@ -21,6 +21,17 @@ updates) through a device-resident pipeline:
   4. eval status updates for the window are applied through consensus as ONE
      EvalUpdate batch, then everything acks
 
+Windows OVERLAP: a finisher thread owns steps 2-4 while the run loop
+dispatches the next window, chaining its kernels on the previous window's
+device-side usage tail. On a remote-attached TPU both the window's readback
+and the dirty-row table refresh are full network round trips; overlap hides
+the readback behind the next window's host work, and chaining makes the
+usage refresh skippable entirely mid-storm (node_table.device_arrays
+skip_usage). The chain rebases to committed state whenever the pipeline
+drains (and on node-table resize), so drift is bounded by the storm length;
+oversubscription is impossible regardless — the plan applier re-verifies
+every placement against committed state.
+
 Anything not pure-placement — updates, migrations, stops, system jobs, core
 GC, deregisters, annotate requests — falls back to the exact per-eval
 GenericScheduler path (scheduler/generic_sched.py), as does any eval whose
@@ -32,7 +43,9 @@ only accelerates evals whose outcome is provably the same.
 from __future__ import annotations
 
 import logging
+import queue
 import random
+import threading
 import time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
@@ -64,6 +77,7 @@ from nomad_tpu.structs.structs import (
     JobTypeService,
 )
 
+from .eval_broker import NotOutstandingError, TokenMismatchError
 from .fsm import MessageType
 from .worker import DEQUEUE_TIMEOUT, Worker
 
@@ -90,6 +104,54 @@ class _FastEval:
     stale: bool = False           # redelivered mid-window: abandoned
 
 
+@dataclass
+class _WindowWork:
+    """One dispatched window flowing through the drain -> build stages."""
+
+    fast: List[_FastEval]
+    slow: List[Tuple[Evaluation, str]]
+    packed: Optional[List[np.ndarray]] = None  # set by the drain stage
+    failed: bool = False                       # drain blew up: nack window
+
+
+# Force a pipeline drain + chain rebase after this many chained windows: the
+# chain misses slow-path/fallback commits (undercount — the applier catches
+# any oversubscription) and evictions (overcount — spurious blocked evals),
+# so its drift is bounded even through a storm that never pauses.
+_REBASE_WINDOWS = 256
+
+
+def _prep_sig(job, place, batch: bool) -> Optional[tuple]:
+    """Value signature of a prepared batch: two jobs with equal constraints,
+    task shapes, and placement sequence produce byte-identical device inputs,
+    so their PreparedBatch can be shared within a window. Returns None when
+    sharing is unsafe (network asks need per-node port bookkeeping)."""
+    tg_sigs = {}
+    names = []
+    for t in place:
+        tg = t.TaskGroup
+        names.append(tg.Name)
+        if tg.Name in tg_sigs:
+            continue
+        tasks = []
+        for task in tg.Tasks:
+            r = task.Resources
+            if r is not None and r.Networks:
+                return None
+            tasks.append((task.Name, task.Driver,
+                          (r.CPU, r.MemoryMB, r.DiskMB, r.IOPS)
+                          if r is not None else None,
+                          tuple((c.LTarget, c.Operand, c.RTarget)
+                                for c in task.Constraints)))
+        tg_sigs[tg.Name] = (
+            tuple(tasks),
+            tuple((c.LTarget, c.Operand, c.RTarget) for c in tg.Constraints))
+    return (batch,
+            tuple((c.LTarget, c.Operand, c.RTarget) for c in job.Constraints),
+            tuple(names),
+            tuple(sorted(tg_sigs.items())))
+
+
 class PipelinedWorker(Worker):
     """Drop-in Worker with windowed device-chained placement."""
 
@@ -99,28 +161,141 @@ class PipelinedWorker(Worker):
         self._noise: Optional[np.ndarray] = None
         # Observability: how evals flowed (fast = device-chained window,
         # slow = per-eval GenericScheduler, fallback = fast dispatch that
-        # re-ran slow after partial commit / port collision).
-        self.stats = {"fast": 0, "slow": 0, "fallback": 0, "windows": 0}
+        # re-ran slow after partial commit / port collision) and where the
+        # wall-clock went (t_*_ms phase totals across both threads).
+        self.stats = {"fast": 0, "slow": 0, "fallback": 0, "windows": 0,
+                      "rebases": 0, "t_refresh_ms": 0.0, "t_dispatch_ms": 0.0,
+                      "t_drain_ms": 0.0, "t_build_ms": 0.0,
+                      "t_planwait_ms": 0.0, "t_evalupd_ms": 0.0,
+                      "t_slow_ms": 0.0}
+        # Cross-window device usage chain (usage_after of the last dispatched
+        # fast eval). None = next window reads committed usage from the table.
+        self._chain = None
+        self._chained_windows = 0
+        # Stage handoffs: dispatch -> drain -> build, one window queued per
+        # seam. The drain stage spends its time in a device readback (GIL
+        # released) while the build stage runs host Python — splitting them
+        # lets window N+1's readback ride under window N's plan building.
+        self._drain_q: "queue.Queue[Optional[_WindowWork]]" = queue.Queue(
+            maxsize=1)
+        self._build_q: "queue.Queue[Optional[_WindowWork]]" = queue.Queue(
+            maxsize=1)
+        self._pending_windows = 0
+        self._pending_lock = threading.Lock()
+        self._drained = threading.Event()
+        self._drained.set()
 
     # -------------------------------------------------------------- run loop
     def run(self) -> None:
-        while not self._stop.is_set():
-            if self._paused.is_set():
-                time.sleep(0.05)
-                continue
-            batch = self._dequeue_window()
-            if not batch:
+        drainer = threading.Thread(target=self._drain_loop, daemon=True,
+                                   name="pipelined-drain")
+        builder = threading.Thread(target=self._build_loop, daemon=True,
+                                   name="pipelined-build")
+        drainer.start()
+        builder.start()
+        try:
+            while not self._stop.is_set():
+                if self._paused.is_set():
+                    time.sleep(0.05)
+                    continue
+                batch = self._dequeue_window()
+                if not batch:
+                    continue
+                work = None
+                try:
+                    work = self._dispatch_window(batch)
+                except Exception:
+                    # Broker/plan-queue teardown on leadership loss: drop
+                    # quietly, redelivery handles the rest (worker.go:88-99).
+                    if self._stop.is_set() or not self.eval_broker.enabled():
+                        continue
+                    logger.exception("pipelined worker: dispatch failed")
+                    for ev, token in batch:
+                        self._send_nack(ev.ID, token)
+                if work is not None:
+                    with self._pending_lock:
+                        self._pending_windows += 1
+                        self._drained.clear()
+                    self._drain_q.put(work)
+        finally:
+            self._drain_q.put(None)
+            drainer.join(timeout=60.0)
+            builder.join(timeout=60.0)
+
+    def _reset_window_deadlines(self, work: _WindowWork) -> None:
+        """Push the broker nack deadline out for every live eval of the
+        window. A window can now wait behind two others' drain+build stages
+        (cold compiles take tens of seconds), so each stage entry re-arms
+        the deadline the way the pre-split loop's single pass did. An eval
+        already redelivered is marked stale here — its device work is
+        abandoned rather than racing another worker's."""
+        for rec in work.fast:
+            if rec.stale:
                 continue
             try:
-                self._process_window(batch)
+                self.eval_broker.outstanding_reset(rec.ev.ID, rec.token)
+            except (NotOutstandingError, TokenMismatchError) as e:
+                logger.debug("eval %s redelivered between stages (%s)",
+                             rec.ev.ID, e)
+                rec.stale = True
             except Exception:
-                # Broker/plan-queue teardown on leadership loss: drop quietly,
-                # redelivery handles the rest (worker.go:88-99).
-                if self._stop.is_set() or not self.eval_broker.enabled():
-                    continue
-                logger.exception("pipelined worker: window failed")
-                for ev, token in batch:
-                    self._send_nack(ev.ID, token)
+                return  # broker teardown: downstream handling owns it
+
+    def _drain_loop(self) -> None:
+        """Stage 2: block on each window's device readback (a full network
+        round trip on remote-attached TPUs), then hand off host-side."""
+        while True:
+            work = self._drain_q.get()
+            if work is None:
+                self._build_q.put(None)
+                return
+            self._reset_window_deadlines(work)
+            try:
+                if work.fast:
+                    t0 = time.perf_counter()
+                    work.packed = self._drain_window(
+                        [rec.res for rec in work.fast])
+                    self.stats["t_drain_ms"] += \
+                        (time.perf_counter() - t0) * 1e3
+            except Exception:
+                work.failed = True
+                if not (self._stop.is_set()
+                        or not self.eval_broker.enabled()):
+                    logger.exception("pipelined worker: window drain failed")
+            self._build_q.put(work)
+
+    def _build_loop(self) -> None:
+        """Stage 3: plan build/submit -> status batch -> acks, plus the
+        slow-path evals of the window."""
+        while True:
+            work = self._build_q.get()
+            if work is None:
+                return
+            self._reset_window_deadlines(work)
+            try:
+                if work.failed:
+                    raise RuntimeError("window drain failed")
+                if work.fast:
+                    self._finish_fast(work.fast, work.packed)
+                t0 = time.perf_counter()
+                for ev, token in work.slow:
+                    self._process_slow(ev, token)
+                self.stats["t_slow_ms"] += (time.perf_counter() - t0) * 1e3
+            except Exception:
+                if not (self._stop.is_set()
+                        or not self.eval_broker.enabled()):
+                    logger.exception("pipelined worker: window finish failed")
+                    # Nack everything; already-acked/stale evals surface as
+                    # NotOutstanding races that _send_nack logs at debug.
+                    for rec in work.fast:
+                        self._send_nack(rec.ev.ID, rec.token)
+                    for ev, token in work.slow:
+                        self._send_nack(ev.ID, token)
+            finally:
+                with self._pending_lock:
+                    self._pending_windows -= 1
+                    if self._pending_windows == 0:
+                        self._drained.set()
 
     def _dequeue_window(self) -> List[Tuple[Evaluation, str]]:
         got = self._dequeue_evaluation()
@@ -139,7 +314,8 @@ class PipelinedWorker(Worker):
         return batch
 
     # ------------------------------------------------------------ the window
-    def _process_window(self, batch: List[Tuple[Evaluation, str]]) -> None:
+    def _dispatch_window(self, batch: List[Tuple[Evaluation, str]]
+                         ) -> Optional[_WindowWork]:
         # The window is in hand: push every eval's nack deadline out NOW.
         # Filling + dispatching + draining a cold window (first compiles)
         # can exceed the redelivery timeout (reference: worker.go heartbeats
@@ -147,8 +323,6 @@ class PipelinedWorker(Worker):
         # already redelivered belongs to another worker — drop it here
         # rather than paying a device dispatch that the token check will
         # reject anyway.
-        from .eval_broker import NotOutstandingError, TokenMismatchError
-
         live: List[Tuple[Evaluation, str]] = []
         for ev, token in batch:
             try:
@@ -159,13 +333,21 @@ class PipelinedWorker(Worker):
                              ev.ID, e)
         batch = live
         if not batch:
-            return
+            return None
         self._wait_for_index(max(ev.ModifyIndex for ev, _ in batch))
         snap = self.raft.fsm.state.snapshot()
+        t0 = time.perf_counter()
+
+        nt = self.tindex.nt
+        usage_chain = self._usage_chain(nt)
+        # With a live chain the device usage array is dead weight: skip its
+        # dirty-row flush (one blocking host->device RTT mid-storm) and
+        # refresh only capacity/readiness changes.
+        tables = nt.device_arrays(skip_usage=usage_chain is not None)
+        self.stats["t_refresh_ms"] += (time.perf_counter() - t0) * 1e3
 
         fast: List[_FastEval] = []
         slow: List[Tuple[Evaluation, str]] = []
-        usage_chain = None
         # Shared per-window: every eval sees the same snapshot, so the ready
         # node list, candidate mask, class-eligibility cache, AND the node
         # table's device arrays (whose dirty-row refresh is a blocking
@@ -173,8 +355,6 @@ class PipelinedWorker(Worker):
         # eval. The tie-break noise is refreshed every 64 windows — enough
         # to spread load across ties without paying an upload per window.
         node_cache: Dict[tuple, tuple] = {}
-        nt = self.tindex.nt
-        tables = nt.device_arrays()
         if self._noise is None or self._noise.shape[0] != nt.n_rows \
                 or self.stats["windows"] % 64 == 0:
             from nomad_tpu.scheduler.stack import make_noise_vec
@@ -194,12 +374,46 @@ class PipelinedWorker(Worker):
                 usage_chain = rec.res.usage_after
                 fast.append(rec)
 
+        if fast:
+            # Next window chains on this one's device-side usage tail even
+            # though its plans haven't committed yet.
+            self._chain = usage_chain
+            self._chained_windows += 1
         self.stats["windows"] += 1
         self.stats["slow"] += len(slow)
-        if fast:
-            self._finish_fast(fast)
-        for ev, token in slow:
-            self._process_slow(ev, token)
+        self.stats["t_dispatch_ms"] += (time.perf_counter() - t0) * 1e3
+        return _WindowWork(fast=fast, slow=slow)
+
+    def quiesce(self, timeout: float = 30.0) -> bool:
+        """Wait until every dispatched window has fully finished (drained,
+        built, acked). For tests/benchmarks that read or reset `stats`:
+        eval completion becomes visible at the EvalUpdate apply, which is
+        BEFORE the build stage's final stats writes for that window."""
+        return self._drained.wait(timeout)
+
+    def _usage_chain(self, nt):
+        """The usage array this window's kernels start from: the previous
+        window's device-side tail while windows are in flight, or None
+        (= committed usage from the table) after a rebase."""
+        chain = self._chain
+        if chain is not None and chain.shape[0] != nt.n_rows:
+            chain = None  # table resized: rows moved under the chain
+        if chain is not None and self._chained_windows >= _REBASE_WINDOWS:
+            # Bound chain drift: drain the pipeline, then restart from
+            # committed state.
+            self._drained.wait(timeout=60.0)
+            chain = None
+        if chain is not None and self._drained.is_set():
+            # Pipeline is empty: everything this chain carries has committed
+            # into the host mirror, so committed state is strictly fresher
+            # (it also includes slow-path/fallback commits the chain missed).
+            chain = None
+        if chain is None:
+            if self._chain is not None:
+                self.stats["rebases"] += 1
+            self._chain = None
+            self._chained_windows = 0
+        return chain
 
     def _try_dispatch_fast(self, ev: Evaluation, token: str, snap,
                            usage_chain,
@@ -213,6 +427,7 @@ class PipelinedWorker(Worker):
             return None
         if ev.TriggeredBy not in _HANDLED_TRIGGERS or ev.AnnotatePlan:
             return None
+        td0 = time.perf_counter()
         job = snap.job_by_id(ev.JobID)
         if job is None:
             return None
@@ -226,8 +441,13 @@ class PipelinedWorker(Worker):
         # rolling-limit semantics the per-eval path owns.
         if diff.update or diff.migrate or diff.stop or not diff.place:
             return None
+        td1 = time.perf_counter()
+        self.stats["t_diff_ms"] = self.stats.get("t_diff_ms", 0.0) \
+            + (td1 - td0) * 1e3
 
-        plan = ev.make_plan(job)
+        # Alias the snapshot's job into the plan (no deep copy): committed
+        # jobs are value-frozen in the state store and the plan only reads.
+        plan = ev.make_plan(job, copy_job=False)
         ctx = EvalContext(snap, plan, logger)
         stack = GenericStack(ctx, self.tindex, batch)
         dc_key = tuple(sorted(job.Datacenters))
@@ -244,24 +464,40 @@ class PipelinedWorker(Worker):
                 if row is not None:
                     cand_mask[row] = True
             elig = ClassEligibility(nt, nodes)
-            cached = (nodes_by_id, cand_mask, elig, by_dc)
+            cached = (nodes_by_id, cand_mask, elig, by_dc, {})
             node_cache[dc_key] = cached
-        nodes_by_id, cand_mask, elig, by_dc = cached
+        nodes_by_id, cand_mask, elig, by_dc, prep_cache = cached
         if not nodes_by_id:
             return None
         stack.job = job
         stack.adopt_nodes(nodes_by_id, cand_mask, elig)
         ctx.metrics.NodesAvailable = by_dc
 
-        prep = stack.prepare_batch([t.TaskGroup for t in diff.place],
-                                   noise_vec=noise_vec)
+        td2 = time.perf_counter()
+        # A storm re-submits value-identical jobs: share the whole prepared
+        # batch (and its resolved device inputs) across them. Only sound
+        # when the job has no prior allocs (zero anti-affinity/banned base).
+        sig = None if allocs else _prep_sig(job, diff.place, batch)
+        prep = prep_cache.get(sig) if sig is not None else None
+        if prep is None:
+            prep = stack.prepare_batch([t.TaskGroup for t in diff.place],
+                                       noise_vec=noise_vec)
+            if sig is not None:
+                prep_cache[sig] = prep
+        td3 = time.perf_counter()
+        self.stats["t_prep_ms"] = self.stats.get("t_prep_ms", 0.0) \
+            + (td3 - td2) * 1e3
         res = stack.dispatch(prep, usage_override=usage_chain, tables=tables)
+        self.stats["t_launch_ms"] = self.stats.get("t_launch_ms", 0.0) \
+            + (time.perf_counter() - td3) * 1e3
         return _FastEval(ev=ev, token=token, plan=plan, ctx=ctx, stack=stack,
                          prep=prep, place=diff.place, res=res)
 
-    def _finish_fast(self, fast: List[_FastEval]) -> None:
-        """Readback once, build + submit plans, wait, batch status updates."""
-        packed = self._drain_window([rec.res for rec in fast])
+    def _finish_fast(self, fast: List[_FastEval],
+                     packed: List[np.ndarray]) -> None:
+        """Build + submit plans, wait, batch status updates (packed results
+        already drained by stage 2)."""
+        t1 = time.perf_counter()
 
         # Build and enqueue plans back-to-back: the applier verifies plan i
         # while we materialize plan i+1's ports host-side.
@@ -271,6 +507,9 @@ class PipelinedWorker(Worker):
         # diagnostics diff against the usage the kernel actually saw.
         window_usage = np.zeros((nt.n_rows, RES_DIMS), dtype=np.float32)
         for rec, pk in zip(fast, packed):
+            if rec.stale:
+                continue  # redelivered between stages: abandoned
+            tc0 = time.perf_counter()
             results = [None] * len(rec.prep.tgs)
             placed_counts = np.zeros(nt.n_rows, dtype=np.int32)
             placed_hosts = np.zeros(nt.n_rows, dtype=bool)
@@ -287,9 +526,14 @@ class PipelinedWorker(Worker):
                 # path's banned-row retry loop owns it.
                 rec.fallback = True
                 continue
+            tc1 = time.perf_counter()
+            self.stats["t_collect_ms"] = self.stats.get("t_collect_ms", 0.0) \
+                + (tc1 - tc0) * 1e3
             build_placement_allocs(rec.ev, rec.plan.Job, rec.ctx,
                                    rec.place, results, rec.plan,
                                    rec.failed_tg_allocs)
+            self.stats["t_bpa_ms"] = self.stats.get("t_bpa_ms", 0.0) \
+                + (time.perf_counter() - tc1) * 1e3
             if rec.plan.is_no_op() and not rec.failed_tg_allocs:
                 rec.fallback = True  # nothing placeable; let sync path decide
                 continue
@@ -307,6 +551,9 @@ class PipelinedWorker(Worker):
             except Exception:
                 logger.exception("plan enqueue failed for eval %s", rec.ev.ID)
                 rec.fallback = True
+
+        t2 = time.perf_counter()
+        self.stats["t_build_ms"] += (t2 - t1) * 1e3
 
         # Wait for the applier; anything not fully committed re-runs sync.
         eval_updates: List[Evaluation] = []
@@ -331,8 +578,11 @@ class PipelinedWorker(Worker):
             eval_updates.extend(self._status_evals(rec))
             done.append(rec)
 
+        t3 = time.perf_counter()
+        self.stats["t_planwait_ms"] += (t3 - t2) * 1e3
         if eval_updates:
             self.raft.apply(MessageType.EvalUpdate, {"Evals": eval_updates})
+        self.stats["t_evalupd_ms"] += (time.perf_counter() - t3) * 1e3
         self.stats["fast"] += len(done)
         for rec in done:
             self._send_ack(rec.ev.ID, rec.token)

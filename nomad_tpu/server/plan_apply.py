@@ -181,11 +181,10 @@ def _vector_fit(snap, plan: Plan, nt, node_ids: List[str]
     if rows:
         r = np.asarray(rows, dtype=np.int64)
         d = np.stack(deltas)
-        # Capture array refs once: a concurrent table resize swaps in grown
-        # copies (rows stable, old rows preserved), so indexing a consistent
-        # pair of refs is safe without taking the tensor lock.
-        usage, capacity = nt.usage, nt.capacity
-        ok = np.all(usage[r] + d <= capacity[r], axis=1)
+        # Row copies under the tensor lock: alloc commits mutate usage rows
+        # in place, and a torn row read mid-`+=` could mis-admit a placement.
+        usage, capacity = nt.snapshot_rows(r)
+        ok = np.all(usage + d <= capacity, axis=1)
         for nid, fit in zip(row_ids, ok):
             fits[nid] = bool(fit)
     return fits, exact

@@ -88,6 +88,10 @@ class ServerConfig:
     # pure-placement evals batch through one device pipeline per window.
     pipelined_scheduling: bool = True
     scheduler_window: int = 32
+    # Placement engine for generic schedulers: "tpu" (device kernels) or
+    # "cpu-reference" (the reference's host iterator chain — the benchmark
+    # denominator runs THROUGH the same served path with this set).
+    scheduler_impl: str = "tpu"
     # Scheduling workers on follower servers, dequeuing/submitting over
     # leader RPC (reference: workers on every server, worker.go:101-130).
     distributed_workers: bool = True
@@ -234,7 +238,12 @@ class Server:
         # Workers
         schedulers = list(self.config.enabled_schedulers) + [JobTypeCore]
         for i in range(self.config.num_schedulers):
-            if self.config.pipelined_scheduling:
+            # The pipelined fast path IS the TPU engine; a non-default
+            # scheduler_impl (cpu-reference denominator) must run every eval
+            # through the per-eval scheduler or the knob would silently
+            # select the wrong engine.
+            if (self.config.pipelined_scheduling
+                    and self.config.scheduler_impl == "tpu"):
                 from .pipelined_worker import PipelinedWorker
                 w = PipelinedWorker(self.raft, self.eval_broker,
                                     self.plan_queue, self.blocked_evals,
@@ -243,6 +252,7 @@ class Server:
             else:
                 w = Worker(self.raft, self.eval_broker, self.plan_queue,
                            self.blocked_evals, self.tindex, schedulers)
+            w.scheduler_impl = self.config.scheduler_impl
             w.core_scheduler = self.core_sched
             w.start(name=f"worker-{i}")
             self.workers.append(w)

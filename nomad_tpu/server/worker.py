@@ -192,6 +192,7 @@ class Worker:
         self.blocked_evals = blocked_evals
         self.tindex = tindex
         self.schedulers = schedulers or ["service", "batch", "system"]
+        self.scheduler_impl = "tpu"  # or "cpu-reference" (bench denominator)
         self.backend = backend or LocalBackend(raft, eval_broker, plan_queue)
         self._stop = threading.Event()
         self._paused = threading.Event()
@@ -299,7 +300,8 @@ class Worker:
                     self.core_scheduler.process(ev)
                 return
             sched = new_scheduler(ev.Type, self._snapshot, self,
-                                  self.tindex, logger)
+                                  self.tindex, logger,
+                                  impl=self.scheduler_impl)
             sched.process(ev)
         finally:
             metrics.measure_since(

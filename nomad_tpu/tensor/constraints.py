@@ -132,6 +132,12 @@ class ClassEligibility:
                 self.representatives[cid] = node
         self._job_cache: Dict[str, Tuple[np.ndarray, bool]] = {}
         self._tg_cache: Dict[Tuple[str, str], np.ndarray] = {}
+        # Cross-job memo keyed by the constraint SIGNATURE: a registration
+        # storm of many jobs with identical constraints (the C1M shape) pays
+        # the per-class evaluation once, not once per job. The per-job-id
+        # caches above stay — blocked-eval reporting introspects them — but
+        # they become views onto these shared entries.
+        self._sig_cache: Dict[tuple, Tuple[np.ndarray, np.ndarray, bool]] = {}
 
     # ---- reporting for blocked evals (reference: Evaluation.ClassEligibility)
     def class_eligibility_report(self, mask_by_class: np.ndarray) -> Dict[str, bool]:
@@ -158,19 +164,29 @@ class ClassEligibility:
             mask[row] = node_meets_constraints(node, constraints)
         return mask
 
+    @staticmethod
+    def _sig(constraints: Sequence[Constraint],
+             drivers: Sequence[str] = ()) -> tuple:
+        return (tuple((c.LTarget, c.Operand, c.RTarget) for c in constraints),
+                tuple(drivers))
+
     def job_mask(self, job_id: str, constraints: Sequence[Constraint],
                  ) -> Tuple[np.ndarray, np.ndarray, bool]:
         """Returns ([N] row mask, [C] class table, escaped?)."""
         cached = self._job_cache.get(job_id)
         if cached is None:
-            esc = escaped_constraints(list(constraints))
-            memo = [c for c in constraints if c not in esc]
-            table = self._class_table(memo)
-            mask = table[self.nt.class_ids]
-            esc_mask = self._escaped_mask(esc)
-            if esc_mask is not None:
-                mask = mask & esc_mask
-            cached = (mask, table, bool(esc))
+            sig = ("job",) + self._sig(constraints)
+            cached = self._sig_cache.get(sig)
+            if cached is None:
+                esc = escaped_constraints(list(constraints))
+                memo = [c for c in constraints if c not in esc]
+                table = self._class_table(memo)
+                mask = table[self.nt.class_ids]
+                esc_mask = self._escaped_mask(esc)
+                if esc_mask is not None:
+                    mask = mask & esc_mask
+                cached = (mask, table, bool(esc))
+                self._sig_cache[sig] = cached
             self._job_cache[job_id] = cached
         return cached
 
@@ -181,17 +197,21 @@ class ClassEligibility:
         key = (job_id, tg_name)
         cached = self._tg_cache.get(key)
         if cached is None:
-            esc = escaped_constraints(list(constraints))
-            memo = [c for c in constraints if c not in esc]
-            n_classes = len(self.nt.class_names)
-            table = np.zeros(n_classes, dtype=bool)
-            for cid, rep in self.representatives.items():
-                table[cid] = (node_meets_constraints(rep, memo)
-                              and node_has_drivers(rep, drivers))
-            mask = table[self.nt.class_ids]
-            esc_mask = self._escaped_mask(esc)
-            if esc_mask is not None:
-                mask = mask & esc_mask
-            cached = (mask, table, bool(esc))
+            sig = ("tg",) + self._sig(constraints, drivers)
+            cached = self._sig_cache.get(sig)
+            if cached is None:
+                esc = escaped_constraints(list(constraints))
+                memo = [c for c in constraints if c not in esc]
+                n_classes = len(self.nt.class_names)
+                table = np.zeros(n_classes, dtype=bool)
+                for cid, rep in self.representatives.items():
+                    table[cid] = (node_meets_constraints(rep, memo)
+                                  and node_has_drivers(rep, drivers))
+                mask = table[self.nt.class_ids]
+                esc_mask = self._escaped_mask(esc)
+                if esc_mask is not None:
+                    mask = mask & esc_mask
+                cached = (mask, table, bool(esc))
+                self._sig_cache[sig] = cached
             self._tg_cache[key] = cached
         return cached

@@ -30,8 +30,8 @@ RES_DIMS = 5  # cpu, mem, disk, iops, mbits
 DIM_NAMES = ("cpu", "memory", "disk", "iops", "bandwidth")
 _MIN_CAP = 64
 # Dirty-row device refresh chunks (fixed shapes -> bounded compile count:
-# trickle, steady, and storm buckets).
-_REFRESH_CHUNKS = (8, 128, 2048)
+# trickle, steady, storm, and rebase-after-storm buckets).
+_REFRESH_CHUNKS = (8, 128, 2048, 16384)
 
 
 def resources_vec(r: Optional[Resources]) -> np.ndarray:
@@ -47,11 +47,21 @@ def resources_vec(r: Optional[Resources]) -> np.ndarray:
 
 
 def alloc_vec(alloc: Allocation) -> np.ndarray:
+    """Resource vector of an allocation, memoized on the instance: the
+    commit path reads it three times per alloc (usage listener, vectorized
+    plan verify, optimistic overlay). Allocations are value-frozen once
+    built — anything that changes resources replaces the object — so the
+    memo cannot go stale. Callers must not mutate the returned array."""
+    vec = getattr(alloc, "_resvec_cache", None)
+    if vec is not None:
+        return vec
     if alloc.Resources is not None:
-        return resources_vec(alloc.Resources)
-    out = np.zeros(RES_DIMS, dtype=np.float32)
-    for r in alloc.TaskResources.values():
-        out += resources_vec(r)
+        out = resources_vec(alloc.Resources)
+    else:
+        out = np.zeros(RES_DIMS, dtype=np.float32)
+        for r in alloc.TaskResources.values():
+            out += resources_vec(r)
+    alloc._resvec_cache = out
     return out
 
 
@@ -80,8 +90,14 @@ class NodeTensor:
         self.dc_vocab: Dict[str, int] = {}
         self.dc_names: List[str] = []
 
-        # Device sync state
+        # Device sync state. Two dirty tiers: rows whose capacity/readiness
+        # changed (node upserts — must always refresh) vs rows where only
+        # USAGE moved (alloc commits). A caller that overrides usage with a
+        # device-side chain can skip the usage tier entirely, turning the
+        # steady-state storm refresh (one blocking host->device RTT per
+        # window) into zero transfers.
         self._dirty_rows: Set[int] = set()
+        self._usage_dirty: Set[int] = set()
         self._resized = True
         self._device: Optional[dict] = None
 
@@ -163,7 +179,7 @@ class NodeTensor:
             if row is None:
                 return
             self.usage[row] += sign * alloc_vec(alloc)
-            self._dirty_rows.add(row)
+            self._usage_dirty.add(row)
 
     # ------------------------------------------------------------ row mgmt
     def _alloc_row(self) -> int:
@@ -186,12 +202,20 @@ class NodeTensor:
         self._resized = True
 
     # --------------------------------------------------------- device sync
-    def device_arrays(self) -> dict:
-        """Return jax device arrays, refreshing dirty rows via scatter."""
+    def device_arrays(self, skip_usage: bool = False) -> dict:
+        """Return jax device arrays, refreshing dirty rows via scatter.
+
+        skip_usage=True refreshes only rows whose capacity/readiness changed
+        and leaves usage-only dirty rows queued — valid ONLY for callers that
+        override the usage input with their own device-side chain (the
+        pipelined worker mid-storm). The queued rows are flushed by the next
+        full call."""
         ensure_backend()
         import jax.numpy as jnp
 
         with self._lock:
+            pending = (set(self._dirty_rows) if skip_usage
+                       else self._dirty_rows | self._usage_dirty)
             if self._device is None or self._resized:
                 self._device = {
                     "capacity": jnp.asarray(self.capacity),
@@ -200,8 +224,9 @@ class NodeTensor:
                 }
                 self._resized = False
                 self._dirty_rows.clear()
-            elif self._dirty_rows:
-                rows = np.fromiter(self._dirty_rows, dtype=np.int32)
+                self._usage_dirty.clear()
+            elif pending:
+                rows = np.fromiter(pending, dtype=np.int32)
                 # Fixed-size scatter chunks (tail padded by repeating the
                 # first row — sets are idempotent): ONE compiled refresh
                 # program ever, instead of one per distinct dirty-row count.
@@ -233,12 +258,25 @@ class NodeTensor:
                     d["capacity"], d["score_cap"], d["usage"] = \
                         _scatter_refresh(d["capacity"], d["score_cap"],
                                          d["usage"], packed)
-                self._dirty_rows.clear()
+                # The scatter writes all three column groups, so refreshed
+                # rows are current in BOTH tiers regardless of why they were
+                # dirty.
+                self._dirty_rows -= pending
+                self._usage_dirty -= pending
             return dict(self._device)
 
     # ------------------------------------------------------------- queries
     def rows_for(self, node_ids: Sequence[str]) -> np.ndarray:
         return np.array([self.row_of[i] for i in node_ids], dtype=np.int32)
+
+    def snapshot_rows(self, rows: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+        """Consistent (usage, capacity) copies of the given rows, taken under
+        the tensor lock. Alloc commits mutate usage rows IN PLACE
+        (_apply_usage), so a lock-free reader could see a torn row — half a
+        usage vector before an in-flight `+=`, half after. Fancy indexing
+        copies, so the returned arrays are immune to later mutation."""
+        with self._lock:
+            return self.usage[rows], self.capacity[rows]
 
     def eligibility_mask(self, dc_ids: Sequence[int],
                         class_ok: Optional[np.ndarray]) -> np.ndarray:

@@ -98,6 +98,71 @@ class TestOptimisticSnapshot:
         assert r2.RefreshIndex > 0
 
 
+class TestVectorFitTornReads:
+    def test_no_admission_from_torn_row_reads(self):
+        """_vector_fit must snapshot rows under the tensor lock: alloc
+        commits mutate usage rows in place, and an unlocked reader could see
+        half of one `+=` and half of another. Constructed so every LEGAL
+        point-in-time state rejects the placement — only a torn mix of two
+        writes (e.g. [0, 0]) could admit it."""
+        from nomad_tpu.server.plan_apply import _vector_fit
+        from nomad_tpu.structs import Resources
+        from nomad_tpu.tensor.node_table import NodeTensor
+
+        node = mock.node()
+        node.Resources = Resources(CPU=50, MemoryMB=50)
+        node.Reserved = None
+        nt = NodeTensor()
+        nt.upsert_node(node)
+
+        def usage_alloc(cpu=0, mem=0):
+            a = mock.alloc()
+            a.NodeID = node.ID
+            a.Resources = Resources(CPU=cpu, MemoryMB=mem)
+            a.TaskResources = {}
+            return a
+
+        alloc_a = usage_alloc(cpu=100)   # [100, 0, ...]
+        alloc_b = usage_alloc(mem=100)   # [0, 100, ...]
+        nt.add_alloc_usage(alloc_a)      # states cycle A, A+B, B — all of
+        # which exceed capacity in SOME dim; [0, 0] is reachable only torn.
+
+        class Snap:
+            row_delta = {}
+
+            @staticmethod
+            def node_by_id(_):
+                return node
+
+            @staticmethod
+            def alloc_by_id(_):
+                return None
+
+        ask = usage_alloc(cpu=1, mem=1)
+        plan = Plan(EvalID="torn", NodeAllocation={node.ID: [ask]})
+
+        stop = threading.Event()
+
+        def hammer():
+            while not stop.is_set():
+                nt.add_alloc_usage(alloc_b)      # A   -> A+B
+                nt.remove_alloc_usage(alloc_a)   # A+B -> B
+                nt.add_alloc_usage(alloc_a)      # B   -> A+B
+                nt.remove_alloc_usage(alloc_b)   # A+B -> A
+
+        writer = threading.Thread(target=hammer, daemon=True)
+        writer.start()
+        try:
+            for _ in range(3000):
+                fits, exact = _vector_fit(Snap, plan, nt, [node.ID])
+                assert exact == []
+                assert fits[node.ID] is False, \
+                    "torn row read admitted an impossible placement"
+        finally:
+            stop.set()
+            writer.join(timeout=5)
+
+
 class TestContentionStorm:
     def test_no_oversubscription_under_many_conflicting_plans(self):
         """Many concurrent workers submit plans fighting over a small node

@@ -161,16 +161,28 @@ class FSM:
         return None
 
     def _apply_alloc_update(self, index: int, req: Dict[str, Any]):
-        allocs: List[Allocation] = [
-            from_dict(Allocation, a) if isinstance(a, dict) else a
-            for a in req["Alloc"]]
-        # Attach the shared job if provided (plan apply normalization).
-        job = req.get("Job")
-        if isinstance(job, dict):
-            job = from_dict(Job, job)
-        for alloc in allocs:
-            if alloc.Job is None and job is not None:
-                alloc.Job = job
+        # Two shapes: {"Job", "Alloc"} for one plan (reference parity,
+        # fsm.go:356 applyAllocUpdate), or {"Batch": [{"Job", "Alloc"}, ...]}
+        # when the plan applier commits several verified plans as one log
+        # entry — the whole group lands in ONE state-store transaction (one
+        # lock/commit/notify/job-status pass), which is where the per-plan
+        # apply cost goes at storm rates.
+        groups = req.get("Batch")
+        if groups is None:
+            groups = [req]
+        allocs: List[Allocation] = []
+        for group in groups:
+            group_allocs = [
+                from_dict(Allocation, a) if isinstance(a, dict) else a
+                for a in group["Alloc"]]
+            # Attach the shared job if provided (plan apply normalization).
+            job = group.get("Job")
+            if isinstance(job, dict):
+                job = from_dict(Job, job)
+            for alloc in group_allocs:
+                if alloc.Job is None and job is not None:
+                    alloc.Job = job
+            allocs.extend(group_allocs)
         self.state.upsert_allocs(index, allocs)
         return None
 

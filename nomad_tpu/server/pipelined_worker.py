@@ -126,6 +126,8 @@ def _prep_sig(job, place, batch: bool) -> Optional[tuple]:
     task shapes, and placement sequence produce byte-identical device inputs,
     so their PreparedBatch can be shared within a window. Returns None when
     sharing is unsafe (network asks need per-node port bookkeeping)."""
+    from nomad_tpu.tensor.constraints import constraint_sig
+
     tg_sigs = {}
     names = []
     for t in place:
@@ -141,14 +143,9 @@ def _prep_sig(job, place, batch: bool) -> Optional[tuple]:
             tasks.append((task.Name, task.Driver,
                           (r.CPU, r.MemoryMB, r.DiskMB, r.IOPS)
                           if r is not None else None,
-                          tuple((c.LTarget, c.Operand, c.RTarget)
-                                for c in task.Constraints)))
-        tg_sigs[tg.Name] = (
-            tuple(tasks),
-            tuple((c.LTarget, c.Operand, c.RTarget) for c in tg.Constraints))
-    return (batch,
-            tuple((c.LTarget, c.Operand, c.RTarget) for c in job.Constraints),
-            tuple(names),
+                          constraint_sig(task.Constraints)))
+        tg_sigs[tg.Name] = (tuple(tasks), constraint_sig(tg.Constraints))
+    return (batch, constraint_sig(job.Constraints), tuple(names),
             tuple(sorted(tg_sigs.items())))
 
 
@@ -171,6 +168,7 @@ class PipelinedWorker(Worker):
         # Cross-window device usage chain (usage_after of the last dispatched
         # fast eval). None = next window reads committed usage from the table.
         self._chain = None
+        self._chain_epoch = -1
         self._chained_windows = 0
         # Stage handoffs: dispatch -> drain -> build, one window queued per
         # seam. The drain stage spends its time in a device readback (GIL
@@ -378,6 +376,10 @@ class PipelinedWorker(Worker):
             # Next window chains on this one's device-side usage tail even
             # though its plans haven't committed yet.
             self._chain = usage_chain
+            # Epoch captured at chain validation (_usage_chain), BEFORE this
+            # window dispatched: a row freed mid-dispatch still rebases the
+            # next window.
+            self._chain_epoch = self._dispatch_epoch
             self._chained_windows += 1
         self.stats["windows"] += 1
         self.stats["slow"] += len(slow)
@@ -396,8 +398,13 @@ class PipelinedWorker(Worker):
         window's device-side tail while windows are in flight, or None
         (= committed usage from the table) after a rebase."""
         chain = self._chain
-        if chain is not None and chain.shape[0] != nt.n_rows:
-            chain = None  # table resized: rows moved under the chain
+        self._dispatch_epoch = nt.row_epoch
+        if chain is not None and (chain.shape[0] != nt.n_rows
+                                  or self._chain_epoch != nt.row_epoch):
+            # Table resized OR a row changed identity (node removed / freed
+            # row reused): the chain may carry a departed node's usage on a
+            # row that now belongs to someone else.
+            chain = None
         if chain is not None and self._chained_windows >= _REBASE_WINDOWS:
             # Bound chain drift: drain the pipeline, then restart from
             # committed state.

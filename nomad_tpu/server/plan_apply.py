@@ -51,6 +51,20 @@ logger = logging.getLogger("nomad.plan_apply")
 # goroutines are cheaper than pool dispatch here).
 _POOL_THRESHOLD = 8
 
+# Max verified plans committed as one consensus entry. Bounds the entry size
+# (reference warns at 1MB raft entries, rpc.go:45-47: 16 x 50-alloc plans
+# stays well under) and the blast radius of a failed group apply.
+_APPLY_BATCH = 16
+
+
+def _result_allocs(result: "PlanResult") -> List[Allocation]:
+    allocs: List[Allocation] = []
+    for updates in result.NodeUpdate.values():
+        allocs.extend(updates)
+    for placed in result.NodeAllocation.values():
+        allocs.extend(placed)
+    return allocs
+
 
 class OptimisticSnapshot:
     """A read view layering not-yet-committed plan results over a state
@@ -294,17 +308,29 @@ class PlanApplier:
     def run(self) -> None:
         self._pool = ThreadPoolExecutor(max_workers=self._pool_size,
                                         thread_name_prefix="plan-eval")
-        # One in-flight raft apply at a time; while it commits, the NEXT plan
-        # verifies against `opt`, an optimistic view that assumes it landed.
+        # One in-flight raft apply at a time; while it commits, the NEXT
+        # GROUP of plans verifies against `opt`, an optimistic view that
+        # assumes it landed. Plans queued back-to-back (a worker window
+        # submitting its plans) verify against the chained overlay and
+        # commit as ONE log entry / state transaction (fsm Batch shape) —
+        # the reference overlaps verify with apply latency
+        # (plan_apply.go:24-33); here apply is also CPU on this core, so
+        # grouping cuts the work itself, not just the wait.
         wait: Optional[threading.Thread] = None
         opt: Optional[OptimisticSnapshot] = None
         try:
             while not self._stop.is_set():
                 try:
                     pending = self.plan_queue.dequeue(timeout=0.5)
+                    batch = [pending] if pending is not None else []
+                    while pending is not None and len(batch) < _APPLY_BATCH:
+                        nxt = self.plan_queue.dequeue(timeout=1e-4)
+                        if nxt is None:
+                            break
+                        batch.append(nxt)
                 except RuntimeError:
                     return  # queue disabled
-                if pending is None:
+                if not batch:
                     continue
 
                 # Last apply already done? Fall back to a fresh snapshot.
@@ -320,36 +346,37 @@ class PlanApplier:
                     opt = OptimisticSnapshot(self.raft.fsm.state.snapshot(),
                          nt=self._nt())
 
-                result = self._verify(pending, opt, overlapped=wait is not None)
-                if result is None:
-                    continue  # rejected; already responded
-                if not result.NodeUpdate and not result.NodeAllocation:
-                    pending.respond(result, None)
+                group = self._verify_group(batch, opt,
+                                           overlapped=wait is not None)
+                if not group:
                     continue
 
                 # One apply in flight at a time: wait for the previous one,
                 # then re-snapshot so the optimistic view can't drift more
-                # than one plan from the log (plan_apply.go:96-103).
+                # than one group from the log (plan_apply.go:96-103).
                 if wait is not None:
                     prev_failed_before = self.stats["apply_failed"]
                     wait.join()
                     opt = OptimisticSnapshot(self.raft.fsm.state.snapshot(),
                          nt=self._nt())
                     if self.stats["apply_failed"] != prev_failed_before:
-                        # The apply this result's verification assumed never
+                        # The apply this group's verification assumed never
                         # landed (e.g. its evictions); re-verify against the
                         # real state before committing.
-                        result = self._verify(pending, opt, overlapped=False)
-                        if result is None:
+                        group = self._verify_group(
+                            [p for p, _ in group], opt, overlapped=False)
+                        if not group:
+                            wait = None
                             continue
-                        if not result.NodeUpdate and not result.NodeAllocation:
-                            pending.respond(result, None)
-                            continue
+                    else:
+                        # Fresh snapshot lacks this group's own results:
+                        # restore them to the overlay. (When no apply was in
+                        # flight, _verify_group already layered them.)
+                        for _, result in group:
+                            opt.apply_result(result)
 
-                opt.apply_result(result)
                 wait = threading.Thread(
-                    target=self._apply_and_respond,
-                    args=(pending, pending.plan, result),
+                    target=self._apply_group, args=(group,),
                     daemon=True, name="plan-apply-async")
                 wait.start()
         finally:
@@ -357,6 +384,27 @@ class PlanApplier:
                 wait.join()
             self._pool.shutdown(wait=False)
             self._pool = None
+
+    def _verify_group(self, batch: List[PendingPlan],
+                      opt: OptimisticSnapshot, overlapped: bool
+                      ) -> List[Tuple[PendingPlan, PlanResult]]:
+        """Verify plans in queue order against the shared overlay; each
+        admitted plan's result is layered into `opt` so the next plan of the
+        group sees it (the group analogue of the single-plan chain). No-op
+        results respond immediately; rejected plans were answered by
+        _verify."""
+        group: List[Tuple[PendingPlan, PlanResult]] = []
+        for pending in batch:
+            result = self._verify(pending, opt,
+                                  overlapped=overlapped or bool(group))
+            if result is None:
+                continue
+            if not result.NodeUpdate and not result.NodeAllocation:
+                pending.respond(result, None)
+                continue
+            opt.apply_result(result)
+            group.append((pending, result))
+        return group
 
     def _verify(self, pending: PendingPlan, opt: OptimisticSnapshot,
                 overlapped: bool) -> Optional[PlanResult]:
@@ -385,15 +433,31 @@ class PlanApplier:
                            result: PlanResult) -> None:
         """Commit through consensus, then answer the waiting worker
         (reference: applyPlan + asyncPlanWait, plan_apply.go:122-190)."""
+        self._apply_group([(pending, result)])
+
+    def _apply_group(self, group: List[Tuple[PendingPlan, PlanResult]]
+                     ) -> None:
+        """Commit a verified group as ONE consensus entry, then answer every
+        waiting worker. All plans of the group share the entry's index."""
         try:
             with metrics.measure(("nomad", "plan", "apply")):
-                index = self._apply(plan, result)
-            result.AllocIndex = index
-            self.stats["applied"] += 1
-            pending.respond(result, None)
+                if len(group) == 1:
+                    pending, result = group[0]
+                    index = self._apply(pending.plan, result)
+                else:
+                    index = self.raft.apply(MessageType.AllocUpdate, {
+                        "Batch": [{"Job": pending.plan.Job,
+                                   "Alloc": _result_allocs(result)}
+                                  for pending, result in group],
+                    })
+            for pending, result in group:
+                result.AllocIndex = index
+                self.stats["applied"] += 1
+                pending.respond(result, None)
         except Exception as e:
             self.stats["apply_failed"] += 1
-            pending.respond(None, e)
+            for pending, _ in group:
+                pending.respond(None, e)
 
     def apply_one(self, pending: PendingPlan) -> None:
         """Synchronous single-plan path (tests / dev tools)."""
@@ -409,12 +473,7 @@ class PlanApplier:
     def _apply(self, plan: Plan, result: PlanResult) -> int:
         """Commit the verified subset through consensus
         (reference: plan_apply.go:122-164 applyPlan)."""
-        allocs: List[Allocation] = []
-        for updates in result.NodeUpdate.values():
-            allocs.extend(updates)
-        for placed in result.NodeAllocation.values():
-            allocs.extend(placed)
         return self.raft.apply(MessageType.AllocUpdate, {
             "Job": plan.Job,
-            "Alloc": allocs,
+            "Alloc": _result_allocs(result),
         })

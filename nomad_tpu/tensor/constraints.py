@@ -86,6 +86,14 @@ def check_constraint(operand: str, l_val, r_val) -> bool:
     return False
 
 
+def constraint_sig(constraints: Sequence[Constraint]) -> tuple:
+    """Value identity of a constraint list. THE single definition: every
+    cache keyed on "same constraints" (class-eligibility masks, shared
+    prepared batches) must use this so a future constraint field can't be
+    forgotten in one of them."""
+    return tuple((c.LTarget, c.Operand, c.RTarget) for c in constraints)
+
+
 def node_meets_constraints(node: Node, constraints: Sequence[Constraint]) -> bool:
     for c in constraints:
         l_val, l_ok = resolve_target(c.LTarget, node)
@@ -167,8 +175,7 @@ class ClassEligibility:
     @staticmethod
     def _sig(constraints: Sequence[Constraint],
              drivers: Sequence[str] = ()) -> tuple:
-        return (tuple((c.LTarget, c.Operand, c.RTarget) for c in constraints),
-                tuple(drivers))
+        return (constraint_sig(constraints), tuple(drivers))
 
     def job_mask(self, job_id: str, constraints: Sequence[Constraint],
                  ) -> Tuple[np.ndarray, np.ndarray, bool]:

@@ -83,6 +83,11 @@ class NodeTensor:
         self.node_of: List[Optional[str]] = [None] * n
         self._free: List[int] = list(range(n - 1, -1, -1))
         self._reserved_cache: Dict[str, np.ndarray] = {}
+        # Bumped whenever a row's IDENTITY changes (node removed, row freed
+        # for reuse, table grown): a device-side usage chain built against an
+        # older epoch may carry a departed node's usage on a reused row and
+        # must rebase (shape checks alone miss free-list reuse).
+        self.row_epoch = 0
 
         # Vocabularies
         self.class_vocab: Dict[str, int] = {}
@@ -166,6 +171,7 @@ class NodeTensor:
             self._free.append(row)
             self._dirty_rows.add(row)
             self._reserved_cache.pop(node_id, None)
+            self.row_epoch += 1
 
     def add_alloc_usage(self, alloc: Allocation) -> None:
         self._apply_usage(alloc, +1.0)
@@ -200,6 +206,7 @@ class NodeTensor:
         self._free.extend(range(new - 1, old - 1, -1))
         self.n_rows = new
         self._resized = True
+        self.row_epoch += 1
 
     # --------------------------------------------------------- device sync
     def device_arrays(self, skip_usage: bool = False) -> dict:
@@ -264,6 +271,27 @@ class NodeTensor:
                 self._dirty_rows -= pending
                 self._usage_dirty -= pending
             return dict(self._device)
+
+    def warm_device(self) -> None:
+        """Precompile every dirty-row refresh program for the current table
+        size. Each _REFRESH_CHUNKS bucket is a distinct XLA program; the
+        first dirty set that lands in a cold bucket otherwise pays its
+        compile (hundreds of ms) in the middle of serving. The warm scatter
+        rewrites row 0 with its own current values — a no-op — so this is
+        safe to call at any time; servers call it once the node table has
+        reached steady size (e.g. after initial cluster sync)."""
+        with self._lock:
+            self.device_arrays()
+            d = self._device
+            for size in _REFRESH_CHUNKS:
+                chunk = np.zeros(size, dtype=np.int32)
+                packed = np.concatenate(
+                    [chunk[:, None].astype(np.float32),
+                     self.capacity[chunk], self.score_cap[chunk],
+                     self.usage[chunk]], axis=1)
+                d["capacity"], d["score_cap"], d["usage"] = \
+                    _scatter_refresh(d["capacity"], d["score_cap"],
+                                     d["usage"], packed)
 
     # ------------------------------------------------------------- queries
     def rows_for(self, node_ids: Sequence[str]) -> np.ndarray:

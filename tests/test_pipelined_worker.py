@@ -182,3 +182,33 @@ class TestPipelinedBurst:
             finally:
                 srv.shutdown()
         assert results[True] == results[False] == [5] * 6
+
+
+class TestChainRebase:
+    def test_row_identity_change_rebases_chain(self):
+        """A freed row reused by a new node mid-storm must invalidate the
+        device usage chain: shape alone doesn't change on free-list reuse,
+        so the worker tracks the table's row_epoch."""
+        srv = make_server()
+        try:
+            nodes = [mock.node() for _ in range(4)]
+            for n in nodes:
+                srv.node_register(n)
+            w = srv.workers[0]
+            nt = srv.tindex.nt
+
+            # Simulate a live chain built against the current table.
+            chain = np.zeros((nt.n_rows, 5), dtype=np.float32)
+            w._chain = chain
+            w._chain_epoch = nt.row_epoch
+            w._chained_windows = 1
+            w._drained.clear()  # pipeline "in flight": chain would be kept
+            assert w._usage_chain(nt) is not None
+
+            # Node leaves; its row goes to the free list (no resize).
+            nt.remove_node(nodes[0].ID)
+            w._chain = chain
+            assert w._usage_chain(nt) is None, (
+                "chain must rebase after a row identity change")
+        finally:
+            srv.shutdown()

@@ -58,20 +58,24 @@ class _DeviceInputCache:
         self._entries: "OrderedDict[tuple, object]" = OrderedDict()
         self._lock = threading.Lock()
 
-    def get(self, arr: np.ndarray):
+    def get(self, arr: np.ndarray, sharding=None):
+        import jax
         import jax.numpy as jnp
 
         arr = np.ascontiguousarray(arr)
         # 128-bit content digest as the key: exact-bytes keys would retain a
         # full host copy of every cached array (MBs at large node counts).
+        # The sharding is part of the key — the same bytes placed on a mesh
+        # and on a single device are different buffers.
         key = (hashlib.blake2b(arr.tobytes(), digest_size=16).digest(),
-               arr.dtype.str, arr.shape)
+               arr.dtype.str, arr.shape, sharding)
         with self._lock:
             dev = self._entries.get(key)
             if dev is not None:
                 self._entries.move_to_end(key)
                 return dev
-        dev = jnp.asarray(arr)
+        dev = (jax.device_put(arr, sharding) if sharding is not None
+               else jnp.asarray(arr))
         with self._lock:
             self._entries[key] = dev
             while len(self._entries) > self.cap:
@@ -309,11 +313,26 @@ class GenericStack:
 
         nt = self.tindex.nt
         d = tables if tables is not None else nt.device_arrays()
+        # Mesh serving: node-axis inputs shard over the mesh like the table
+        # arrays; per-placement inputs replicate. XLA's SPMD partitioner
+        # turns the same place_batch program into the multi-chip version
+        # (global argmax/sum become ICI collectives).
+        mesh = nt.mesh
+        node_sh = mask_sh = rep_sh = None
+        if mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            axis = mesh.axis_names[0]
+            node_sh = NamedSharding(mesh, P(axis))
+            mask_sh = NamedSharding(mesh, P(None, axis))
+            rep_sh = NamedSharding(mesh, P())
         usage = usage_override if usage_override is not None else d["usage"]
         if len(prep.evict_rows):
             usage = usage.at[prep.evict_rows].add(-prep.evict_vecs)
         if placed_usage is not None and placed_usage.any():
-            usage = usage + jnp.asarray(placed_usage)
+            # Host accumulator stays numpy (uncommitted): the add places it
+            # with `usage`, sharded or not.
+            usage = usage + placed_usage
 
         pristine = (banned is None and placed_usage is None
                     and placed_counts is None and placed_hosts is None
@@ -344,13 +363,15 @@ class GenericStack:
         # a registration storm re-dispatches with byte-identical masks/demands/
         # zero-count/zero-host arrays, so steady state pays ZERO host->device
         # puts per eval (each put is a full RTT on remote-attached TPUs).
-        dev = (_dev_cache.get(masks),
-               _dev_cache.get(counts_now), _dev_cache.get(prep.demands),
-               _dev_cache.get(prep.tg_ids), _dev_cache.get(sel_valid),
-               _dev_cache.get(prep.noise_vec),
-               _dev_cache.get(np.float32(prep.penalty)),
-               _dev_cache.get(np.asarray(prep.distinct)),
-               _dev_cache.get(hosts))
+        dev = (_dev_cache.get(masks, mask_sh),
+               _dev_cache.get(counts_now, node_sh),
+               _dev_cache.get(prep.demands, rep_sh),
+               _dev_cache.get(prep.tg_ids, rep_sh),
+               _dev_cache.get(sel_valid, rep_sh),
+               _dev_cache.get(prep.noise_vec, node_sh),
+               _dev_cache.get(np.float32(prep.penalty), rep_sh),
+               _dev_cache.get(np.asarray(prep.distinct), rep_sh),
+               _dev_cache.get(hosts, node_sh))
         if pristine:
             prep.dev_inputs = dev
         return kernels.place_batch(d["capacity"], d["score_cap"], usage, *dev)

@@ -429,12 +429,6 @@ class PlanApplier:
             self.stats["overlapped"] += 1
         return result
 
-    def _apply_and_respond(self, pending: PendingPlan, plan: Plan,
-                           result: PlanResult) -> None:
-        """Commit through consensus, then answer the waiting worker
-        (reference: applyPlan + asyncPlanWait, plan_apply.go:122-190)."""
-        self._apply_group([(pending, result)])
-
     def _apply_group(self, group: List[Tuple[PendingPlan, PlanResult]]
                      ) -> None:
         """Commit a verified group as ONE consensus entry, then answer every

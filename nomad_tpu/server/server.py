@@ -92,6 +92,12 @@ class ServerConfig:
     # "cpu-reference" (the reference's host iterator chain — the benchmark
     # denominator runs THROUGH the same served path with this set).
     scheduler_impl: str = "tpu"
+    # Multi-chip serving: "all" shards the node tensor (and every placement
+    # kernel) over all local devices with jax.sharding — the SERVED windows
+    # run SPMD over the mesh, not just the bare kernels. "" = single device.
+    # Device counts that aren't a power of two use the largest pow2 prefix
+    # (row padding is pow2, so the node axis must divide evenly).
+    scheduler_mesh: str = ""
     # Scheduling workers on follower servers, dequeuing/submitting over
     # leader RPC (reference: workers on every server, worker.go:101-130).
     distributed_workers: bool = True
@@ -132,6 +138,20 @@ class Server:
             self.raft = DevRaft(self.fsm)
         self.state: StateStore = self.fsm.state
         self.tindex = TensorIndex.attach(self.state)
+        if self.config.scheduler_mesh:
+            if self.config.scheduler_mesh != "all":
+                raise ValueError(
+                    f"scheduler_mesh must be \"all\" or \"\", got "
+                    f"{self.config.scheduler_mesh!r}")
+            from nomad_tpu.parallel import scheduling_mesh
+
+            import jax
+
+            devices = jax.devices()
+            n = 1
+            while n * 2 <= len(devices):
+                n *= 2
+            self.tindex.nt.set_mesh(scheduling_mesh(devices[:n]))
 
         self.eval_broker = EvalBroker(self.config.eval_nack_timeout,
                                       self.config.eval_delivery_limit)

@@ -105,6 +105,51 @@ class NodeTensor:
         self._usage_dirty: Set[int] = set()
         self._resized = True
         self._device: Optional[dict] = None
+        # Multi-chip: when set, device arrays shard their node axis over the
+        # mesh (jax.sharding) and every consumer kernel runs SPMD with XLA
+        # inserting the ICI collectives (SURVEY §7.1: the node axis IS the
+        # sharded tensor axis). None = single-device arrays, byte-identical
+        # to the pre-mesh path.
+        self.mesh = None
+        self._node_sharding = None
+
+    # --------------------------------------------------------------- mesh
+    def set_mesh(self, mesh) -> None:
+        """Shard the node axis of the device arrays over `mesh` (a 1-D
+        jax.sharding.Mesh). Must be a power-of-two device count: rows are
+        padded to powers of two (>= 64), so divisibility is guaranteed for
+        any pow2 mesh up to 64 devices and preserved across table growth.
+        Call before serving traffic; existing device arrays are rebuilt."""
+        if mesh is None:
+            self.mesh = None
+            self._node_sharding = None
+            self._device = None
+            self._resized = True
+            return
+        n_dev = mesh.devices.size
+        if n_dev & (n_dev - 1):
+            raise ValueError(
+                f"scheduling mesh needs a power-of-two device count, got "
+                f"{n_dev}")
+        if self.n_rows % n_dev:
+            raise ValueError(
+                f"node axis ({self.n_rows}) not divisible by mesh ({n_dev})")
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        axis = mesh.axis_names[0]
+        self.mesh = mesh
+        self._node_sharding = NamedSharding(mesh, PartitionSpec(axis))
+        self._device = None  # rebuild sharded on next device_arrays()
+        self._resized = True
+
+    def _put(self, arr: np.ndarray):
+        """Upload one full array, sharded over the mesh when set."""
+        import jax
+        import jax.numpy as jnp
+
+        if self._node_sharding is not None:
+            return jax.device_put(arr, self._node_sharding)
+        return jnp.asarray(arr)
 
     # ------------------------------------------------------------- vocab
     def class_id(self, computed_class: str) -> int:
@@ -218,16 +263,20 @@ class NodeTensor:
         pipelined worker mid-storm). The queued rows are flushed by the next
         full call."""
         ensure_backend()
-        import jax.numpy as jnp
 
         with self._lock:
             pending = (set(self._dirty_rows) if skip_usage
                        else self._dirty_rows | self._usage_dirty)
             if self._device is None or self._resized:
+                if (self._node_sharding is not None
+                        and self.n_rows % self.mesh.devices.size):
+                    raise ValueError(
+                        f"node axis ({self.n_rows}) not divisible by mesh "
+                        f"({self.mesh.devices.size})")
                 self._device = {
-                    "capacity": jnp.asarray(self.capacity),
-                    "score_cap": jnp.asarray(self.score_cap),
-                    "usage": jnp.asarray(self.usage),
+                    "capacity": self._put(self.capacity),
+                    "score_cap": self._put(self.score_cap),
+                    "usage": self._put(self.usage),
                 }
                 self._resized = False
                 self._dirty_rows.clear()
@@ -340,9 +389,11 @@ def _scatter_refresh(capacity, score_cap, usage, packed):
                     us.at[rows].set(us_v))
 
         _SCATTER_REFRESH = refresh
-    import jax.numpy as jnp
 
-    return _SCATTER_REFRESH(capacity, score_cap, usage, jnp.asarray(packed))
+    # packed stays a host array (uncommitted): jit places it with the other
+    # operands, which may be sharded over a mesh — an eager jnp.asarray here
+    # would commit it to the default device and conflict.
+    return _SCATTER_REFRESH(capacity, score_cap, usage, packed)
 
 
 def ensure_backend() -> None:

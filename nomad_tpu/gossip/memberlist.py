@@ -147,6 +147,13 @@ class Memberlist:
         self._shutdown = threading.Event()
         self._left = False
         self._threads: List[threading.Thread] = []
+        # Fault-injection seam (tests only): called with (dest, msgs) before
+        # every UDP send; return False to drop the packet. Models lossy
+        # links and asymmetric partitions — the conditions SWIM's
+        # suspicion/refutation pipeline exists to survive. Never set in
+        # production paths.
+        self.transport_filter: Optional[
+            Callable[[Tuple[str, int], List[Any]], bool]] = None
 
     # ------------------------------------------------------------ lifecycle
     def start(self) -> None:
@@ -248,6 +255,9 @@ class Memberlist:
 
     # ------------------------------------------------------------ transport
     def _send_udp(self, dest: Tuple[str, int], msgs: List[Any]) -> None:
+        f = self.transport_filter
+        if f is not None and not f(dest, msgs):
+            return
         try:
             self._udp.sendto(msgpack.packb(msgs, use_bin_type=True), dest)
         except OSError:
@@ -582,6 +592,12 @@ class Memberlist:
             targets = self._random_members(1)
             if targets:
                 m = targets[0]
+                # The fault-injection seam gates anti-entropy too: a
+                # "partitioned" link must not heal through the TCP side.
+                f = self.transport_filter
+                if f is not None and not f((m.addr, m.port),
+                                           [("push-pull",)]):
+                    continue
                 try:
                     self._push_pull((m.addr, m.port))
                 except OSError:

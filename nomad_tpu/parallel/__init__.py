@@ -11,6 +11,7 @@ become XLA collectives, and regions federate over DCN (one mesh per region).
 from .mesh import (  # noqa: F401
     node_sharding,
     place_batch_sharded,
+    pow2_prefix,
     replicated,
     scheduling_mesh,
 )

@@ -27,6 +27,17 @@ def scheduling_mesh(devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
     return Mesh(devs.reshape(-1), (NODE_AXIS,))
 
 
+def pow2_prefix(devices: Sequence[jax.Device]) -> Sequence[jax.Device]:
+    """Largest power-of-two prefix of a device list — the mesh-sizing rule
+    (node rows pad to powers of two, so the sharded axis must divide
+    evenly). THE single definition; server boot and the multi-chip dry run
+    both use it."""
+    n = 1
+    while n * 2 <= len(devices):
+        n *= 2
+    return devices[:n]
+
+
 def node_sharding(mesh: Mesh) -> NamedSharding:
     """Shard the leading (node) axis."""
     return NamedSharding(mesh, P(NODE_AXIS))

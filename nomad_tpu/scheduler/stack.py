@@ -131,6 +131,32 @@ def _pad_pow2(n: int, floor: int = 8) -> int:
     return p
 
 
+def score_fit_rows(usage2: np.ndarray, score_cap: np.ndarray) -> np.ndarray:
+    """BestFit-v3 host-side, in float64 like the Go reference
+    (funcs.go:102-137): 20 - 10^freeCpuPct - 10^freeMemPct, clamped [0,18],
+    NaN/Inf division edges sanitized. THE single host-side definition —
+    select_on_node and the system batch path both call it so the formula
+    cannot drift between them (the device twin is kernels._score).
+
+    usage2 [K, 2]: proposed cpu/mem including reserved; score_cap [K, 2]."""
+    with np.errstate(divide="ignore", invalid="ignore"):
+        free_pct = 1.0 - (usage2.astype(np.float64)
+                          / score_cap.astype(np.float64))
+        total = (np.power(10.0, free_pct[:, 0])
+                 + np.power(10.0, free_pct[:, 1]))
+    scores = np.clip(20.0 - total, 0.0, 18.0)
+    return np.nan_to_num(scores, nan=0.0, posinf=18.0, neginf=0.0)
+
+
+def fit_lacking(cap: np.ndarray, usage: np.ndarray,
+                demand: np.ndarray) -> np.ndarray:
+    """Per-dimension exhaustion mask in float64 (reference AllocsFit,
+    funcs.go:44-100): True where free capacity can't cover the demand.
+    Shared by the single-node and batched host fit checks."""
+    return ((cap.astype(np.float64) - usage.astype(np.float64))
+            < demand.astype(np.float64))
+
+
 def make_noise_vec(n_rows: int, rng: random.Random) -> np.ndarray:
     """Per-node tie-break jitter (the load-spreading analogue of the
     reference's node shuffle, stack.go:120-133)."""
@@ -602,7 +628,7 @@ class GenericStack:
         for alloc in self.ctx.plan.NodeAllocation.get(node.ID, ()):
             usage += alloc_vec(alloc)
         demand = resources_vec(cons.size)
-        lacking = nt.capacity[row] - usage < demand
+        lacking = fit_lacking(nt.capacity[row], usage, demand)
         if np.any(lacking):
             m.NodesExhausted += 1
             for d in np.flatnonzero(lacking):
@@ -611,12 +637,8 @@ class GenericStack:
                     m.DimensionExhausted.get(name, 0) + 1)
             return None
         util2 = usage[:2] + demand[:2]
-        with np.errstate(divide="ignore", invalid="ignore"):
-            free_pct = 1.0 - util2 / nt.score_cap[row]
-            total = np.power(10.0, free_pct[0]) + np.power(10.0, free_pct[1])
-        score = float(np.clip(20.0 - total, 0.0, 18.0))
-        if np.isnan(score):
-            score = 0.0
+        score = float(score_fit_rows(util2[None, :],
+                                     nt.score_cap[row][None, :])[0])
         option = SelectedOption(node=node, score=score)
         for task in tg.Tasks:
             option.task_resources[task.Name] = (
@@ -643,3 +665,106 @@ class SystemStack:
         if option is None:
             return None
         return self.inner._assign_networks(node, tg, option.score) or None
+
+    def select_batch_on_nodes(self, tg: TaskGroup, nodes: Sequence[Node]
+                              ) -> Optional[List[Optional[SelectedOption]]]:
+        """Vectorized per-pinned-node selection for ONE task group: the
+        system scheduler's sweep is `for node in all_nodes: select(tg,
+        node)`, which at 10k nodes is 10k Python constraint walks. All the
+        per-node checks are row math on the node tensor, so they run as a
+        handful of numpy ops over the whole batch instead (the TPU-framework
+        shape of system_sched.go:219-281's loop; the reference's per-node
+        semantics are preserved exactly).
+
+        Returns None when the group asks for network resources — port
+        bitmaps are per-node host state, the caller keeps the per-node path.
+        """
+        inner = self.inner
+        assert inner.job is not None and inner.elig is not None
+        if any(t.Resources is not None and t.Resources.Networks
+               for t in tg.Tasks):
+            return None
+        nt = inner.tindex.nt
+        ctx = inner.ctx
+        m = ctx.metrics
+
+        cons = task_group_constraints(tg)
+        job_mask, _, _ = inner.elig.job_mask(inner.job.ID,
+                                             inner.job.Constraints)
+        tg_mask, _, _ = inner.elig.tg_mask(inner.job.ID, tg.Name,
+                                           cons.constraints, cons.drivers)
+        demand = resources_vec(cons.size).astype(np.float64)
+
+        results: List[Optional[SelectedOption]] = [None] * len(nodes)
+        rows = np.empty(len(nodes), dtype=np.int64)
+        idxs: List[int] = []
+        for i, node in enumerate(nodes):
+            row = nt.row_of.get(node.ID)
+            if row is not None:
+                rows[len(idxs)] = row
+                idxs.append(i)
+        rows = rows[:len(idxs)]
+        if not len(rows):
+            return results
+
+        usage_rows, cap_rows = nt.snapshot_rows(rows)
+        usage_rows = usage_rows.astype(np.float64)
+        # In-plan deltas on these nodes (stops subtract, placements add) —
+        # mirrors select_on_node's per-node walk, batched by node id.
+        plan = ctx.plan
+        if plan.NodeUpdate or plan.NodeAllocation:
+            for k, i in enumerate(idxs):
+                nid = nodes[i].ID
+                for alloc in plan.NodeUpdate.get(nid, ()):
+                    full = ctx.state.alloc_by_id(alloc.ID) or alloc
+                    usage_rows[k] -= alloc_vec(full)
+                for alloc in plan.NodeAllocation.get(nid, ()):
+                    usage_rows[k] += alloc_vec(alloc)
+
+        ready = nt.ready[rows]
+        job_ok = job_mask[rows]
+        tg_ok = tg_mask[rows]
+        eligible = ready & job_ok & tg_ok
+        lacking = fit_lacking(cap_rows, usage_rows, demand[None, :])
+        fits = ~lacking.any(axis=1)
+        ok = eligible & fits
+
+        # Metrics: the exact counters select_on_node's per-node walk
+        # accumulates (not-ready counts filtered-only; constraint filters
+        # also record class + constraint labels via filter_node).
+        m.NodesEvaluated += len(rows)
+        m.NodesFiltered += int((~ready).sum())
+        job_filtered = ready & ~job_ok
+        tg_filtered = ready & job_ok & ~tg_ok
+        for sel, label in ((job_filtered, "job constraints"),
+                           (tg_filtered, "group constraints")):
+            for k in np.flatnonzero(sel):
+                m.filter_node(nodes[idxs[int(k)]], label)
+        exhausted = eligible & ~fits
+        m.NodesExhausted += int(exhausted.sum())
+        if exhausted.any():
+            # Per lacking dimension of each exhausted node, exactly like
+            # select_on_node's flatnonzero walk.
+            per_dim = (lacking & exhausted[:, None]).sum(axis=0)
+            for d, count in enumerate(per_dim.tolist()):
+                if count:
+                    name = DIM_NAMES[d]
+                    m.DimensionExhausted[name] = (
+                        m.DimensionExhausted.get(name, 0) + count)
+
+        util2 = usage_rows[:, :2] + demand[None, :2]
+        scores = score_fit_rows(util2, nt.score_cap[rows])
+
+        ok_list = ok.tolist()
+        score_list = scores.tolist()
+        for k, i in enumerate(idxs):
+            if not ok_list[k]:
+                continue
+            node = nodes[i]
+            option = SelectedOption(node=node, score=score_list[k])
+            for task in tg.Tasks:
+                option.task_resources[task.Name] = (
+                    task.Resources.copy() if task.Resources is not None
+                    else Resources())
+            results[i] = option
+        return results

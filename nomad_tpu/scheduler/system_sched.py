@@ -163,31 +163,53 @@ class SystemScheduler:
         self._compute_placements(diff.place)
 
     def _compute_placements(self, place) -> None:
-        """(reference: system_sched.go:219-281)"""
+        """(reference: system_sched.go:219-281). Placements group by task
+        group and run through the vectorized pinned-node batch select — a
+        10k-node system sweep is a few numpy ops, not 10k constraint walks.
+        Groups with network asks keep the exact per-node path (port bitmaps
+        are host state)."""
         node_by_id = {n.ID: n for n in self.nodes}
         self.ctx.metrics.NodesAvailable = self.node_by_dc
+
+        by_tg: Dict[str, List] = {}
         for tup in place:
             node = node_by_id.get(tup.Alloc.NodeID if tup.Alloc else "")
             if node is None:
                 continue
-            option = self.stack.select(tup.TaskGroup, node)
-            if option is None:
-                metric = self.failed_tg_allocs.get(tup.TaskGroup.Name)
-                if metric is not None:
-                    metric.CoalescedFailures += 1
-                else:
-                    self.failed_tg_allocs[tup.TaskGroup.Name] = self.ctx.metrics.copy()
-                continue
-            alloc = Allocation(
-                ID=generate_uuid(),
-                EvalID=self.eval.ID,
-                Name=tup.Name,
-                JobID=self.job.ID,
-                TaskGroup=tup.TaskGroup.Name,
-                Metrics=self.ctx.metrics.copy(),
-                NodeID=node.ID,
-                TaskResources=option.task_resources,
-                DesiredStatus=AllocDesiredStatusRun,
-                ClientStatus=AllocClientStatusPending,
-            )
-            self.plan.append_alloc(alloc)
+            by_tg.setdefault(tup.TaskGroup.Name, []).append((tup, node))
+
+        for pairs in by_tg.values():
+            tg = pairs[0][0].TaskGroup
+            options = self.stack.select_batch_on_nodes(
+                tg, [node for _, node in pairs])
+            if options is None:  # network asks: exact per-node path
+                options = [self.stack.select(tup.TaskGroup, node)
+                           for tup, node in pairs]
+            # One shared metrics snapshot per TG (scoring is done by now;
+            # a copy per alloc walks the metric maps P times — the same
+            # O(P^2) the generic path's build_placement_allocs avoids).
+            shared_metric = None
+            for (tup, node), option in zip(pairs, options):
+                if option is None:
+                    metric = self.failed_tg_allocs.get(tup.TaskGroup.Name)
+                    if metric is not None:
+                        metric.CoalescedFailures += 1
+                    else:
+                        self.failed_tg_allocs[tup.TaskGroup.Name] = \
+                            self.ctx.metrics.copy()
+                    continue
+                if shared_metric is None:
+                    shared_metric = self.ctx.metrics.copy()
+                alloc = Allocation(
+                    ID=generate_uuid(),
+                    EvalID=self.eval.ID,
+                    Name=tup.Name,
+                    JobID=self.job.ID,
+                    TaskGroup=tup.TaskGroup.Name,
+                    Metrics=shared_metric,
+                    NodeID=node.ID,
+                    TaskResources=option.task_resources,
+                    DesiredStatus=AllocDesiredStatusRun,
+                    ClientStatus=AllocClientStatusPending,
+                )
+                self.plan.append_alloc(alloc)

@@ -143,15 +143,12 @@ class Server:
                 raise ValueError(
                     f"scheduler_mesh must be \"all\" or \"\", got "
                     f"{self.config.scheduler_mesh!r}")
-            from nomad_tpu.parallel import scheduling_mesh
+            from nomad_tpu.parallel import pow2_prefix, scheduling_mesh
 
             import jax
 
-            devices = jax.devices()
-            n = 1
-            while n * 2 <= len(devices):
-                n *= 2
-            self.tindex.nt.set_mesh(scheduling_mesh(devices[:n]))
+            self.tindex.nt.set_mesh(
+                scheduling_mesh(pow2_prefix(jax.devices())))
 
         self.eval_broker = EvalBroker(self.config.eval_nack_timeout,
                                       self.config.eval_delivery_limit)

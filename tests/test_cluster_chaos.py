@@ -1,0 +1,222 @@
+"""Whole-cluster chaos test: a registration storm through a real networked
+3-server cluster while the leader is killed and a survivor's gossip is
+partitioned. The cross-subsystem composition the unit suites can't cover:
+gossip bootstrap -> raft -> broker -> distributed workers -> plan applier
+-> commit, under failover (reference composition: nomad/leader_test.go's
+leader-loss suites run against C1M-style load).
+
+Asserted invariants:
+  - every evaluation reaches a terminal state (nothing lost in failover)
+  - zero lost or duplicated allocations (exactly Count per job)
+  - no node oversubscribed (token protocol + plan re-verification held)
+  - throughput recovers: jobs submitted AFTER the kill also complete
+"""
+
+import random
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.gossip import GossipConfig
+from nomad_tpu.raft import RaftConfig
+from nomad_tpu.rpc.cluster import ClusterServer
+from nomad_tpu.server.server import ServerConfig
+from nomad_tpu.structs import to_dict
+from nomad_tpu.structs.structs import (
+    EvalStatusBlocked,
+    EvalStatusCancelled,
+    EvalStatusComplete,
+    EvalStatusFailed,
+)
+from nomad_tpu.tensor.node_table import alloc_vec, resources_vec
+
+from helpers import wait_for  # noqa: E402
+
+pytestmark = pytest.mark.timing_retry  # networked chaos suite: one retry
+
+FAST = RaftConfig(heartbeat_interval=0.02, election_timeout_min=0.08,
+                  election_timeout_max=0.16, apply_timeout=5.0)
+
+N_NODES = 80
+N_JOBS = 90
+PER_JOB = 3
+KILL_AT = 30        # jobs submitted before the leader dies
+PARTITION_AT = 60   # jobs submitted before a survivor's gossip partitions
+
+TERMINAL = (EvalStatusComplete, EvalStatusFailed, EvalStatusCancelled)
+
+
+def boot(name, join=None):
+    cs = ClusterServer(ServerConfig(
+        node_id="", num_schedulers=1, bootstrap_expect=3,
+        scheduler_window=8))
+    cs.connect([], raft_config=FAST)
+    cs.start()
+    cs.enable_gossip(name, join=join, gossip_config=GossipConfig.fast())
+    return cs
+
+
+def leader_of(nodes):
+    for n in nodes:
+        try:
+            if n.server is not None and n.server.is_leader() \
+                    and n.server._leader:
+                return n
+        except Exception:
+            pass
+    return None
+
+
+def make_job():
+    job = mock.job()
+    tg = job.TaskGroups[0]
+    tg.Count = PER_JOB
+    task = tg.Tasks[0]
+    task.Resources.CPU = 20
+    task.Resources.MemoryMB = 32
+    task.Resources.Networks = []
+    task.Services = []
+    return job
+
+
+class TestClusterChaos:
+    def test_storm_survives_leader_kill_and_gossip_partition(self):
+        nodes = [boot("s0")]
+        nodes.append(boot("s1", join=[_gaddr(nodes[0])]))
+        nodes.append(boot("s2", join=[_gaddr(nodes[0])]))
+        live = list(nodes)
+        try:
+            assert wait_for(lambda: leader_of(live) is not None, timeout=30)
+
+            # --- cluster inventory: mock nodes registered over RPC
+            for _ in range(N_NODES):
+                node = mock.node()
+                _rpc_retry(live, "Node.Register", {"Node": to_dict(node)})
+
+            jobs = [make_job() for _ in range(N_JOBS)]
+            submitted = {}  # job_id -> eval_id (first successful register)
+            errors = []
+
+            def storm():
+                for i, job in enumerate(jobs):
+                    if i == KILL_AT:
+                        kill_leader()
+                    if i == PARTITION_AT:
+                        partition_one()
+                    try:
+                        resp = _rpc_retry(live, "Job.Register",
+                                          {"Job": to_dict(job)})
+                        submitted[job.ID] = resp["EvalID"]
+                    except Exception as e:  # total cluster loss: fail test
+                        errors.append(e)
+                        return
+                    time.sleep(0.01)
+
+            partitioned = []
+
+            def kill_leader():
+                victim = leader_of(live)
+                if victim is not None:
+                    live.remove(victim)
+                    victim.shutdown()
+
+            def partition_one():
+                # A non-leader survivor loses its gossip links for a while
+                # (raft RPC stays up: the quorum keeps committing).
+                target = next((n for n in live
+                               if n is not leader_of(live)), None)
+                if target is None or target.membership is None:
+                    return
+                ml = target.membership.memberlist
+                ml.transport_filter = lambda dest, msgs: False
+                partitioned.append(ml)
+
+            t = threading.Thread(target=storm)
+            t.start()
+            t.join(timeout=120)
+            assert not t.is_alive(), "storm thread wedged"
+            assert not errors, f"storm lost the cluster: {errors[0]}"
+            assert len(submitted) == N_JOBS
+
+            # Heal the partition; the member refutes its suspicion and
+            # rejoins.
+            for ml in partitioned:
+                ml.transport_filter = None
+
+            # --- every eval terminal on the current leader
+            def all_terminal():
+                ldr = leader_of(live)
+                if ldr is None:
+                    return False
+                state = ldr.server.state
+                for eval_id in submitted.values():
+                    ev = state.eval_by_id(eval_id)
+                    if ev is None or ev.Status not in TERMINAL:
+                        return False
+                return True
+
+            assert wait_for(all_terminal, timeout=120, interval=0.25,
+                            msg="all evals terminal after chaos")
+
+            ldr = leader_of(live)
+            state = ldr.server.state
+
+            # --- zero lost or duplicated allocations
+            for job in jobs:
+                allocs = [a for a in state.allocs_by_job(job.ID)
+                          if not a.terminal_status()]
+                assert len(allocs) == PER_JOB, (
+                    f"job {job.ID}: {len(allocs)} allocs, want {PER_JOB}")
+                assert len({a.ID for a in allocs}) == len(allocs)
+
+            # --- no node oversubscribed
+            cap = {}
+            for n in state.nodes():
+                cap[n.ID] = resources_vec(n.Resources)
+            used = {}
+            for a in state.allocs():
+                if a.terminal_status():
+                    continue
+                u = used.setdefault(a.NodeID,
+                                    np.zeros(5, dtype=np.float64))
+                u += alloc_vec(a)
+            for nid, u in used.items():
+                assert (u <= cap[nid] + 1e-6).all(), (
+                    f"node {nid} oversubscribed: {u} > {cap[nid]}")
+
+            # --- throughput recovered: the post-kill jobs all placed
+            post_kill = jobs[KILL_AT:]
+            assert all(
+                len([a for a in state.allocs_by_job(j.ID)
+                     if not a.terminal_status()]) == PER_JOB
+                for j in post_kill)
+        finally:
+            for n in nodes:
+                try:
+                    n.shutdown()
+                except Exception:
+                    pass
+
+
+def _gaddr(cs):
+    ml = cs.membership.memberlist
+    return f"{ml.addr}:{ml.port}"
+
+
+def _rpc_retry(live, method, args, attempts=40, delay=0.25):
+    """Issue an RPC against any live server, retrying through elections
+    and dead connections (what a real API client's retry loop does)."""
+    last = None
+    for _ in range(attempts):
+        targets = [n for n in live if n.endpoints is not None]
+        random.shuffle(targets)
+        for cs in targets:
+            try:
+                return cs.endpoints.handle(method, dict(args))
+            except Exception as e:
+                last = e
+        time.sleep(delay)
+    raise last if last is not None else RuntimeError("no live servers")

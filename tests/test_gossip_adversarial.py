@@ -1,0 +1,279 @@
+"""Adversarial gossip tests: SWIM's invariants under loss, partitions,
+false accusation, incarnation races, and churn.
+
+The reference gets these properties from vendored hashicorp/memberlist;
+a from-scratch SWIM must prove them. The fault-injection seam is
+Memberlist.transport_filter (drops UDP sends and anti-entropy dials), which
+models lossy links and asymmetric partitions deterministically.
+"""
+
+import random
+import threading
+import time
+
+import msgpack
+import pytest
+
+from nomad_tpu.gossip import (
+    ALIVE,
+    DEAD,
+    GossipConfig,
+    Memberlist,
+)
+from nomad_tpu.gossip.memberlist import _ALIVE, _SUSPECT, SUSPECT
+
+from helpers import wait_for  # noqa: E402
+
+pytestmark = pytest.mark.timing_retry  # networked timing suite: one retry
+
+
+def make(name, events=None, tags=None, cfg=None):
+    cb = None
+    if events is not None:
+        cb = lambda ev, m: events.append((ev, m.name))
+    ml = Memberlist(name, tags=tags or {}, config=cfg or GossipConfig.fast(),
+                    on_event=cb)
+    ml.start()
+    return ml
+
+
+def build_cluster(names, cfg=None):
+    mls = [make(names[0], cfg=cfg)]
+    for name in names[1:]:
+        m = make(name, cfg=cfg)
+        assert m.join([f"{mls[0].addr}:{mls[0].port}"]) == 1
+        mls.append(m)
+    for m in mls:
+        wait_for(lambda m=m: m.num_alive() == len(names),
+                 msg=f"{m.name} converged")
+    return mls
+
+
+def states(ml):
+    return {m.name: m.state for m in ml.members()}
+
+
+class TestLossyLinks:
+    def test_cluster_survives_sustained_packet_loss(self):
+        """25% loss on every link: members may transiently be suspected but
+        refutation keeps every live member from being declared dead, and
+        after the loss clears the cluster re-converges fully alive."""
+        names = ["n%d" % i for i in range(5)]
+        mls = build_cluster(names)
+        try:
+            rng = random.Random(42)
+            for m in mls:
+                m.transport_filter = lambda dest, msgs: rng.random() > 0.25
+            # Several full suspicion cycles under loss.
+            time.sleep(2.0)
+            for m in mls:
+                m.transport_filter = None
+            # Everyone re-converges: all 5 alive at every member (suspects
+            # refute; no permanent death of a live node).
+            for m in mls:
+                wait_for(lambda m=m: all(
+                    x.state == ALIVE for x in m.members()),
+                    timeout=20, msg=f"{m.name} all-alive after loss")
+                assert m.num_alive() == 5
+        finally:
+            for m in mls:
+                m.shutdown()
+
+    def test_refutation_under_sustained_false_accusation(self):
+        """An attacker floods SUSPECT(victim) at everyone: the victim must
+        keep out-incarnating the accusations and never be declared dead."""
+        names = ["a", "b", "c", "d"]
+        mls = build_cluster(names)
+        victim = mls[1]
+        try:
+            stop = threading.Event()
+
+            def accuse():
+                import socket
+
+                sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+                while not stop.is_set():
+                    inc = victim.local_member().incarnation
+                    pkt = msgpack.packb(
+                        [(_SUSPECT, "b", inc, "a")], use_bin_type=True)
+                    for m in mls:
+                        if m.name != "b":
+                            sock.sendto(pkt, (m.addr, m.port))
+                    time.sleep(0.05)
+
+            t = threading.Thread(target=accuse, daemon=True)
+            t.start()
+            inc_before = victim.local_member().incarnation
+            time.sleep(2.0)  # ~7 suspicion timeouts under constant attack
+            stop.set()
+            t.join()
+            # The victim refuted (incarnation climbed) and nobody ever
+            # committed the death.
+            assert victim.local_member().incarnation > inc_before
+            for m in mls:
+                assert states(m)["b"] in (ALIVE, SUSPECT), states(m)
+            for m in mls:
+                wait_for(lambda m=m: states(m)["b"] == ALIVE,
+                         timeout=10, msg=f"{m.name} sees b alive")
+        finally:
+            for m in mls:
+                m.shutdown()
+
+
+class TestIncarnationRaces:
+    def test_concurrent_suspect_and_alive_converge_to_newest(self):
+        """A SUSPECT(inc=k) racing an ALIVE(inc=k+1) through different
+        members must converge to alive everywhere — incarnation order wins,
+        not arrival order."""
+        names = ["a", "b", "c", "d"]
+        mls = build_cluster(names)
+        a, b, c, d = mls
+        try:
+            import socket
+
+            sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+            inc = b.local_member().incarnation
+            member_b = b.local_member()
+            # c hears the stale suspicion; d hears the newer alive; both
+            # gossip their view onward.
+            sock.sendto(msgpack.packb([(_SUSPECT, "b", inc, "a")],
+                                      use_bin_type=True), (c.addr, c.port))
+            sock.sendto(msgpack.packb(
+                [(_ALIVE, "b", member_b.addr, member_b.port, inc + 1, {})],
+                use_bin_type=True), (d.addr, d.port))
+            for m in (a, c, d):
+                wait_for(lambda m=m: states(m)["b"] == ALIVE
+                         and next(x for x in m.members()
+                                  if x.name == "b").incarnation >= inc + 1,
+                         timeout=10,
+                         msg=f"{m.name} converges to alive@inc+1")
+        finally:
+            for m in mls:
+                m.shutdown()
+
+    def test_stale_suspect_after_refutation_is_ignored(self):
+        """A suspicion carrying an incarnation older than the member's
+        current one must be dropped on arrival."""
+        mls = build_cluster(["a", "b", "c"])
+        a, b, c = mls
+        try:
+            import socket
+
+            inc = b.local_member().incarnation
+            # b refutes pre-emptively (tag update bumps incarnation).
+            b.set_tags({"x": "1"})
+            wait_for(lambda: next(m for m in a.members()
+                                  if m.name == "b").incarnation > inc,
+                     msg="a sees b's new incarnation")
+            sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+            sock.sendto(msgpack.packb([(_SUSPECT, "b", inc, "c")],
+                                      use_bin_type=True), (a.addr, a.port))
+            time.sleep(0.3)
+            assert states(a)["b"] == ALIVE
+        finally:
+            for m in mls:
+                m.shutdown()
+
+
+class TestAsymmetricPartition:
+    def test_one_way_link_does_not_kill_a_reachable_member(self):
+        """b's packets to a are dropped (one-way break) but b<->c and a<->c
+        work: a may suspect b (pings unacked) but the suspicion must be
+        refuted through c — b is never declared dead anywhere."""
+        mls = build_cluster(["a", "b", "c"])
+        a, b, c = mls
+        try:
+            blocked = (a.addr, a.port)
+            b.transport_filter = lambda dest, msgs: dest != blocked
+            time.sleep(2.0)  # many probe rounds with the broken link
+            for m in mls:
+                assert states(m)["b"] != DEAD, (m.name, states(m))
+            b.transport_filter = None
+            for m in mls:
+                wait_for(lambda m=m: states(m)["b"] == ALIVE,
+                         timeout=10, msg=f"{m.name} sees b alive")
+        finally:
+            for m in mls:
+                m.shutdown()
+
+    def test_fully_isolated_member_dies_and_rejoins(self):
+        """b loses ALL outbound links: the cluster declares it dead within
+        the suspicion bound; when the partition heals, b rejoins and every
+        view re-converges."""
+        mls = build_cluster(["a", "b", "c", "d"])
+        a, b, c, d = mls
+        try:
+            b.transport_filter = lambda dest, msgs: False
+            # Inbound to b still works, but no acks/refutations escape.
+            for m in (a, c, d):
+                wait_for(lambda m=m: states(m)["b"] == DEAD,
+                         timeout=20, msg=f"{m.name} declares b dead")
+            b.transport_filter = None
+            # b re-announces (its own probes/gossip resume; push-pull
+            # heals the rest).
+            assert b.join([f"{a.addr}:{a.port}"]) == 1
+            for m in mls:
+                wait_for(lambda m=m: all(x.state == ALIVE
+                                         for x in m.members()),
+                         timeout=20, msg=f"{m.name} healed")
+        finally:
+            for m in mls:
+                m.shutdown()
+
+
+class TestChurn:
+    def test_ten_member_churn_converges(self):
+        """10 members; 3 crash (no leave). The 7 survivors converge on
+        exactly 7 alive within the suspicion bound, then 3 new members join
+        and every survivor converges on 10 alive."""
+        names = ["m%d" % i for i in range(10)]
+        mls = build_cluster(names)
+        try:
+            crashed = {"m3", "m6", "m9"}
+            for m in mls:
+                if m.name in crashed:
+                    m.shutdown()
+            live = [m for m in mls if m.name not in crashed]
+            for m in live:
+                wait_for(lambda m=m: m.num_alive() == 7,
+                         timeout=30, msg=f"{m.name} sees 7 after crashes")
+                assert all(states(m)[n] == DEAD for n in crashed)
+            newcomers = []
+            for name in ("x0", "x1", "x2"):
+                nm = make(name)
+                newcomers.append(nm)
+                assert nm.join([f"{live[0].addr}:{live[0].port}"]) == 1
+            mls.extend(newcomers)
+            for m in live + newcomers:
+                wait_for(lambda m=m: m.num_alive() == 10,
+                         timeout=30, msg=f"{m.name} sees 10 after joins")
+        finally:
+            for m in mls:
+                m.shutdown()
+
+    def test_piggyback_budget_starvation_still_disseminates(self):
+        """A burst of simultaneous state changes (several tag updates racing
+        a death) exceeds one packet's piggyback budget; retransmission must
+        still deliver every update."""
+        names = ["p%d" % i for i in range(8)]
+        mls = build_cluster(names)
+        try:
+            # 6 members change tags at once + one crashes: 7 broadcasts
+            # compete for the 6-message piggyback budget.
+            for i, m in enumerate(mls[:6]):
+                m.set_tags({"v": str(i)})
+            mls[7].shutdown()
+            survivors = mls[:7]
+            for m in survivors:
+                wait_for(lambda m=m: states(m)["p7"] == DEAD,
+                         timeout=30, msg=f"{m.name} sees the crash")
+                for i in range(6):
+                    wait_for(lambda m=m, i=i: next(
+                        (x for x in m.members() if x.name == f"p{i}"),
+                        None) is not None and next(
+                        x for x in m.members()
+                        if x.name == f"p{i}").tags.get("v") == str(i),
+                        timeout=30, msg=f"{m.name} sees p{i} tags")
+        finally:
+            for m in mls:
+                m.shutdown()

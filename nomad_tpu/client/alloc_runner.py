@@ -136,7 +136,8 @@ class AllocRunner:
                 try:
                     cwd, env = self._task_check_ctx(name, runner)
                     self.service_manager.register_task(
-                        alloc, new_task, cwd=cwd, env=env)
+                        alloc, new_task, cwd=cwd, env=env,
+                        exec_fn=self._task_exec_fn(runner))
                 except Exception:
                     logger.exception(
                         "alloc %s: service re-sync for %s failed",
@@ -244,7 +245,8 @@ class AllocRunner:
             if state == TaskStateRunning:
                 cwd, env = self._task_check_ctx(task_name, runner)
                 self.service_manager.register_task(
-                    self.alloc, runner.task, cwd=cwd, env=env)
+                    self.alloc, runner.task, cwd=cwd, env=env,
+                    exec_fn=self._task_exec_fn(runner))
             else:
                 self.service_manager.deregister_task(self.alloc.ID, task_name)
         except Exception:
@@ -259,6 +261,18 @@ class AllocRunner:
             self.alloc_dir.task_dirs.get(task_name, ""), "local") \
             if self.alloc_dir is not None else None
         return cwd, env.build_env() if env is not None else None
+
+    def _task_exec_fn(self, runner):
+        """In-task script exec bound to the task's LIVE handle: resolved at
+        call time (not capture time) so a restarted task's checks run in
+        the new container/chroot, and a dead handle falls back to host
+        execution instead of erroring."""
+        def exec_fn(command, args, timeout):
+            handle = runner.handle
+            if handle is None:
+                return None
+            return handle.exec_in_task(command, args, timeout)
+        return exec_fn
 
     def _alloc_status(self) -> tuple:
         """Aggregate task states -> alloc client status

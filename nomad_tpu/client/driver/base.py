@@ -10,7 +10,7 @@ import sys
 import threading
 import time
 from dataclasses import dataclass, field
-from typing import Any, Dict, Optional
+from typing import Any, Dict, Optional, Tuple
 
 from nomad_tpu.client.allocdir import AllocDir
 from nomad_tpu.client.env import TaskEnv
@@ -67,6 +67,16 @@ class DriverHandle:
         executor.go pid-tree stats / docker stats API)."""
         return None
 
+    def exec_in_task(self, command: str, args: list, timeout: float
+                     ) -> Optional[Tuple[int, str]]:
+        """Run a command INSIDE the task's execution context (container /
+        chroot) — script health checks use this so a check can't pass on
+        the host while the service is broken in its isolation (reference:
+        executor/checks.go:31-65 DockerScriptCheck + ExecScriptCheck).
+        Returns (exit_code, output), or None when the driver has no
+        in-task exec (caller falls back to host cwd/env execution)."""
+        return None
+
 
 class Driver:
     name = "base"
@@ -87,6 +97,20 @@ class Driver:
     def open(self, ctx: ExecContext, handle_id: str) -> DriverHandle:
         """Re-attach to a running task after agent restart."""
         raise NotImplementedError
+
+
+def run_exec_argv(argv: list, timeout: float, cwd=None, env=None
+                  ) -> Tuple[int, str]:
+    """Run an in-task exec argv with the shared timeout/error mapping and
+    output truncation (one definition for every driver's exec_in_task)."""
+    try:
+        proc = subprocess.run(argv, capture_output=True, text=True,
+                              timeout=timeout, cwd=cwd, env=env)
+    except subprocess.TimeoutExpired:
+        return 2, f"in-task exec timed out after {timeout:.0f}s"
+    except OSError as e:
+        return 2, str(e)
+    return proc.returncode, (proc.stdout + proc.stderr)[-4096:]
 
 
 class ExecutorHandle(DriverHandle):
@@ -112,6 +136,33 @@ class ExecutorHandle(DriverHandle):
         data = json.loads(handle_id)
         return ExecutorHandle(data["state_dir"], data["task_name"],
                               data["executor_pid"])
+
+    def exec_in_task(self, command: str, args: list, timeout: float
+                     ) -> Optional[Tuple[int, str]]:
+        """Execute inside the task's context from its persisted spec: same
+        chroot (when the task has one), cwd, and environment (reference:
+        ExecScriptCheck runs through the executor, checks.go:31-65)."""
+        spec_path = os.path.join(self.state_dir,
+                                 f"{self.task_name}.executor_spec.json")
+        try:
+            with open(spec_path) as f:
+                spec = json.load(f)
+        except (OSError, ValueError):
+            # Missing or mid-rewrite spec (task restarting): host fallback
+            # rather than a spurious critical.
+            return None
+        chroot = spec.get("chroot")
+        cwd = spec.get("cwd")
+        env = spec.get("env") or None
+
+        argv = [command] + list(args)
+        if chroot:
+            # chroot(1) rather than a preexec_fn os.chroot: preexec_fn is
+            # documented deadlock-prone with threads, and checks run on the
+            # service manager's worker pool.
+            argv = ["chroot", chroot] + argv
+            cwd = None  # host cwd is meaningless post-chroot
+        return run_exec_argv(argv, timeout, cwd=cwd, env=env)
 
     # -------------------------------------------------------------- running
     def _exit_path(self) -> str:
@@ -248,8 +299,12 @@ def launch_executor(state_dir: str, task_name: str, spec: Dict[str, Any]
     os.makedirs(state_dir, exist_ok=True)
     spec_path = os.path.join(state_dir, f"{task_name}.executor_spec.json")
     spec = dict(spec, task_name=task_name)
-    with open(spec_path, "w") as f:
+    # Atomic write: exec_in_task (script checks) may read the spec while a
+    # restart rewrites it.
+    tmp_path = spec_path + ".tmp"
+    with open(tmp_path, "w") as f:
         json.dump(spec, f)
+    os.replace(tmp_path, spec_path)
     # Clear stale exit/state files from a previous run.
     for suffix in ("exit_status.json", "executor_state.json"):
         try:

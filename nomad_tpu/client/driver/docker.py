@@ -50,6 +50,31 @@ class DockerHandle(DriverHandle):
                             max_files=data.get("max_files", 10),
                             max_file_size_mb=data.get("max_file_size_mb", 10))
 
+    def exec_in_task(self, command: str, args: list, timeout: float):
+        """`docker exec` into the container (reference: DockerScriptCheck,
+        executor/checks.go:31-53): a script check observes the container's
+        filesystem/network, not the host's.
+
+        The deadline is enforced IN-CONTAINER via timeout(1) when the image
+        has it: killing only the local docker CLI on timeout leaves the
+        exec'd process running inside the container, leaking one stuck
+        check process per tick. The host-side timeout stays as the backstop
+        for images without coreutils/busybox."""
+        from .base import run_exec_argv
+
+        wrapped = ["docker", "exec", self.container_id, "timeout",
+                   str(int(timeout)), command] + list(args)
+        code, output = run_exec_argv(wrapped, timeout + 5)
+        if code in (126, 127) and "timeout" in output and (
+                "not found" in output or "executable" in output):
+            # Image lacks timeout(1): run unwrapped with the host deadline.
+            plain = ["docker", "exec", self.container_id, command] \
+                + list(args)
+            code, output = run_exec_argv(plain, timeout)
+        elif code == 124:  # timeout(1)'s timed-out exit code
+            return 2, f"in-task exec timed out after {timeout:.0f}s"
+        return code, output
+
     def _since_path(self) -> str:
         import os
 

@@ -51,6 +51,14 @@ class ExecDriver(Driver):
         if task.Resources is not None:
             spec["cgroup"] = {"cpu_shares": task.Resources.CPU,
                               "memory_mb": task.Resources.MemoryMB}
+        # Chroot into the task dir with the host system dirs bind-mounted
+        # read-only (reference: exec.go + alloc_dir_linux.go Embed). Skipped
+        # for non-root (fingerprint already gates on root) and by the
+        # operator escape hatches.
+        if (os.geteuid() == 0
+                and os.environ.get("NOMAD_TPU_EXEC_CHROOT", "1") != "0"
+                and not task.Config.get("no_chroot")):
+            spec["chroot"] = ctx.alloc_dir.build_chroot(task.Name)
         return launch_executor(ctx.alloc_dir.task_dirs[task.Name],
                                task.Name, spec)
 

@@ -1,11 +1,12 @@
 """Health check runners: http / tcp / script.
 
 One stateless entry point, `run_check`, executed on the service manager's
-worker pool per (check, interval) tick. The reference delegates http/tcp
-checks to Consul and runs script checks through the executor
-(client/driver/executor/checks.go:31-65); here all three run in the client
-agent, with script checks executed in the task's directory with the task's
-environment.
+worker pool per (check, interval) tick. http/tcp checks run from the client
+agent; script checks run INSIDE the task's execution context via the
+driver handle's exec (docker exec for containers, chroot-side execution
+for exec tasks — reference: client/driver/executor/checks.go:31-65),
+falling back to host execution with the task's cwd/env only when the
+driver has no in-task exec (raw_exec semantics).
 """
 
 from __future__ import annotations
@@ -31,8 +32,13 @@ from nomad_tpu.structs.structs import (
 
 def run_check(check: ServiceCheck, address: str, port: int,
               cwd: Optional[str] = None,
-              env: Optional[dict] = None) -> Tuple[str, str]:
-    """Execute one check; returns (status, output). Never raises."""
+              env: Optional[dict] = None,
+              exec_fn=None) -> Tuple[str, str]:
+    """Execute one check; returns (status, output). Never raises.
+
+    exec_fn: optional `(command, args, timeout) -> (exit_code, output) |
+    None` running inside the task's isolation (DriverHandle.exec_in_task);
+    script checks prefer it over host execution."""
     timeout = max(ns_to_seconds(check.Timeout), 1.0)
     kind = check.Type.lower()
     try:
@@ -41,7 +47,7 @@ def run_check(check: ServiceCheck, address: str, port: int,
         if kind == ServiceCheckTCP:
             return _tcp_check(address, port, timeout)
         if kind == ServiceCheckScript:
-            return _script_check(check, timeout, cwd, env)
+            return _script_check(check, timeout, cwd, env, exec_fn)
         return CheckStatusCritical, f"unknown check type {check.Type!r}"
     except Exception as e:  # a check must never take down the manager
         return CheckStatusCritical, str(e)
@@ -76,8 +82,23 @@ def _tcp_check(address: str, port: int, timeout: float) -> Tuple[str, str]:
 
 
 def _script_check(check: ServiceCheck, timeout: float,
-                  cwd: Optional[str], env: Optional[dict]) -> Tuple[str, str]:
-    """Exit 0 passing, 1 warning, else critical (Consul script semantics)."""
+                  cwd: Optional[str], env: Optional[dict],
+                  exec_fn=None) -> Tuple[str, str]:
+    """Exit 0 passing, 1 warning, else critical (Consul script semantics).
+    Runs in the task's isolation when the driver provides an exec."""
+    if exec_fn is not None:
+        try:
+            result = exec_fn(check.Command, list(check.Args), timeout)
+        except Exception as e:
+            result = (2, f"in-task exec failed: {e}")
+        if result is not None:
+            code, output = result
+            if code == 0:
+                return CheckStatusPassing, output
+            if code == 1:
+                return CheckStatusWarning, output
+            return CheckStatusCritical, output
+        # Driver has no in-task exec: host fallback below.
     try:
         proc = subprocess.run(
             [check.Command] + list(check.Args), capture_output=True,

@@ -60,17 +60,21 @@ class _Check:
 
 
 class _Instance:
-    __slots__ = ("reg", "checks", "alloc_id", "task_name", "cwd", "env")
+    __slots__ = ("reg", "checks", "alloc_id", "task_name", "cwd", "env",
+                 "exec_fn")
 
     def __init__(self, reg: ServiceRegistration, checks: List[_Check],
                  alloc_id: str, task_name: str,
-                 cwd: Optional[str], env: Optional[dict]):
+                 cwd: Optional[str], env: Optional[dict], exec_fn=None):
         self.reg = reg
         self.checks = checks
         self.alloc_id = alloc_id
         self.task_name = task_name
         self.cwd = cwd
         self.env = env
+        # In-task script exec (DriverHandle.exec_in_task), preferred over
+        # host cwd/env execution for script checks.
+        self.exec_fn = exec_fn
 
 
 def _same_registration(prev: _Instance, reg: ServiceRegistration,
@@ -114,7 +118,8 @@ class ServiceManager:
     # ------------------------------------------------------------- lifecycle
     def register_task(self, alloc: Allocation, task: Task,
                       cwd: Optional[str] = None,
-                      env: Optional[dict] = None) -> None:
+                      env: Optional[dict] = None,
+                      exec_fn=None) -> None:
         """Register the task's services — idempotent, and RECONCILING: a
         service dropped from the task definition (in-place update) is
         deregistered (reference: the Consul syncer diffs desired vs
@@ -135,7 +140,7 @@ class ServiceManager:
                     JobID=alloc.JobID, AllocID=alloc.ID, TaskName=task.Name,
                     NodeID=self.node.ID, Address=address, Port=port)
                 prev = self._instances.get(reg.ID)
-                inst_cwd, inst_env = cwd, env
+                inst_cwd, inst_env, inst_exec = cwd, env, exec_fn
                 if prev is not None:
                     if _same_registration(prev, reg, svc):
                         continue  # unchanged: keep check state and timers
@@ -148,12 +153,14 @@ class ServiceManager:
                         inst_cwd = prev.cwd
                     if inst_env is None:
                         inst_env = prev.env
+                    if inst_exec is None:
+                        inst_exec = prev.exec_fn
                     self._drop(reg.ID)
                 checks = [_Check(c) for c in svc.Checks]
                 reg.Checks = [c.state for c in checks]
                 reg.Status = reg.derive_status()
                 inst = _Instance(reg, checks, alloc.ID, task.Name,
-                                 inst_cwd, inst_env)
+                                 inst_cwd, inst_env, inst_exec)
                 self._instances[reg.ID] = inst
                 self._deletes.discard(reg.ID)
                 self._dirty.add(reg.ID)
@@ -216,9 +223,9 @@ class ServiceManager:
             if inst is None or check.seq != seq:
                 return
             reg = inst.reg
-            cwd, env = inst.cwd, inst.env
+            cwd, env, exec_fn = inst.cwd, inst.env, inst.exec_fn
         status, output = run_check(check.spec, reg.Address, reg.Port,
-                                   cwd=cwd, env=env)
+                                   cwd=cwd, env=env, exec_fn=exec_fn)
         restart: Optional[str] = None
         with self._lock:
             if check.seq != seq or rid not in self._instances:

@@ -1,0 +1,182 @@
+"""Script health checks run INSIDE the task's execution context.
+
+A check that passes on the host while the service is broken in its
+chroot/container (or vice versa) is exactly the false signal health checks
+exist to prevent (reference: client/driver/executor/checks.go:31-65 runs
+script checks through the executor / docker exec). These tests build a real
+chroot, start a real exec-driver task in it, and prove the IN-TASK result
+wins over what host execution would have said.
+"""
+
+import os
+import platform
+import subprocess
+import tempfile
+import time
+
+import pytest
+
+from nomad_tpu.client.allocdir import AllocDir
+from nomad_tpu.client.driver.base import (
+    ExecContext,
+    build_executor_spec,
+    launch_executor,
+)
+from nomad_tpu.client.env import TaskEnv
+from nomad_tpu.services.checks import run_check
+from nomad_tpu.structs import ServiceCheck, Task
+from nomad_tpu.structs.structs import (
+    CheckStatusCritical,
+    CheckStatusPassing,
+    ServiceCheckScript,
+)
+
+SEC = 1_000_000_000  # ns
+
+
+def _can_chroot() -> bool:
+    if platform.system() != "Linux" or os.geteuid() != 0:
+        return False
+    probe = tempfile.mkdtemp(prefix="mountprobe-")
+    target = os.path.join(probe, "bin")
+    os.makedirs(target)
+    try:
+        ok = subprocess.run(["mount", "--bind", "/bin", target],
+                            capture_output=True).returncode == 0
+        if ok:
+            subprocess.run(["umount", target], capture_output=True)
+        return ok
+    finally:
+        subprocess.run(["umount", "-l", target], capture_output=True)
+        os.rmdir(target)
+        os.rmdir(probe)
+
+
+pytestmark = pytest.mark.skipif(
+    not _can_chroot(), reason="needs root + bind mounts (linux)")
+
+
+def script_check(command, args):
+    return ServiceCheck(Name="sc", Type=ServiceCheckScript,
+                        Command=command, Args=list(args),
+                        Interval=1 * SEC, Timeout=5 * SEC)
+
+
+class TestChrootBuild:
+    def test_build_and_destroy_preserves_host(self, tmp_path):
+        ad = AllocDir(str(tmp_path / "alloc1"))
+        ad.build(["t"])
+        root = ad.build_chroot("t")
+        try:
+            # A shell resolves inside the chroot.
+            assert os.path.exists(os.path.join(root, "bin"))
+            r = subprocess.run(
+                ["chroot", root, "/bin/sh", "-c", "echo from-chroot"],
+                capture_output=True, text=True)
+            assert r.returncode == 0 and "from-chroot" in r.stdout
+            # Read-only: writing into the bind-mounted /bin fails.
+            r = subprocess.run(
+                ["chroot", root, "/bin/sh", "-c",
+                 "touch /bin/___nomad_probe 2>/dev/null"],
+                capture_output=True)
+            assert r.returncode != 0
+        finally:
+            ad.destroy()
+        # Host /bin intact, mounts gone, alloc dir removed.
+        assert os.path.exists("/bin/sh")
+        assert not os.path.exists(str(tmp_path / "alloc1"))
+
+
+class TestInTaskScriptChecks:
+    def _start_task(self, tmp_path):
+        ad = AllocDir(str(tmp_path / "alloc2"))
+        ad.build(["web"])
+        task = Task(Name="web", Driver="exec",
+                    Config={"command": "/bin/sleep", "args": ["60"]})
+        env = TaskEnv()
+        ctx = ExecContext(alloc_dir=ad, alloc_id="a1", task_env=env)
+        spec = build_executor_spec(ctx, task, "/bin/sleep", ["60"])
+        spec["chroot"] = ad.build_chroot("web")
+        handle = launch_executor(ad.task_dirs["web"], "web", spec)
+        return ad, handle
+
+    def test_in_task_result_wins_over_host(self, tmp_path):
+        """The marker exists only at the chroot's root: host execution says
+        critical, in-task execution says passing — the in-task result must
+        be the one recorded."""
+        ad, handle = self._start_task(tmp_path)
+        try:
+            marker = os.path.join(ad.task_dirs["web"], "in_task_marker")
+            open(marker, "w").write("x")
+            check = script_check("/bin/sh",
+                                 ["-c", "test -f /in_task_marker || exit 2"])
+
+            # Host-side execution (no exec_fn): the path doesn't exist.
+            status_host, _ = run_check(check, "127.0.0.1", 0, cwd="/")
+            assert status_host == CheckStatusCritical
+
+            # In-task execution through the handle: sees the chroot root.
+            status, _ = run_check(check, "127.0.0.1", 0, cwd="/",
+                                  exec_fn=handle.exec_in_task)
+            assert status == CheckStatusPassing
+        finally:
+            handle.kill(kill_timeout=1.0)
+            ad.destroy()
+
+    def test_host_pass_task_fail_detected(self, tmp_path):
+        """Inverse direction: a file that exists on the host but not in the
+        chroot — the host would report healthy, the in-task check reports
+        the truth (critical)."""
+        ad, handle = self._start_task(tmp_path)
+        host_marker = str(tmp_path / "host_only_marker")
+        open(host_marker, "w").write("x")
+        try:
+            check = script_check("/bin/sh",
+                                 ["-c", f"test -f {host_marker} || exit 2"])
+            status_host, _ = run_check(check, "127.0.0.1", 0)
+            assert status_host == CheckStatusPassing
+            status, _ = run_check(check, "127.0.0.1", 0,
+                                  exec_fn=handle.exec_in_task)
+            assert status == CheckStatusCritical
+        finally:
+            handle.kill(kill_timeout=1.0)
+            ad.destroy()
+
+    def test_task_env_reaches_in_task_check(self, tmp_path):
+        """The executor spec's env is the check's env (reference: checks run
+        with the task environment)."""
+        ad = AllocDir(str(tmp_path / "alloc3"))
+        ad.build(["web"])
+        task = Task(Name="web", Driver="raw_exec",
+                    Config={"command": "/bin/sleep", "args": ["60"]})
+        env = TaskEnv()
+        env.env["MY_MARKER"] = "hello42"
+        ctx = ExecContext(alloc_dir=ad, alloc_id="a2", task_env=env)
+        spec = build_executor_spec(ctx, task, "/bin/sleep", ["60"])
+        handle = launch_executor(ad.task_dirs["web"], "web", spec)
+        try:
+            check = script_check(
+                "/bin/sh", ["-c", 'test "$MY_MARKER" = hello42'])
+            status, _ = run_check(check, "127.0.0.1", 0,
+                                  exec_fn=handle.exec_in_task)
+            assert status == CheckStatusPassing
+        finally:
+            handle.kill(kill_timeout=1.0)
+            ad.destroy()
+
+
+class TestChrootRestart:
+    def test_rebuild_is_idempotent_and_destroy_clean(self, tmp_path):
+        """A restarting exec task calls build_chroot again: the existing
+        chroot is reused (no stacked mounts) and destroy still removes the
+        alloc dir cleanly."""
+        ad = AllocDir(str(tmp_path / "alloc4"))
+        ad.build(["t"])
+        ad.build_chroot("t")
+        n_mounts = len(ad._mounts)
+        root2 = ad.build_chroot("t")  # restart path
+        assert len(ad._mounts) == n_mounts, "mounts stacked on rebuild"
+        assert root2 == ad.task_dirs["t"]
+        ad.destroy()
+        assert not os.path.exists(str(tmp_path / "alloc4"))
+        assert os.path.exists("/bin/sh")

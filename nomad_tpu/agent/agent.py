@@ -58,6 +58,14 @@ class AgentConfig:
     enable_syslog: bool = False
     # Expose /v1/agent/debug/* (reference: enable_debug gating pprof)
     enable_debug: bool = False
+    # TLS for the RPC mux (reference: config.go TLSConfig; tls{} block):
+    # both the server listener and every outgoing pool (raft, forwarding,
+    # membership probes, client heartbeats) use it.
+    tls_enable_rpc: bool = False
+    tls_ca_file: str = ""
+    tls_cert_file: str = ""
+    tls_key_file: str = ""
+    tls_verify_incoming: bool = True
 
     @staticmethod
     def dev() -> "AgentConfig":
@@ -102,6 +110,17 @@ class LogRing(logging.Handler):
             seq = self._seq
         out = [(s, line) for s, line in snapshot if s > after]
         return (out[-lines:] if lines > 0 else []), seq
+
+
+def _agent_tls(config: "AgentConfig"):
+    if not config.tls_enable_rpc:
+        return None
+    from nomad_tpu.rpc.tls import TLSConfig
+
+    return TLSConfig(enable_rpc=True, ca_file=config.tls_ca_file,
+                     cert_file=config.tls_cert_file,
+                     key_file=config.tls_key_file,
+                     verify_incoming=config.tls_verify_incoming)
 
 
 class Agent:
@@ -201,7 +220,8 @@ class Agent:
             bootstrap_expect=self.config.bootstrap_expect,
         )
         self.cluster = ClusterServer(sconf, bind_addr=self.config.bind_addr,
-                                     port=self.config.rpc_port)
+                                     port=self.config.rpc_port,
+                                     tls=_agent_tls(self.config))
         # Durable raft log + term/vote (reference: raft-boltdb store,
         # server.go setupRaft) — a restarted server must not re-vote in a
         # term it already voted in, nor re-bootstrap a formed cluster.
@@ -254,7 +274,14 @@ class Agent:
                 raise ValueError(
                     "client-only agents need config.servers (RPC addresses) "
                     "or server_discovery_url")
-            channel = NetServerChannel(servers)
+            tls = _agent_tls(self.config)
+            if tls is not None:
+                from nomad_tpu.rpc.tls import client_context
+
+                channel = NetServerChannel(
+                    servers, tls_context=client_context(tls))
+            else:
+                channel = NetServerChannel(servers)
         self.client = Client(cconf, channel)
         if self.config.node_name:
             self.client.node.Name = self.config.node_name
@@ -302,7 +329,11 @@ class Agent:
                 "no server running on this agent and no servers configured")
         from nomad_tpu.rpc.pool import ConnError, ConnPool
         if self._rpc_pool is None:
-            self._rpc_pool = ConnPool()
+            from nomad_tpu.rpc.tls import client_context
+
+            tls = _agent_tls(self.config)
+            self._rpc_pool = ConnPool(
+                tls_context=client_context(tls) if tls else None)
         last_exc: Exception = ValueError("no servers reachable")
         for addr in servers:
             try:

@@ -101,4 +101,13 @@ def config_from_dict(data: dict) -> AgentConfig:
                                           cfg.server_discovery_url)
     cfg.meta = {k: str(v) for k, v in (client.get("meta") or {}).items()}
     cfg.options = {k: str(v) for k, v in (client.get("options") or {}).items()}
+
+    # TLS for the RPC mux (reference: config.go TLSConfig; tls {} block).
+    tls = data.get("tls") or {}
+    cfg.tls_enable_rpc = bool(tls.get("rpc", cfg.tls_enable_rpc))
+    cfg.tls_ca_file = tls.get("ca_file", cfg.tls_ca_file)
+    cfg.tls_cert_file = tls.get("cert_file", cfg.tls_cert_file)
+    cfg.tls_key_file = tls.get("key_file", cfg.tls_key_file)
+    cfg.tls_verify_incoming = bool(tls.get("verify_incoming",
+                                           cfg.tls_verify_incoming))
     return cfg

@@ -57,6 +57,25 @@ class HTTPServer:
             self._httpd.server_close()
 
 
+def _accepts_gzip(header: str) -> bool:
+    """True when the Accept-Encoding header permits gzip — a bare substring
+    match would treat the explicit refusal 'gzip;q=0' as acceptance."""
+    for part in header.split(","):
+        token, _, params = part.strip().partition(";")
+        if token.strip().lower() not in ("gzip", "*"):
+            continue
+        q = 1.0
+        for p in params.split(";"):
+            k, _, v = p.strip().partition("=")
+            if k.strip().lower() == "q":
+                try:
+                    q = float(v)
+                except ValueError:
+                    q = 0.0
+        return q > 0
+    return False
+
+
 def _make_handler(agent):
     class Handler(BaseHTTPRequestHandler):
         protocol_version = "HTTP/1.1"
@@ -69,6 +88,16 @@ def _make_handler(agent):
             body = json.dumps(obj).encode()
             self.send_response(code)
             self.send_header("Content-Type", "application/json")
+            # gzip for clients that accept it (reference: every handler is
+            # gzip-wrapped, command/agent/http.go:70-80) — list responses
+            # like /v1/allocations run to megabytes of JSON. Small bodies
+            # skip it: the header+CPU overhead beats the saved bytes.
+            if _accepts_gzip(self.headers.get("Accept-Encoding", "")) \
+                    and len(body) >= 1024:
+                import gzip as _gzip
+
+                body = _gzip.compress(body, compresslevel=1)
+                self.send_header("Content-Encoding", "gzip")
             self.send_header("Content-Length", str(len(body)))
             if index is not None:
                 self.send_header("X-Nomad-Index", str(index))

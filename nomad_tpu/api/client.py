@@ -88,9 +88,14 @@ class Client:
                                      method=method)
         if data is not None:
             req.add_header("Content-Type", "application/json")
+        req.add_header("Accept-Encoding", "gzip")
         try:
             with urllib.request.urlopen(req, timeout=timeout) as resp:
                 raw = resp.read()
+                if resp.headers.get("Content-Encoding") == "gzip":
+                    import gzip
+
+                    raw = gzip.decompress(raw)
                 meta = QueryMeta(
                     last_index=int(resp.headers.get("X-Nomad-Index", 0)),
                     known_leader=resp.headers.get(

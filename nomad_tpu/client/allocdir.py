@@ -82,6 +82,9 @@ class AllocDir:
         root = self.task_dirs[task]
         if task in self._chroots:
             return root
+        # Roll back only THIS task's mounts on failure: a sibling task of
+        # the same alloc may be running out of its own chroot.
+        before = len(self._mounts)
         try:
             for src in chroot_env:
                 if not os.path.isdir(src):
@@ -103,7 +106,8 @@ class AllocDir:
             os.makedirs(shared, exist_ok=True)
             self._bind(self.shared_dir, shared, readonly=False)
         except Exception:
-            self.unmount_all()
+            mine, self._mounts = self._mounts[before:], self._mounts[:before]
+            self._unmount(mine)
             raise
         self._chroots.add(task)
         return root
@@ -141,17 +145,39 @@ class AllocDir:
             pass
         return points
 
-    def unmount_all(self) -> bool:
-        """Tear down chroot mounts in reverse order. Returns True when no
-        mounts remain (verified against /proc/self/mountinfo)."""
-        for dest in reversed(self._mounts):
+    @staticmethod
+    def _unmount(dests) -> None:
+        for dest in reversed(list(dests)):
             r = subprocess.run(["umount", dest], capture_output=True)
             if r.returncode != 0:
-                # Busy mount: detach lazily, then re-verify below.
+                # Busy mount: detach lazily; callers re-verify via
+                # /proc/self/mountinfo.
                 subprocess.run(["umount", "-l", dest], capture_output=True)
-        live = self._live_mounts()
-        remaining = [d for d in self._mounts
-                     if os.path.realpath(d) in live]
+
+    def unmount_all(self) -> bool:
+        """Tear down chroot mounts in reverse order — the tracked list PLUS
+        anything /proc/self/mountinfo shows under the alloc dir. The kernel
+        table is authoritative: after an agent restart the in-memory list
+        is empty but the previous process's chroot mounts are still live,
+        and destroy()'s rmtree through a live /dev or /bin bind would
+        delete host files. Returns True when nothing remains mounted under
+        the alloc dir."""
+        root = os.path.realpath(self.alloc_dir)
+
+        def under_alloc(points) -> List[str]:
+            # Deepest-first so nested mounts unwind in order.
+            return sorted(
+                (p for p in points
+                 if p == root or p.startswith(root + os.sep)),
+                key=len, reverse=True)
+
+        self._unmount(self._mounts)
+        untracked = under_alloc(self._live_mounts())
+        if untracked:
+            logger.info("unmounting %d untracked chroot mounts under %s "
+                        "(previous agent run)", len(untracked), root)
+            self._unmount(untracked)
+        remaining = under_alloc(self._live_mounts())
         for dest in remaining:
             logger.error("chroot mount still active: %s", dest)
         self._mounts = remaining

@@ -159,8 +159,19 @@ class ExecutorHandle(DriverHandle):
         if chroot:
             # chroot(1) rather than a preexec_fn os.chroot: preexec_fn is
             # documented deadlock-prone with threads, and checks run on the
-            # service manager's worker pool.
-            argv = ["chroot", chroot] + argv
+            # service manager's worker pool. Resolved to an ABSOLUTE path
+            # with the agent's PATH — the task env has no PATH, and
+            # subprocess would otherwise search os.defpath, which misses
+            # /usr/sbin (where Debian keeps chroot).
+            import shutil as _shutil
+
+            chroot_bin = _shutil.which("chroot") or next(
+                (p for p in ("/usr/sbin/chroot", "/sbin/chroot",
+                             "/usr/bin/chroot")
+                 if os.access(p, os.X_OK)), None)
+            if chroot_bin is None:
+                return 2, "chroot binary not found on host"
+            argv = [chroot_bin, chroot] + argv
             cwd = None  # host cwd is meaningless post-chroot
         return run_exec_argv(argv, timeout, cwd=cwd, env=env)
 

@@ -180,10 +180,11 @@ class NetServerChannel:
     REBALANCE_INTERVAL = 120.0
 
     def __init__(self, servers: List[str],
-                 rebalance_interval: Optional[float] = None):
+                 rebalance_interval: Optional[float] = None,
+                 tls_context=None):  # noqa: D401
         from nomad_tpu.rpc import ConnPool
 
-        self.pool = ConnPool()
+        self.pool = ConnPool(tls_context=tls_context)
         self.proxy = RpcProxy(servers)
         self._stop_rebalance = threading.Event()
         interval = (self.REBALANCE_INTERVAL if rebalance_interval is None

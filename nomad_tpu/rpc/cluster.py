@@ -18,10 +18,22 @@ from .transport import TCPTransport
 
 
 class ClusterServer:
-    def __init__(self, config, bind_addr: str = "127.0.0.1", port: int = 0):
+    def __init__(self, config, bind_addr: str = "127.0.0.1", port: int = 0,
+                 tls=None):
+        """tls: optional rpc.tls.TLSConfig — every stream on the shared
+        port (application RPC and raft) then rides the TLS mux byte, and
+        with verify_incoming plaintext connections are refused outright
+        (reference: rpc.go:25-30,88-132 + config.go TLSConfig)."""
+        from .tls import client_context, server_context
+
         self.config = config
         self.bind_addr = bind_addr
-        self.rpc_server = RPCServer(bind_addr, port)
+        self.tls = tls
+        self._client_tls = client_context(tls) if tls else None
+        self.rpc_server = RPCServer(
+            bind_addr, port,
+            tls_context=server_context(tls) if tls else None,
+            require_tls=bool(tls and tls.enable_rpc and tls.verify_incoming))
         self.addr = self.rpc_server.addr
         config.node_id = self.addr
         self.server = None
@@ -33,11 +45,19 @@ class ClusterServer:
                 region_router=None, region_lister=None) -> None:
         from nomad_tpu.server.server import Server
 
-        self.transport = TCPTransport()
+        from .pool import ConnPool
+        from .wire import RPC_NOMAD, RPC_RAFT
+
+        self.transport = TCPTransport(
+            pool=ConnPool(stream_type=RPC_RAFT,
+                          tls_context=self._client_tls))
         self.server = Server(self.config, transport=self.transport,
                              peers=list(peers), log_store=log_store,
                              raft_config=raft_config)
         self.endpoints = Endpoints(self.server,
+                                   pool=ConnPool(
+                                       stream_type=RPC_NOMAD,
+                                       tls_context=self._client_tls),
                                    region_router=region_router,
                                    region_lister=region_lister)
         self.rpc_server.rpc_handler = self.endpoints.handle
@@ -57,7 +77,7 @@ class ClusterServer:
         self.membership = ServerMembership(
             self.server, rpc_addr=self.addr, node_name=node_name,
             bind_addr=self.bind_addr, gossip_port=gossip_port,
-            gossip_config=gossip_config)
+            gossip_config=gossip_config, tls_context=self._client_tls)
         # Route cross-region RPCs through the gossip view.
         self.endpoints.region_router = self.membership.region_router
         self.endpoints.region_lister = self.membership.region_lister

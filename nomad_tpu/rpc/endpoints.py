@@ -11,6 +11,7 @@ hop to the current leader (node ids are "host:port" addresses).
 
 from __future__ import annotations
 
+import random
 import threading
 import time
 from typing import Any, Callable, Dict, List, Optional, Tuple
@@ -42,8 +43,16 @@ def blocking_query(state, items: List[Item], min_index: int,
                    run: Callable[[], Tuple[Any, int]]) -> Tuple[Any, int]:
     """Run `run` until its index passes min_index or the wait expires
     (reference: blockingRPC, rpc.go:294-349). `run` returns (result, index).
+
+    The wait is jittered by up to wait/16 (reference: rpc.go:334-343):
+    thousands of clients watching the same object re-arm their queries in
+    lockstep after a change; without jitter every later expiry becomes a
+    synchronized thundering herd on the leader.
     """
     max_wait = min(max_wait, MAX_BLOCK_TIME)
+    if max_wait > 0:
+        max_wait += random.random() * (max_wait / 16.0)
+        max_wait = min(max_wait, MAX_BLOCK_TIME)
     deadline = time.monotonic() + max_wait
     if min_index <= 0:
         return run()

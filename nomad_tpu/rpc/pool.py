@@ -28,10 +28,21 @@ class ConnError(Exception):
 
 
 class _Conn:
-    def __init__(self, addr: str, stream_type: int, timeout: float):
+    def __init__(self, addr: str, stream_type: int, timeout: float,
+                 tls_context=None):
         host, port = addr.rsplit(":", 1)
         self.sock = socket.create_connection((host, int(port)),
                                              timeout=timeout)
+        if tls_context is not None:
+            # TLS byte in plaintext, handshake, then the inner stream type
+            # rides encrypted (reference: rpc.go rpcTLS).
+            from .wire import RPC_TLS
+
+            self.sock.sendall(bytes([RPC_TLS]))
+            self.sock = tls_context.wrap_socket(
+                self.sock,
+                server_hostname=host if tls_context.check_hostname
+                else None)
         self.sock.settimeout(None)
         self.sock.sendall(bytes([stream_type]))
         self._seq = itertools.count(1)
@@ -112,12 +123,14 @@ class ConnPool:
 
     def __init__(self, stream_type: int = RPC_NOMAD,
                  connect_timeout: float = 5.0,
-                 call_timeout: float = 310.0):
+                 call_timeout: float = 310.0,
+                 tls_context=None):
         # call_timeout must exceed the 300s blocking-query cap
         # (reference: rpc.go:33-47 maxQueryTime).
         self.stream_type = stream_type
         self.connect_timeout = connect_timeout
         self.call_timeout = call_timeout
+        self.tls_context = tls_context
         self._conns: Dict[str, _Conn] = {}
         self._lock = threading.Lock()
         self._addr_locks: Dict[str, threading.Lock] = {}
@@ -136,7 +149,8 @@ class ConnPool:
                 conn = self._conns.get(addr)
                 if conn is not None and not conn._dead:
                     return conn
-            conn = _Conn(addr, self.stream_type, self.connect_timeout)
+            conn = _Conn(addr, self.stream_type, self.connect_timeout,
+                         tls_context=self.tls_context)
             with self._lock:
                 self._conns[addr] = conn
             return conn

@@ -10,7 +10,14 @@ import socket
 import threading
 from typing import Any, Callable, Dict, Optional
 
-from .wire import RPC_NOMAD, RPC_RAFT, MessageCodec, recv_frame, send_frame
+from .wire import (
+    RPC_NOMAD,
+    RPC_RAFT,
+    RPC_TLS,
+    MessageCodec,
+    recv_frame,
+    send_frame,
+)
 
 logger = logging.getLogger("nomad.rpc")
 
@@ -18,13 +25,19 @@ Handler = Callable[[str, Any], Any]
 
 
 class RPCServer:
-    """One TCP port for both application RPC and raft traffic."""
+    """One TCP port for both application RPC and raft traffic; with a TLS
+    context, TLS-prefixed streams unwrap and re-dispatch (reference:
+    rpc.go:88-132 handleConn's rpcTLS arm)."""
 
     def __init__(self, bind_addr: str = "127.0.0.1", port: int = 0,
                  rpc_handler: Optional[Handler] = None,
-                 raft_handler: Optional[Handler] = None):
+                 raft_handler: Optional[Handler] = None,
+                 tls_context=None, require_tls: bool = False):
         self.rpc_handler = rpc_handler
         self.raft_handler = raft_handler
+        self.tls_context = tls_context
+        # verify_incoming semantics: plaintext streams are refused outright.
+        self.require_tls = require_tls
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._sock.bind((bind_addr, port))
@@ -81,6 +94,28 @@ class RPCServer:
             if not prefix:
                 return
             stream_type = prefix[0]
+            if stream_type == RPC_TLS:
+                if self.tls_context is None:
+                    logger.warning(
+                        "rpc: TLS connection attempted, server not "
+                        "configured for TLS")
+                    return
+                import ssl
+
+                try:
+                    conn = self.tls_context.wrap_socket(conn,
+                                                        server_side=True)
+                except (ssl.SSLError, OSError) as e:
+                    logger.warning("rpc: TLS handshake failed: %s", e)
+                    return
+                inner = conn.recv(1)
+                if not inner:
+                    return
+                stream_type = inner[0]
+            elif self.require_tls:
+                logger.warning(
+                    "rpc: non-TLS connection rejected (verify_incoming)")
+                return
             if stream_type == RPC_NOMAD:
                 self._serve_rpc(conn, self.rpc_handler)
             elif stream_type == RPC_RAFT:

@@ -14,6 +14,7 @@ import msgpack
 # Stream type prefix bytes (reference: rpc.go:25-30)
 RPC_NOMAD = 0x01
 RPC_RAFT = 0x02
+RPC_TLS = 0x03  # TLS wrapper: handshake, then the inner type byte again
 
 _LEN = struct.Struct("<I")
 MAX_FRAME = 64 * 1024 * 1024  # reference warns at 1MB raft entries; cap hard

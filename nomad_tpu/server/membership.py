@@ -79,7 +79,8 @@ class ServerMembership:
                  bind_addr: str = "127.0.0.1",
                  gossip_port: int = 0,
                  gossip_config: Optional[GossipConfig] = None,
-                 reconcile_interval: float = 10.0):
+                 reconcile_interval: float = 10.0,
+                 tls_context=None):
         self.server = server
         self.rpc_addr = rpc_addr
         self.region = server.config.region
@@ -93,7 +94,7 @@ class ServerMembership:
         # region -> gossip_name -> ServerParts (reference: s.peers)
         self.peers: Dict[str, Dict[str, ServerParts]] = {}
         self._bootstrapped = False
-        self._pool = ConnPool()
+        self._pool = ConnPool(tls_context=tls_context)
         self._reconcile_interval = reconcile_interval
         self._wake = threading.Event()
         self._stop = threading.Event()

@@ -226,3 +226,41 @@ def test_agent_monitor_ring(dev_agent):
     out2, _ = api.get(f"/v1/agent/monitor?after={seq}")
     assert any("monitor-marker-2" in l for l in out2["Lines"])
     assert not any("monitor-marker-1" in l for l in out2["Lines"])
+
+
+class TestGzip:
+    def test_large_responses_gzip_when_accepted(self, dev_agent):
+        """(reference: every handler gzip-wrapped, command/agent/http.go:
+        70-80) — large list responses compress; clients that don't accept
+        gzip get identity; the API client decodes transparently."""
+        import gzip
+        import json as _json
+        import urllib.request
+
+        agent, api = dev_agent
+        base = f"http://127.0.0.1:{agent.http.port}"
+        # Find an endpoint whose identity payload clears the 1KB gzip
+        # floor (metrics accumulates counters; agent/self dumps config).
+        fat = None
+        for path in ("/v1/agent/metrics", "/v1/agent/self", "/v1/nodes"):
+            with urllib.request.urlopen(base + path, timeout=10) as resp:
+                if len(resp.read()) >= 1024:
+                    fat = path
+                    break
+        assert fat is not None, "no endpoint over the gzip floor"
+        req = urllib.request.Request(base + fat)
+        req.add_header("Accept-Encoding", "gzip")
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            assert resp.headers.get("Content-Encoding") == "gzip"
+            body = _json.loads(gzip.decompress(resp.read()))
+        assert body
+
+        # Identity for clients that don't ask for gzip.
+        req2 = urllib.request.Request(base + "/v1/nodes")
+        with urllib.request.urlopen(req2, timeout=10) as resp:
+            assert resp.headers.get("Content-Encoding") is None
+            _json.loads(resp.read())
+
+        # The API client path round-trips (it sends Accept-Encoding: gzip).
+        nodes, _ = api.request("GET", "/v1/nodes")
+        assert isinstance(nodes, list)

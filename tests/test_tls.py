@@ -1,0 +1,203 @@
+"""TLS on the single-port RPC mux (reference: nomad/rpc.go:25-30 rpcTLS
+byte + handleConn:88-132; TLSConfig in nomad/config.go): a mutual-TLS
+cluster forms, replicates, and schedules; plaintext connections are refused
+when verify_incoming is set; wrong-CA clients are rejected.
+"""
+
+import os
+import subprocess
+
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.raft import RaftConfig
+from nomad_tpu.rpc.cluster import ClusterServer
+from nomad_tpu.rpc.pool import ConnPool, ConnError, RPCError
+from nomad_tpu.rpc.tls import TLSConfig
+from nomad_tpu.server.server import ServerConfig
+from nomad_tpu.structs import to_dict
+from nomad_tpu.structs.structs import EvalStatusComplete
+
+from helpers import wait_for  # noqa: E402
+
+pytestmark = pytest.mark.timing_retry
+
+FAST = RaftConfig(heartbeat_interval=0.02, election_timeout_min=0.08,
+                  election_timeout_max=0.16, apply_timeout=5.0)
+
+
+def make_ca(dirpath, name="ca"):
+    """Self-signed CA + a cert it signs, via openssl."""
+    ca_key = os.path.join(dirpath, f"{name}.key")
+    ca_crt = os.path.join(dirpath, f"{name}.crt")
+    subprocess.run(
+        ["openssl", "req", "-x509", "-newkey", "rsa:2048", "-nodes",
+         "-keyout", ca_key, "-out", ca_crt, "-days", "1",
+         "-subj", f"/CN=nomad-test-{name}"],
+        check=True, capture_output=True)
+    return ca_key, ca_crt
+
+
+def issue_cert(dirpath, ca_key, ca_crt, name):
+    key = os.path.join(dirpath, f"{name}.key")
+    csr = os.path.join(dirpath, f"{name}.csr")
+    crt = os.path.join(dirpath, f"{name}.crt")
+    subprocess.run(
+        ["openssl", "req", "-newkey", "rsa:2048", "-nodes",
+         "-keyout", key, "-out", csr, "-subj", f"/CN={name}"],
+        check=True, capture_output=True)
+    subprocess.run(
+        ["openssl", "x509", "-req", "-in", csr, "-CA", ca_crt,
+         "-CAkey", ca_key, "-CAcreateserial", "-out", crt, "-days", "1"],
+        check=True, capture_output=True)
+    return key, crt
+
+
+@pytest.fixture(scope="module")
+def pki(tmp_path_factory):
+    d = str(tmp_path_factory.mktemp("pki"))
+    ca_key, ca_crt = make_ca(d)
+    key, crt = issue_cert(d, ca_key, ca_crt, "server")
+    evil_ca_key, evil_ca_crt = make_ca(d, "evil")
+    evil_key, evil_crt = issue_cert(d, evil_ca_key, evil_ca_crt,
+                                    "evil-client")
+    return {"ca": ca_crt, "key": key, "crt": crt,
+            "evil_ca": evil_ca_crt, "evil_key": evil_key,
+            "evil_crt": evil_crt}
+
+
+def tls_cfg(pki):
+    return TLSConfig(enable_rpc=True, ca_file=pki["ca"],
+                     cert_file=pki["crt"], key_file=pki["key"],
+                     verify_incoming=True)
+
+
+def leader_of(nodes):
+    for n in nodes:
+        if n.server.is_leader() and n.server._leader:
+            return n
+    return None
+
+
+class TestTLSCluster:
+    def test_mutual_tls_cluster_schedules(self, pki):
+        """3 servers, every RPC and raft stream over mutual TLS: leadership
+        establishes, a job registers through a follower, and its eval
+        completes with allocations committed."""
+        cfgs = [ServerConfig(num_schedulers=1) for _ in range(3)]
+        nodes = [ClusterServer(cfg, tls=tls_cfg(pki)) for cfg in cfgs]
+        addrs = [cs.addr for cs in nodes]
+        for cs in nodes:
+            cs.connect(list(addrs), raft_config=FAST)
+        for cs in nodes:
+            cs.start()
+        try:
+            assert wait_for(lambda: leader_of(nodes) is not None,
+                            timeout=30)
+            ldr = leader_of(nodes)
+            for _ in range(4):
+                ldr.server.node_register(mock.node())
+            follower = next(n for n in nodes if n is not ldr)
+            job = mock.job()
+            job.TaskGroups[0].Count = 2
+            resp = follower.endpoints.handle("Job.Register",
+                                             {"Job": to_dict(job)})
+            eval_id = resp["EvalID"]
+            assert wait_for(
+                lambda: (e := leader_of(nodes).server.state.eval_by_id(
+                    eval_id)) is not None
+                and e.Status == EvalStatusComplete, timeout=60)
+            allocs = list(ldr.server.state.allocs_by_job(job.ID))
+            assert len(allocs) == 2
+        finally:
+            for cs in nodes:
+                cs.shutdown()
+
+    def test_plaintext_refused_when_verify_incoming(self, pki):
+        cfg = ServerConfig(num_schedulers=0)
+        cs = ClusterServer(cfg, tls=tls_cfg(pki))
+        cs.connect([cs.addr], raft_config=FAST)
+        cs.start()
+        try:
+            assert wait_for(lambda: cs.server.is_leader()
+                            and cs.server._leader, timeout=20)
+            plain = ConnPool()  # no TLS context
+            with pytest.raises((ConnError, OSError, TimeoutError,
+                                RPCError)):
+                plain.call(cs.addr, "Status.Ping", {}, timeout=2.0)
+        finally:
+            cs.shutdown()
+
+    def test_wrong_ca_client_rejected(self, pki):
+        from nomad_tpu.rpc.tls import client_context
+
+        cfg = ServerConfig(num_schedulers=0)
+        cs = ClusterServer(cfg, tls=tls_cfg(pki))
+        cs.connect([cs.addr], raft_config=FAST)
+        cs.start()
+        try:
+            assert wait_for(lambda: cs.server.is_leader()
+                            and cs.server._leader, timeout=20)
+            evil = ConnPool(tls_context=client_context(TLSConfig(
+                enable_rpc=True, ca_file=pki["evil_ca"],
+                cert_file=pki["evil_crt"], key_file=pki["evil_key"])))
+            with pytest.raises((ConnError, OSError, TimeoutError,
+                                RPCError)):
+                evil.call(cs.addr, "Status.Ping", {}, timeout=2.0)
+        finally:
+            cs.shutdown()
+
+
+class TestTLSGossipBootstrap:
+    def test_gossip_bootstrapped_tls_cluster(self, pki):
+        """The membership plane's RPC probes also ride TLS: a 3-server
+        cluster forms via gossip bootstrap-expect with verify_incoming on
+        (plaintext probes would be refused and the cluster could never
+        elect)."""
+        from nomad_tpu.gossip import GossipConfig
+
+        def boot(name, join=None):
+            cs = ClusterServer(ServerConfig(
+                node_id="", num_schedulers=0, bootstrap_expect=3),
+                tls=tls_cfg(pki))
+            cs.connect([], raft_config=FAST)
+            cs.start()
+            cs.enable_gossip(name, join=join,
+                             gossip_config=GossipConfig.fast())
+            return cs
+
+        nodes = [boot("t0")]
+        ml = nodes[0].membership.memberlist
+        seed = [f"{ml.addr}:{ml.port}"]
+        nodes.append(boot("t1", join=seed))
+        nodes.append(boot("t2", join=seed))
+        try:
+            assert wait_for(lambda: leader_of(nodes) is not None,
+                            timeout=30)
+            ldr = leader_of(nodes)
+            assert wait_for(lambda: len(ldr.server.raft.peers) == 3,
+                            timeout=20)
+        finally:
+            for cs in nodes:
+                cs.shutdown()
+
+
+class TestTLSAgentConfig:
+    def test_tls_block_parses(self, tmp_path, pki):
+        from nomad_tpu.agent.config import load_config_file
+
+        p = tmp_path / "agent.hcl"
+        p.write_text(f'''
+region = "global"
+tls {{
+  rpc = true
+  ca_file = "{pki['ca']}"
+  cert_file = "{pki['crt']}"
+  key_file = "{pki['key']}"
+  verify_incoming = true
+}}
+''')
+        cfg = load_config_file(str(p))
+        assert cfg.tls_enable_rpc is True
+        assert cfg.tls_ca_file == pki["ca"]
+        assert cfg.tls_verify_incoming is True

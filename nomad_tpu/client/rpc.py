@@ -268,7 +268,9 @@ class NetServerChannel:
         resp = self._call("Node.GetClientAllocs",
                           {"NodeID": node_id, "MinQueryIndex": min_index,
                            "MaxQueryTime": max_wait, "AllowStale": True},
-                          timeout=max_wait + 10.0)
+                          # Margin covers the server's wait/16 herd jitter
+                          # on top of the grace (rpc/endpoints.py).
+                          timeout=max_wait * 17.0 / 16.0 + 10.0)
         return resp["Allocs"], resp["Index"]
 
     def get_allocs(self, alloc_ids: List[str]) -> List[Allocation]:

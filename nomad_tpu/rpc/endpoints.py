@@ -49,10 +49,13 @@ def blocking_query(state, items: List[Item], min_index: int,
     lockstep after a change; without jitter every later expiry becomes a
     synchronized thundering herd on the leader.
     """
+    # Clamp FIRST, then jitter without re-clamping (reference order,
+    # rpc.go:334-343): re-clamping after the add would cancel the jitter
+    # exactly for full-length queries — the synchronized-expiry case the
+    # jitter exists to break.
     max_wait = min(max_wait, MAX_BLOCK_TIME)
     if max_wait > 0:
         max_wait += random.random() * (max_wait / 16.0)
-        max_wait = min(max_wait, MAX_BLOCK_TIME)
     deadline = time.monotonic() + max_wait
     if min_index <= 0:
         return run()

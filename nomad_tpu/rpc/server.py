@@ -102,12 +102,19 @@ class RPCServer:
                     return
                 import ssl
 
+                raw = conn
                 try:
                     conn = self.tls_context.wrap_socket(conn,
                                                         server_side=True)
                 except (ssl.SSLError, OSError) as e:
                     logger.warning("rpc: TLS handshake failed: %s", e)
                     return
+                # Track the SSLSocket, not the detached raw socket: the
+                # finally-block discard and shutdown()'s force-close must
+                # see the live object.
+                with self._lock:
+                    self._conns.discard(raw)
+                    self._conns.add(conn)
                 inner = conn.recv(1)
                 if not inner:
                     return

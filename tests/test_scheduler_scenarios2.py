@@ -1,0 +1,236 @@
+"""Scenario matrix round 2 (toward the reference's generic_sched_test.go
+coverage): full rolling-update eval CHAINS driven to convergence, AllAtOnce
+gang commits under contention at the plan applier, and distinct_hosts at
+kernel scale.
+"""
+
+import threading
+import time
+
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.scheduler import Harness
+from nomad_tpu.server.fsm import FSM, DevRaft, MessageType
+from nomad_tpu.server.plan_apply import PlanApplier
+from nomad_tpu.server.plan_queue import PlanQueue
+from nomad_tpu.structs import Constraint, Plan, UpdateStrategy
+from nomad_tpu.structs.structs import (
+    SECOND,
+    EvalStatusComplete,
+    EvalStatusPending,
+    EvalTriggerJobRegister,
+    EvalTriggerRollingUpdate,
+)
+
+
+def make_eval(job, trigger=EvalTriggerJobRegister):
+    ev = mock.eval()
+    ev.JobID = job.ID
+    ev.Type = job.Type
+    ev.TriggeredBy = trigger
+    ev.Status = EvalStatusPending
+    return ev
+
+
+class TestRollingUpdateChain:
+    def test_destructive_update_chains_to_convergence(self):
+        """A destructive update of a 6-count group with max_parallel=2
+        replaces exactly 2 per pass; each pass chains a rolling-update
+        follow-up eval (NextEval/PreviousEval linked, stagger wait) until
+        every alloc runs the new version (reference:
+        TestServiceSched_JobModify_Rolling + NextRollingEval,
+        structs.go:2810)."""
+        h = Harness()
+        for _ in range(8):
+            h.upsert("node", mock.node())
+        job = mock.job()
+        job.TaskGroups[0].Count = 6
+        job.Update = UpdateStrategy(Stagger=10 * SECOND, MaxParallel=2)
+        job.init_fields()
+        h.upsert("job", job)
+        h.process("service", make_eval(job))
+        assert len([a for a in h.state.allocs_by_job(job.ID)
+                    if not a.terminal_status()]) == 6
+
+        # Destructive change: bump the task's resources.
+        job2 = job.copy()
+        job2.TaskGroups[0].Tasks[0].Resources.CPU += 100
+        job2.init_fields()
+        h.upsert("job", job2)
+
+        ev = make_eval(job2)
+        rounds = 0
+        chain = []
+        while True:
+            h.creates.clear()
+            h.process("service", ev)
+            rounds += 1
+            follow = [e for e in h.creates
+                      if e.TriggeredBy == EvalTriggerRollingUpdate]
+            if not follow:
+                break
+            assert len(follow) == 1
+            nxt = follow[0]
+            # Chain links (reference: NextRollingEval sets PreviousEval).
+            assert nxt.Wait == 10 * SECOND
+            assert nxt.PreviousEval == ev.ID
+            chain.append(nxt.ID)
+            assert rounds < 10, "rolling chain never converged"
+            ev = nxt
+
+        # 6 allocs / 2 per pass = 3 destructive passes; the last pass's
+        # follow-up sees nothing left and completes without a successor.
+        assert rounds >= 3
+        live = [a for a in h.state.allocs_by_job(job.ID)
+                if not a.terminal_status()]
+        assert len(live) == 6
+        new_cpu = job2.TaskGroups[0].Tasks[0].Resources.CPU
+        for a in live:
+            res = a.TaskResources[job2.TaskGroups[0].Tasks[0].Name]
+            assert res.CPU == new_cpu, "old-version alloc survived the roll"
+
+
+class TestAllAtOnceContention:
+    def test_racing_gangs_one_commits_whole_other_commits_nothing(self):
+        """Two AllAtOnce gang plans race over capacity that fits only one
+        gang: the applier's verification must commit one gang COMPLETELY
+        and the loser NOT AT ALL — a partial gang is worse than none
+        (reference: Plan.AllAtOnce, structs.go:2845-2928 +
+        plan_apply.go:194-316 clearing the whole result)."""
+        fsm = FSM()
+        raft = DevRaft(fsm)
+        queue = PlanQueue()
+        queue.set_enabled(True)
+        applier = PlanApplier(queue, raft)
+        applier.start()
+        try:
+            nodes = []
+            for _ in range(4):
+                node = mock.node()
+                node.Resources.CPU = 500
+                node.Reserved = None
+                raft.apply(MessageType.NodeRegister, {"Node": node})
+                nodes.append(node)
+
+            def gang_plan():
+                plan = Plan(EvalID=mock.eval().ID, Priority=50,
+                            AllAtOnce=True)
+                for node in nodes:
+                    alloc = mock.alloc()
+                    alloc.NodeID = node.ID
+                    alloc.Resources.CPU = 400  # 4x400: only one gang fits
+                    alloc.Resources.Networks = []
+                    alloc.TaskResources = {}
+                    plan.NodeAllocation[node.ID] = [alloc]
+                return plan
+
+            pendings = [queue.enqueue(gang_plan()) for _ in range(2)]
+            results = [p.wait(timeout=10) for p in pendings]
+
+            committed = [r for r in results if r.NodeAllocation]
+            empty = [r for r in results if not r.NodeAllocation]
+            assert len(committed) == 1, "exactly one gang must win"
+            assert len(empty) == 1
+            # Winner committed on ALL nodes; loser carries RefreshIndex.
+            assert len(committed[0].NodeAllocation) == len(nodes)
+            assert empty[0].RefreshIndex > 0
+            # State holds exactly one gang's worth.
+            live = [a for a in fsm.state.allocs()
+                    if not a.terminal_status()]
+            assert len(live) == len(nodes)
+        finally:
+            applier.stop()
+            queue.set_enabled(False)
+
+    def test_gang_partial_infeasible_commits_nothing(self):
+        """One node of the gang is already full: the whole gang is refused
+        even though 3 of 4 placements fit."""
+        fsm = FSM()
+        raft = DevRaft(fsm)
+        queue = PlanQueue()
+        queue.set_enabled(True)
+        applier = PlanApplier(queue, raft)
+        applier.start()
+        try:
+            nodes = []
+            for i in range(4):
+                node = mock.node()
+                node.Resources.CPU = 500 if i else 100  # node 0 too small
+                node.Reserved = None
+                raft.apply(MessageType.NodeRegister, {"Node": node})
+                nodes.append(node)
+            plan = Plan(EvalID=mock.eval().ID, Priority=50, AllAtOnce=True)
+            for node in nodes:
+                alloc = mock.alloc()
+                alloc.NodeID = node.ID
+                alloc.Resources.CPU = 400
+                alloc.Resources.Networks = []
+                alloc.TaskResources = {}
+                plan.NodeAllocation[node.ID] = [alloc]
+            result = queue.enqueue(plan).wait(timeout=10)
+            assert not result.NodeAllocation
+            assert not [a for a in fsm.state.allocs()
+                        if not a.terminal_status()]
+        finally:
+            applier.stop()
+            queue.set_enabled(False)
+
+
+class TestDistinctHostsAtScale:
+    def test_distinct_hosts_512_nodes_all_unique(self):
+        """distinct_hosts at kernel scale: 512-count group over 512 nodes
+        places every instance on a unique host through the batched device
+        scan (reference semantics: ProposedAllocConstraintIterator,
+        feasible.go:145-242)."""
+        h = Harness()
+        node_ids = set()
+        for _ in range(512):
+            node = mock.node()
+            h.upsert("node", node)
+            node_ids.add(node.ID)
+        job = mock.job()
+        job.Constraints.append(Constraint(Operand="distinct_hosts"))
+        tg = job.TaskGroups[0]
+        tg.Count = 512
+        task = tg.Tasks[0]
+        task.Resources.CPU = 20
+        task.Resources.MemoryMB = 32
+        task.Resources.Networks = []
+        job.init_fields()
+        h.upsert("job", job)
+        h.process("service", make_eval(job))
+
+        live = [a for a in h.state.allocs_by_job(job.ID)
+                if not a.terminal_status()]
+        assert len(live) == 512
+        hosts = [a.NodeID for a in live]
+        assert len(set(hosts)) == 512, "duplicate host under distinct_hosts"
+        assert set(hosts) <= node_ids
+
+    def test_distinct_hosts_overflow_blocks_remainder(self):
+        """Count exceeds the node pool: exactly one per host places, the
+        remainder fails placement and blocks."""
+        h = Harness()
+        for _ in range(16):
+            h.upsert("node", mock.node())
+        job = mock.job()
+        job.Constraints.append(Constraint(Operand="distinct_hosts"))
+        tg = job.TaskGroups[0]
+        tg.Count = 24
+        task = tg.Tasks[0]
+        task.Resources.CPU = 20
+        task.Resources.MemoryMB = 32
+        task.Resources.Networks = []
+        job.init_fields()
+        h.upsert("job", job)
+        h.process("service", make_eval(job))
+
+        live = [a for a in h.state.allocs_by_job(job.ID)
+                if not a.terminal_status()]
+        assert len(live) == 16
+        assert len({a.NodeID for a in live}) == 16
+        final = h.evals[-1]
+        assert final.FailedTGAllocs
+        tg_metric = final.FailedTGAllocs[tg.Name]
+        assert tg_metric.CoalescedFailures == 24 - 16 - 1

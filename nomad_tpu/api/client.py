@@ -82,7 +82,7 @@ class Client:
     def request(self, method: str, path: str,
                 params: Optional[Dict[str, str]] = None,
                 body: Any = None,
-                timeout: float = 310.0) -> Tuple[Any, QueryMeta]:
+                timeout: float = 330.0) -> Tuple[Any, QueryMeta]:
         data = json.dumps(body).encode() if body is not None else None
         req = urllib.request.Request(self._url(path, params), data=data,
                                      method=method)

@@ -6,6 +6,7 @@ operations without a vendored daemon client).
 from __future__ import annotations
 
 import json
+import os
 import shutil
 import subprocess
 import threading
@@ -17,15 +18,47 @@ from nomad_tpu.structs import Node, Task
 from .base import Driver, DriverHandle, ExecContext, WaitResult
 
 
+def docker_conn_env(config) -> dict:
+    """Daemon connection settings from client options (reference:
+    docker.go:66-120 config structs — docker.endpoint, docker.cert.path,
+    docker.tls.verify): environment for every docker CLI invocation, so a
+    remote or TLS-protected dockerd works exactly like the local socket."""
+    env = dict(os.environ)
+    if config is None:
+        return env
+    endpoint = str(config.read_option("docker.endpoint", ""))
+    cert_path = str(config.read_option("docker.cert.path", ""))
+    tls_verify = str(config.read_option("docker.tls.verify", ""))
+    if endpoint:
+        env["DOCKER_HOST"] = endpoint
+    if cert_path:
+        env["DOCKER_CERT_PATH"] = cert_path
+        env.setdefault("DOCKER_TLS_VERIFY", "1")
+    if tls_verify:
+        env["DOCKER_TLS_VERIFY"] = \
+            "1" if tls_verify.lower() in ("1", "true") else ""
+    return env
+
+
 class DockerHandle(DriverHandle):
     def __init__(self, container_id: str, log_dir: str = "",
                  task_name: str = "", max_files: int = 10,
-                 max_file_size_mb: int = 10):
+                 max_file_size_mb: int = 10,
+                 docker_env: dict = None,
+                 cleanup_container: bool = True,
+                 cleanup_image: bool = False,
+                 image: str = ""):
         self.container_id = container_id
         self.log_dir = log_dir
         self.task_name = task_name
         self.max_files = max_files
         self.max_file_size_mb = max_file_size_mb
+        # Daemon connection env + cleanup policy (reference:
+        # docker.cleanup.container / docker.cleanup.image options).
+        self.docker_env = docker_env or dict(os.environ)
+        self.cleanup_container = cleanup_container
+        self.cleanup_image = cleanup_image
+        self.image = image
         self._result: Optional[WaitResult] = None
         self._done = threading.Event()
         self._log_proc: Optional[subprocess.Popen] = None
@@ -39,16 +72,24 @@ class DockerHandle(DriverHandle):
                            "log_dir": self.log_dir,
                            "task_name": self.task_name,
                            "max_files": self.max_files,
-                           "max_file_size_mb": self.max_file_size_mb})
+                           "max_file_size_mb": self.max_file_size_mb,
+                           "cleanup_container": self.cleanup_container,
+                           "cleanup_image": self.cleanup_image,
+                           "image": self.image})
 
     @staticmethod
-    def from_id(handle_id: str) -> "DockerHandle":
+    def from_id(handle_id: str, docker_env: dict = None) -> "DockerHandle":
         data = json.loads(handle_id)
-        return DockerHandle(data["container_id"],
-                            log_dir=data.get("log_dir", ""),
-                            task_name=data.get("task_name", ""),
-                            max_files=data.get("max_files", 10),
-                            max_file_size_mb=data.get("max_file_size_mb", 10))
+        return DockerHandle(
+            data["container_id"],
+            log_dir=data.get("log_dir", ""),
+            task_name=data.get("task_name", ""),
+            max_files=data.get("max_files", 10),
+            max_file_size_mb=data.get("max_file_size_mb", 10),
+            docker_env=docker_env,
+            cleanup_container=data.get("cleanup_container", True),
+            cleanup_image=data.get("cleanup_image", False),
+            image=data.get("image", ""))
 
     def exec_in_task(self, command: str, args: list, timeout: float):
         """`docker exec` into the container (reference: DockerScriptCheck,
@@ -64,20 +105,20 @@ class DockerHandle(DriverHandle):
 
         wrapped = ["docker", "exec", self.container_id, "timeout",
                    str(int(timeout)), command] + list(args)
-        code, output = run_exec_argv(wrapped, timeout + 5)
+        code, output = run_exec_argv(wrapped, timeout + 5,
+                                     env=self.docker_env)
         if code in (126, 127) and "timeout" in output and (
                 "not found" in output or "executable" in output):
             # Image lacks timeout(1): run unwrapped with the host deadline.
             plain = ["docker", "exec", self.container_id, command] \
                 + list(args)
-            code, output = run_exec_argv(plain, timeout)
+            code, output = run_exec_argv(plain, timeout,
+                                         env=self.docker_env)
         elif code == 124:  # timeout(1)'s timed-out exit code
             return 2, f"in-task exec timed out after {timeout:.0f}s"
         return code, output
 
     def _since_path(self) -> str:
-        import os
-
         return os.path.join(self.log_dir,
                             f".{self.task_name}.docker_log_since")
 
@@ -107,7 +148,8 @@ class DockerHandle(DriverHandle):
         cmd.append(self.container_id)
         try:
             self._log_proc = subprocess.Popen(
-                cmd, stdout=subprocess.PIPE, stderr=subprocess.PIPE)
+                cmd, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                env=self.docker_env)
         except OSError:
             return
 
@@ -127,8 +169,6 @@ class DockerHandle(DriverHandle):
                     tmp = self._since_path() + ".tmp"
                     with open(tmp, "w") as f:
                         f.write(str(int(time.time()) - 30))
-                    import os
-
                     os.replace(tmp, self._since_path())
                 except OSError:
                     pass
@@ -144,12 +184,29 @@ class DockerHandle(DriverHandle):
     def _watch(self) -> None:
         try:
             out = subprocess.run(["docker", "wait", self.container_id],
-                                 capture_output=True, text=True)
+                                 capture_output=True, text=True,
+                                 env=self.docker_env)
             code = int(out.stdout.strip() or 0)
             self._result = WaitResult(exit_code=code)
         except Exception as e:
             self._result = WaitResult(error=str(e))
         self._done.set()
+        # Cleanup belongs HERE, not in kill(): a task that exits on its own
+        # never sees kill(), and the reference's docker.cleanup.container
+        # default would otherwise leak a stopped container per completed
+        # task. `docker wait` has returned, so the container is down.
+        if self._log_proc is not None:
+            try:  # let the pump drain the final log output first
+                self._log_proc.wait(timeout=3.0)
+            except subprocess.TimeoutExpired:
+                pass
+        if self.cleanup_container:
+            subprocess.run(["docker", "rm", self.container_id],
+                           capture_output=True, env=self.docker_env)
+        if self.cleanup_image and self.image:
+            # Best-effort: fails harmlessly while other containers use it.
+            subprocess.run(["docker", "rmi", self.image],
+                           capture_output=True, env=self.docker_env)
 
     def wait(self, timeout: Optional[float] = None) -> Optional[WaitResult]:
         if not self._done.wait(timeout):
@@ -158,7 +215,8 @@ class DockerHandle(DriverHandle):
 
     def kill(self, kill_timeout: float = 5.0) -> None:
         subprocess.run(["docker", "stop", "-t", str(int(kill_timeout)),
-                        self.container_id], capture_output=True)
+                        self.container_id], capture_output=True,
+                       env=self.docker_env)
         if self._log_proc is not None:
             # The container stopping ends the log stream; give the pump a
             # moment to drain the final output before forcing it down.
@@ -182,14 +240,16 @@ class DockerHandle(DriverHandle):
         """One `docker stats` invocation covering many containers: the CLI
         samples twice to compute CPU%, so per-container calls would cost
         seconds each inside the stats HTTP handler."""
-        ids = [h.container_id for h in handles if not h._done.is_set()]
+        live = [h for h in handles if not h._done.is_set()]
+        ids = [h.container_id for h in live]
         if not ids:
             return {}
         try:
             out = subprocess.run(
                 ["docker", "stats", "--no-stream", "--format",
                  "{{.ID}} {{.CPUPerc}} {{.MemUsage}}"] + ids,
-                capture_output=True, text=True, timeout=15)
+                capture_output=True, text=True, timeout=15,
+                env=live[0].docker_env)
         except Exception:
             return {}
         if out.returncode != 0:
@@ -236,7 +296,8 @@ class DockerDriver(Driver):
         try:
             out = subprocess.run(["docker", "version", "--format",
                                   "{{.Server.Version}}"],
-                                 capture_output=True, text=True, timeout=10)
+                                 capture_output=True, text=True, timeout=10,
+                                 env=docker_conn_env(config))
             if out.returncode != 0:
                 node.Attributes.pop("driver.docker", None)
                 return False
@@ -250,11 +311,23 @@ class DockerDriver(Driver):
         if not config.get("image"):
             raise ValueError("missing image for docker driver")
 
+    def _options(self):
+        cfg = self.ctx.config if self.ctx is not None else None
+        conn = docker_conn_env(cfg)
+        def opt(name, default):
+            if cfg is None:
+                return default
+            raw = str(cfg.read_option(name, str(default))).lower()
+            return raw in ("1", "true")
+        return (conn, opt("docker.cleanup.container", True),
+                opt("docker.cleanup.image", False))
+
     def start(self, ctx: ExecContext, task: Task) -> DriverHandle:
         self.validate(task.Config)
         env = ctx.task_env
         image = env.replace(str(task.Config["image"]))
         task_dir = ctx.alloc_dir.task_dirs[task.Name]
+        conn_env, cleanup_container, cleanup_image = self._options()
         cmd = ["docker"]
         auth_dir = self._write_auth_config(task, task_dir)
         if auth_dir:
@@ -276,7 +349,8 @@ class DockerDriver(Driver):
             cmd.append(env.replace(str(task.Config["command"])))
             cmd.extend(env.replace(str(a))
                        for a in task.Config.get("args", []))
-        out = subprocess.run(cmd, capture_output=True, text=True, timeout=300)
+        out = subprocess.run(cmd, capture_output=True, text=True,
+                             timeout=300, env=conn_env)
         if auth_dir:
             # The pull happened inside `docker run`; credentials must not
             # stay at rest in the alloc dir.
@@ -285,13 +359,19 @@ class DockerDriver(Driver):
             raise RuntimeError(f"docker run failed: {out.stderr.strip()}")
         log_cfg = task.LogConfig
         return DockerHandle(
-            out.stdout.strip(), log_dir=ctx.alloc_dir.log_dir,
+            out.stdout.strip(), log_dir=ctx.alloc_dir.log_dir(),
             task_name=task.Name,
             max_files=log_cfg.MaxFiles if log_cfg else 10,
-            max_file_size_mb=log_cfg.MaxFileSizeMB if log_cfg else 10)
+            max_file_size_mb=log_cfg.MaxFileSizeMB if log_cfg else 10,
+            docker_env=conn_env, cleanup_container=cleanup_container,
+            cleanup_image=cleanup_image, image=image)
 
     def open(self, ctx: ExecContext, handle_id: str) -> DriverHandle:
-        return DockerHandle.from_id(handle_id)
+        # Daemon connection env is NOT persisted in the id: recomputed from
+        # the client options BEFORE the handle's watcher thread starts
+        # (reattach must never probe the wrong daemon, even briefly).
+        return DockerHandle.from_id(handle_id,
+                                    docker_env=self._options()[0])
 
     @staticmethod
     def _write_auth_config(task: Task, task_dir: str) -> str:

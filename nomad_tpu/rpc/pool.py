@@ -123,10 +123,11 @@ class ConnPool:
 
     def __init__(self, stream_type: int = RPC_NOMAD,
                  connect_timeout: float = 5.0,
-                 call_timeout: float = 310.0,
+                 call_timeout: float = 330.0,
                  tls_context=None):
-        # call_timeout must exceed the 300s blocking-query cap
-        # (reference: rpc.go:33-47 maxQueryTime).
+        # call_timeout must exceed the 300s blocking-query cap PLUS the
+        # server's herd jitter of up to wait/16 (300 * 17/16 = 318.75s;
+        # reference: rpc.go:33-47 maxQueryTime + :334-343 jitter).
         self.stream_type = stream_type
         self.connect_timeout = connect_timeout
         self.call_timeout = call_timeout

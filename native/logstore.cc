@@ -1,0 +1,244 @@
+// Native raft segment-log backend (nomad_tpu/raft/log.py format v2).
+//
+// The reference persists its raft log through raft-boltdb (a native Go
+// B-tree store); this is the rebuild's native equivalent for the same
+// role: CRC-framed append-only segment with fsync'd group appends,
+// mmap-scanned validated replay, and atomic rewrite for compaction.
+// The file format is SHARED with the Python FileLogStore ("NTL2" magic,
+// [u32 len][u32 crc32(payload)][payload] records, little-endian), so a
+// node can move between the native and Python backends freely.
+//
+// Exposed as a C API consumed via ctypes (nomad_tpu/raft/native_log.py).
+// Build: make -C native  ->  native/bin/liblogstore.so
+
+#include <cerrno>
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <string>
+
+#include <fcntl.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+#include <zlib.h>
+
+namespace {
+
+constexpr char kMagic[4] = {'N', 'T', 'L', '2'};
+
+struct Store {
+  std::string path;
+  int fd = -1;
+};
+
+void set_err(char* err, int errlen, const std::string& msg) {
+  if (err != nullptr && errlen > 0) {
+    snprintf(err, static_cast<size_t>(errlen), "%s", msg.c_str());
+  }
+}
+
+uint32_t rd32(const uint8_t* p) {
+  uint32_t v;
+  memcpy(&v, p, 4);
+  return v;  // x86/arm little-endian, same as Python struct "<I"
+}
+
+void wr32(uint8_t* p, uint32_t v) { memcpy(p, &v, 4); }
+
+bool full_write(int fd, const uint8_t* buf, size_t n) {
+  size_t off = 0;
+  while (off < n) {
+    ssize_t w = write(fd, buf + off, n - off);
+    if (w < 0) {
+      if (errno == EINTR) continue;
+      return false;
+    }
+    off += static_cast<size_t>(w);
+  }
+  return true;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Open (creating if missing, writing the magic header). Returns a handle
+// or null with `err` filled.
+void* lgs_open(const char* path, char* err, int errlen) {
+  auto* s = new Store();
+  s->path = path;
+  s->fd = open(path, O_RDWR | O_CREAT, 0644);
+  if (s->fd < 0) {
+    set_err(err, errlen, std::string("open: ") + strerror(errno));
+    delete s;
+    return nullptr;
+  }
+  struct stat st;
+  if (fstat(s->fd, &st) == 0 && st.st_size == 0) {
+    if (!full_write(s->fd, reinterpret_cast<const uint8_t*>(kMagic), 4) ||
+        fdatasync(s->fd) != 0) {
+      set_err(err, errlen, "magic write failed");
+      close(s->fd);
+      delete s;
+      return nullptr;
+    }
+  }
+  if (lseek(s->fd, 0, SEEK_END) < 0) {
+    set_err(err, errlen, "seek failed");
+    close(s->fd);
+    delete s;
+    return nullptr;
+  }
+  return s;
+}
+
+// Scan + CRC-validate the whole file (mmap'd); truncates a torn or corrupt
+// tail ON DISK. Returns a malloc'd buffer of concatenated
+// [u32 len][payload] frames (CRC verified and stripped) with *out_n set,
+// or null on error. A valid empty log returns a non-null empty buffer.
+uint8_t* lgs_replay(void* handle, long* out_n, char* err, int errlen) {
+  auto* s = static_cast<Store*>(handle);
+  *out_n = 0;
+  struct stat st;
+  if (fstat(s->fd, &st) != 0) {
+    set_err(err, errlen, "fstat failed");
+    return nullptr;
+  }
+  size_t n = static_cast<size_t>(st.st_size);
+  auto* out = static_cast<uint8_t*>(malloc(n > 0 ? n : 1));
+  if (out == nullptr) {
+    set_err(err, errlen, "oom");
+    return nullptr;
+  }
+  if (n <= 4) {  // empty or header-only
+    if (n != 0 && n < 4) {
+      // Torn header: rewrite it.
+      if (ftruncate(s->fd, 0) == 0) {
+        (void)!full_write(s->fd, reinterpret_cast<const uint8_t*>(kMagic),
+                          4);
+        (void)fdatasync(s->fd);
+      }
+    }
+    lseek(s->fd, 0, SEEK_END);
+    return out;
+  }
+  void* mem = mmap(nullptr, n, PROT_READ, MAP_PRIVATE, s->fd, 0);
+  if (mem == MAP_FAILED) {
+    set_err(err, errlen, "mmap failed");
+    free(out);
+    return nullptr;
+  }
+  const auto* raw = static_cast<const uint8_t*>(mem);
+  size_t off = 4;  // past magic (a legacy headerless file is handled by
+                   // the Python side before choosing this backend)
+  if (memcmp(raw, kMagic, 4) != 0) {
+    munmap(mem, n);
+    free(out);
+    set_err(err, errlen, "not an NTL2 segment");
+    return nullptr;
+  }
+  size_t w = 0;
+  while (off + 8 <= n) {
+    uint32_t len = rd32(raw + off);
+    uint32_t crc = rd32(raw + off + 4);
+    if (off + 8 + len > n) break;  // torn tail
+    const uint8_t* payload = raw + off + 8;
+    if (crc32(0L, payload, len) != crc) break;  // corrupt record
+    wr32(out + w, len);
+    memcpy(out + w + 4, payload, len);
+    w += 4 + len;
+    off += 8 + len;
+  }
+  munmap(mem, n);
+  if (off < n) {
+    // Drop the invalid tail on disk so appends land after valid data.
+    if (ftruncate(s->fd, static_cast<off_t>(off)) != 0) {
+      set_err(err, errlen, "truncate of corrupt tail failed");
+      free(out);
+      return nullptr;
+    }
+  }
+  lseek(s->fd, 0, SEEK_END);
+  *out_n = static_cast<long>(w);
+  return out;
+}
+
+void lgs_free(uint8_t* p) { free(p); }
+
+// Append a batch: `frames` is concatenated [u32 len][payload]; each
+// payload is CRC-framed and the whole batch lands with one fdatasync.
+int lgs_append(void* handle, const uint8_t* frames, long n) {
+  auto* s = static_cast<Store*>(handle);
+  if (s->fd < 0) return -5;  // poisoned by a failed rewrite reopen
+  auto* buf = static_cast<uint8_t*>(malloc(static_cast<size_t>(n) * 2 + 8));
+  if (buf == nullptr) return -1;
+  size_t w = 0;
+  long off = 0;
+  while (off + 4 <= n) {
+    uint32_t len = rd32(frames + off);
+    if (off + 4 + static_cast<long>(len) > n) {
+      free(buf);
+      return -2;  // malformed input batch
+    }
+    const uint8_t* payload = frames + off + 4;
+    wr32(buf + w, len);
+    wr32(buf + w + 4, crc32(0L, payload, len));
+    memcpy(buf + w + 8, payload, len);
+    w += 8 + len;
+    off += 4 + len;
+  }
+  int rc = 0;
+  if (!full_write(s->fd, buf, w) || fdatasync(s->fd) != 0) rc = -3;
+  free(buf);
+  return rc;
+}
+
+// Atomic rewrite (compaction/truncation): same batch input as lgs_append,
+// written to <path>.tmp then renamed over the segment.
+int lgs_rewrite(void* handle, const uint8_t* frames, long n) {
+  auto* s = static_cast<Store*>(handle);
+  std::string tmp = s->path + ".tmp";
+  int fd = open(tmp.c_str(), O_WRONLY | O_CREAT | O_TRUNC, 0644);
+  if (fd < 0) return -1;
+  bool ok = full_write(fd, reinterpret_cast<const uint8_t*>(kMagic), 4);
+  long off = 0;
+  while (ok && off + 4 <= n) {
+    uint32_t len = rd32(frames + off);
+    if (off + 4 + static_cast<long>(len) > n) {
+      ok = false;
+      break;
+    }
+    const uint8_t* payload = frames + off + 4;
+    uint8_t hdr[8];
+    wr32(hdr, len);
+    wr32(hdr + 4, crc32(0L, payload, len));
+    ok = full_write(fd, hdr, 8) && full_write(fd, payload, len);
+    off += 4 + len;
+  }
+  ok = ok && fdatasync(fd) == 0;
+  close(fd);
+  if (!ok) {
+    unlink(tmp.c_str());
+    return -2;
+  }
+  if (rename(tmp.c_str(), s->path.c_str()) != 0) return -3;
+  // Swap the live fd to the new segment. The old fd points at the
+  // renamed-over (unlinked) inode either way: close it FIRST, and on
+  // reopen failure poison the handle — appending to the unlinked inode
+  // would acknowledge entries that vanish on restart.
+  close(s->fd);
+  s->fd = open(s->path.c_str(), O_RDWR, 0644);
+  if (s->fd < 0) return -4;
+  lseek(s->fd, 0, SEEK_END);
+  return 0;
+}
+
+void lgs_close(void* handle) {
+  auto* s = static_cast<Store*>(handle);
+  if (s->fd >= 0) close(s->fd);
+  delete s;
+}
+
+}  // extern "C"

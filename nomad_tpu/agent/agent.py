@@ -210,7 +210,7 @@ class Agent:
         """Networked server: RPC+raft listener plus the gossip membership
         plane (reference: agent.go:356 setupServer -> nomad.NewServer with
         setupRPC/setupRaft/setupSerf, server.go:166-263)."""
-        from nomad_tpu.raft.log import FileLogStore
+        from nomad_tpu.raft.native_log import make_log_store
         from nomad_tpu.rpc.cluster import ClusterServer
 
         sconf = ServerConfig(
@@ -227,7 +227,9 @@ class Agent:
         # term it already voted in, nor re-bootstrap a formed cluster.
         raft_dir = os.path.join(self.config.data_dir, "raft")
         os.makedirs(raft_dir, exist_ok=True)
-        self.cluster.connect([], log_store=FileLogStore(raft_dir))
+        # Native C++ segment log when built (make -C native), Python
+        # FileLogStore otherwise — same on-disk format either way.
+        self.cluster.connect([], log_store=make_log_store(raft_dir))
         self.cluster.start()
         self.cluster.enable_gossip(self.config.node_name,
                                    gossip_port=self.config.serf_port,

@@ -3,21 +3,33 @@ pair wired in nomad/server.go:640-663, and the two retained FSM snapshots,
 server.go:50 snapshotsRetained).
 
 Three backends behind one interface:
-  InMemLogStore  — tests and dev mode
-  FileLogStore   — append-only msgpack segment file + snapshot files
-  (native)       — C++ mmap segment log, see nomad_tpu/native/loglib
+  InMemLogStore   — tests and dev mode
+  FileLogStore    — CRC-framed append-only segment file + snapshot files
+  NativeLogStore  — the same format with the hot path in C++
+                    (native/logstore.cc via raft/native_log.py)
 """
 
 from __future__ import annotations
 
 import enum
+import logging
 import os
 import struct
 import threading
+import zlib
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Tuple
 
 import msgpack
+
+LOG = logging.getLogger("nomad.raft.log")
+
+# Segment format v2: magic header, then [u32 len][u32 crc32(payload)]
+# [payload] records. The CRC catches mid-file corruption (a torn or
+# bit-flipped record truncates the log there instead of feeding garbage
+# into raft replay); legacy headerless files are parsed without CRC and
+# upgraded at the first rewrite.
+_MAGIC = b"NTL2"
 
 
 class EntryType(enum.IntEnum):
@@ -136,11 +148,19 @@ class FileLogStore(InMemLogStore):
         self._log_path = os.path.join(directory, "raft.log")
         self._stable_path = os.path.join(directory, "stable.mp")
         self._snap_path = os.path.join(directory, "snapshot.mp")
+        self._needs_upgrade = False
         self._replay()
-        self._fh = open(self._log_path, "ab")
+        if self._needs_upgrade or not os.path.exists(self._log_path):
+            # New file or legacy format: (re)write with the v2 CRC header.
+            self._fh = None
+            self._rewrite_file()
+        else:
+            self._fh = open(self._log_path, "ab")
 
     # ----------------------------------------------------------- durability
-    def _replay(self) -> None:
+    def _load_side_files(self) -> None:
+        """Stable kv + snapshot side files — THE single loader, shared with
+        the native backend so side-file handling can't drift."""
         if os.path.exists(self._stable_path):
             with open(self._stable_path, "rb") as fh:
                 self._stable = msgpack.unpackb(fh.read(), raw=False)
@@ -148,40 +168,74 @@ class FileLogStore(InMemLogStore):
             with open(self._snap_path, "rb") as fh:
                 idx, term, data = msgpack.unpackb(fh.read(), raw=False)
                 self._snapshot = (idx, term, data)
+
+    def _replay(self) -> None:
+        self._load_side_files()
         if not os.path.exists(self._log_path):
             return
         with open(self._log_path, "rb") as fh:
             raw = fh.read()
-        off, n = 0, len(raw)
         entries = []
-        while off + 4 <= n:
-            (length,) = _FRAME.unpack_from(raw, off)
-            if off + 4 + length > n:  # torn tail write: drop it
-                break
-            entries.append(LogEntry.unpack(raw[off + 4:off + 4 + length]))
-            off += 4 + length
+        if raw.startswith(_MAGIC):
+            off, n = len(_MAGIC), len(raw)
+            while off + 8 <= n:
+                (length,) = _FRAME.unpack_from(raw, off)
+                (crc,) = _FRAME.unpack_from(raw, off + 4)
+                end = off + 8 + length
+                if end > n:  # torn tail write: drop it
+                    break
+                payload = raw[off + 8:end]
+                if zlib.crc32(payload) != crc:
+                    LOG.error("raft log: CRC mismatch at offset %d; "
+                              "truncating %d trailing bytes", off, n - off)
+                    break
+                entries.append(LogEntry.unpack(payload))
+                off = end
+            if off < n:
+                # Drop the corrupt/torn tail ON DISK too, so appends don't
+                # land after garbage.
+                with open(self._log_path, "r+b") as fh:
+                    fh.truncate(off)
+        else:  # legacy headerless format (no CRC)
+            off, n = 0, len(raw)
+            while off + 4 <= n:
+                (length,) = _FRAME.unpack_from(raw, off)
+                if off + 4 + length > n:  # torn tail write: drop it
+                    break
+                entries.append(
+                    LogEntry.unpack(raw[off + 4:off + 4 + length]))
+                off += 4 + length
+            self._needs_upgrade = True
         super().store_entries(entries)
 
     def _append_file(self, entries: List[LogEntry]) -> None:
         buf = bytearray()
         for e in entries:
             rec = e.pack()
-            buf += _FRAME.pack(len(rec)) + rec
+            buf += _FRAME.pack(len(rec)) + _FRAME.pack(zlib.crc32(rec)) + rec
         self._fh.write(bytes(buf))
         self._fh.flush()
         os.fsync(self._fh.fileno())
 
     def _rewrite_file(self) -> None:
+        # Snapshot under the lock: replication appends run concurrently
+        # with snapshot-path compaction.
+        with self._lock:
+            entries = [self._entries[i] for i in sorted(self._entries)]
         tmp = self._log_path + ".tmp"
         with open(tmp, "wb") as fh:
-            for i in sorted(self._entries):
-                rec = self._entries[i].pack()
-                fh.write(_FRAME.pack(len(rec)) + rec)
+            fh.write(_MAGIC)
+            for e in entries:
+                rec = e.pack()
+                fh.write(_FRAME.pack(len(rec))
+                         + _FRAME.pack(zlib.crc32(rec)) + rec)
             fh.flush()
             os.fsync(fh.fileno())
-        self._fh.close()
+        if self._fh is not None:
+            self._fh.close()
         os.replace(tmp, self._log_path)
         self._fh = open(self._log_path, "ab")
+        self._needs_upgrade = False
 
     # ------------------------------------------------------------ overrides
     def store_entries(self, entries: List[LogEntry]) -> None:

@@ -218,6 +218,13 @@ class StateStore(_ReadAPI):
 
     def _emit(self, events: List[Tuple[str, Any, Any]]) -> None:
         for cb in self._listeners:
+            # Batch-aware listeners (the tensor index) take the whole
+            # commit's events in one call — a 50-alloc plan then costs one
+            # lock acquisition, not fifty.
+            batch = getattr(cb, "on_change_batch", None)
+            if batch is not None:
+                batch(events)
+                continue
             for kind, old, new in events:
                 cb(kind, old, new)
 

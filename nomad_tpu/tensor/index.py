@@ -16,7 +16,9 @@ from typing import Optional
 from nomad_tpu.state.state_store import StateStore
 from nomad_tpu.structs import Allocation, Node
 
-from .node_table import NodeTensor
+import numpy as np
+
+from .node_table import NodeTensor, alloc_vec
 
 
 class TensorIndex:
@@ -36,7 +38,9 @@ class TensorIndex:
         for alloc in store.allocs():
             if not alloc.terminal_status():
                 idx.nt.add_alloc_usage(alloc)
-        store.add_change_listener(idx._on_change)
+        # The index object itself is the listener: _emit prefers its
+        # on_change_batch; __call__ keeps the per-event contract.
+        store.add_change_listener(idx)
         return idx
 
     @staticmethod
@@ -55,6 +59,34 @@ class TensorIndex:
             self._on_node(old, new)
         elif kind == "alloc":
             self._on_alloc(old, new)
+
+    # Listener protocol: callable per-event, batch-capable via
+    # on_change_batch (preferred by state_store._emit).
+    __call__ = _on_change
+
+    def on_change_batch(self, events) -> None:
+        """Batch form the state store prefers (state_store._emit): alloc
+        usage transitions collapse into one scatter-add under one tensor
+        lock; node events keep their per-event path (rare)."""
+        node_ids = []
+        vecs = []
+        for kind, old, new in events:
+            if kind == "node":
+                self._on_node(old, new)
+                continue
+            if kind != "alloc":
+                continue
+            was = old is not None and not old.terminal_status()
+            now = new is not None and not new.terminal_status()
+            if was:
+                node_ids.append(old.NodeID)
+                vecs.append(-alloc_vec(old))
+            if now:
+                node_ids.append(new.NodeID)
+                vecs.append(alloc_vec(new))
+        if node_ids:
+            self.nt.apply_usage_deltas(
+                node_ids, np.stack(vecs).astype(np.float32))
 
     def _on_node(self, old: Optional[Node], new: Optional[Node]) -> None:
         if new is None:

@@ -32,14 +32,18 @@ def _ensure_native_executor():
 
     root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     binary = os.path.join(root, "native", "bin", "nomad-executor")
+    liblog = os.path.join(root, "native", "bin", "liblogstore.so")
     stamp = os.path.join(root, "native", "bin", ".build_failed")
-    source = os.path.join(root, "native", "executor.cc")
-    if os.path.exists(binary) or shutil.which("g++") is None:
+    sources = [os.path.join(root, "native", f)
+               for f in ("executor.cc", "logstore.cc", "Makefile")]
+    if (os.path.exists(binary) and os.path.exists(liblog)) \
+            or shutil.which("g++") is None:
         return
     # Don't re-pay a failed build on every pytest start: skip while the
     # failure stamp is newer than the source.
     try:
-        if os.path.getmtime(stamp) >= os.path.getmtime(source):
+        if os.path.getmtime(stamp) >= max(os.path.getmtime(s)
+                                          for s in sources):
             return
     except OSError:
         pass

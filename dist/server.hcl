@@ -17,7 +17,22 @@ server {
   # Seed gossip with any existing server's serf address; every server
   # found this way is added to the raft peer set automatically.
   start_join = ["10.1.0.1:4648"]
+
+  # Scheduler engine: windowed device-chained scheduling (the TPU fast
+  # path) with this many evals per window; "all" shards the node tensor
+  # over every local accelerator (multi-chip serving).
+  # scheduler_window = 256
+  # scheduler_mesh = "all"
 }
+
+# Mutual TLS on the RPC mux (servers AND clients need the same CA):
+# tls {
+#   rpc = true
+#   ca_file = "/etc/nomad-tpu/ca.crt"
+#   cert_file = "/etc/nomad-tpu/server.crt"
+#   key_file = "/etc/nomad-tpu/server.key"
+#   verify_incoming = true
+# }
 
 telemetry {
   # statsd_address = "127.0.0.1:8125"

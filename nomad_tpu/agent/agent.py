@@ -47,6 +47,12 @@ class AgentConfig:
     server_enabled: bool = False
     client_enabled: bool = False
     num_schedulers: int = 2
+    # Scheduler engine knobs (server{} block): windowed device-chained
+    # scheduling, window size, and multi-chip mesh serving ("all" shards
+    # the node tensor over every local device).
+    scheduler_window: int = 32
+    pipelined_scheduling: bool = True
+    scheduler_mesh: str = ""
     node_class: str = ""
     meta: Dict[str, str] = field(default_factory=dict)
     options: Dict[str, str] = field(default_factory=dict)
@@ -200,6 +206,9 @@ class Agent:
             region=self.config.region,
             datacenter=self.config.datacenter,
             num_schedulers=self.config.num_schedulers,
+            scheduler_window=self.config.scheduler_window,
+            pipelined_scheduling=self.config.pipelined_scheduling,
+            scheduler_mesh=self.config.scheduler_mesh,
             dev_mode=True,
         )
         self.server = Server(sconf)
@@ -217,6 +226,9 @@ class Agent:
             region=self.config.region,
             datacenter=self.config.datacenter,
             num_schedulers=self.config.num_schedulers,
+            scheduler_window=self.config.scheduler_window,
+            pipelined_scheduling=self.config.pipelined_scheduling,
+            scheduler_mesh=self.config.scheduler_mesh,
             bootstrap_expect=self.config.bootstrap_expect,
         )
         self.cluster = ClusterServer(sconf, bind_addr=self.config.bind_addr,

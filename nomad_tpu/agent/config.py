@@ -79,6 +79,11 @@ def config_from_dict(data: dict) -> AgentConfig:
                                           cfg.bootstrap_expect))
     join = server.get("start_join") or []
     cfg.start_join = [join] if isinstance(join, str) else list(join)
+    cfg.scheduler_window = int(server.get("scheduler_window",
+                                          cfg.scheduler_window))
+    cfg.pipelined_scheduling = bool(server.get("pipelined_scheduling",
+                                               cfg.pipelined_scheduling))
+    cfg.scheduler_mesh = server.get("scheduler_mesh", cfg.scheduler_mesh)
 
     telemetry = data.get("telemetry") or {}
     cfg.statsd_addr = telemetry.get("statsd_address", cfg.statsd_addr)

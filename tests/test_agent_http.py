@@ -264,3 +264,22 @@ class TestGzip:
         # The API client path round-trips (it sends Accept-Encoding: gzip).
         nodes, _ = api.request("GET", "/v1/nodes")
         assert isinstance(nodes, list)
+
+
+class TestConfigKnobs:
+    def test_server_scheduler_and_tls_blocks_parse(self, tmp_path):
+        from nomad_tpu.agent.config import load_config_file
+
+        p = tmp_path / "srv.hcl"
+        p.write_text('''
+server {
+  enabled = true
+  scheduler_window = 128
+  pipelined_scheduling = true
+  scheduler_mesh = "all"
+}
+''')
+        cfg = load_config_file(str(p))
+        assert cfg.scheduler_window == 128
+        assert cfg.pipelined_scheduling is True
+        assert cfg.scheduler_mesh == "all"

@@ -576,10 +576,11 @@ class RaftNode:
         discovered set; the usual election then picks one leader (reference:
         maybeBootstrap's SetPeers, nomad/serf.go:80-139)."""
         with self._lock:
-            # Empty log + no snapshot = virgin. (A bumped term alone — e.g.
-            # we granted a vote to an already-bootstrapped peer — does not
-            # disqualify: the log decides whether a cluster exists.)
-            if self.last_index > 0 or self._snap_index > 0:
+            # Empty log + no snapshot + no peer set = virgin. (A bumped
+            # term alone — e.g. we granted a vote to an already-
+            # bootstrapped peer — does not disqualify: the log/config
+            # decide whether a cluster exists.)
+            if self.last_index > 0 or self._snap_index > 0 or self._peers:
                 return False
             self._peers = list(peers)
             if self.id not in self._peers:

@@ -215,8 +215,13 @@ class Endpoints:
         raft = self.server.raft
         if hasattr(raft, "stats"):
             stats = raft.stats()
+            # A CONFIGURED PEER SET counts as bootstrapped even with an
+            # empty log: between bootstrap_cluster and the first leader's
+            # noop entry the log index is 0, and a late joiner probing in
+            # that window must not form a SECOND cluster config.
             return {"Bootstrapped": stats.get("last_log_index", 0) > 0
-                    or stats.get("snapshot_index", 0) > 0,
+                    or stats.get("snapshot_index", 0) > 0
+                    or bool(getattr(raft, "peers", ())),
                     "Stats": stats}
         return {"Bootstrapped": True, "Stats": {}}  # dev mode
 
@@ -443,7 +448,14 @@ class Endpoints:
             raise NotLeaderError(self.status_leader(body) or None)
         ev, token = self.server.eval_broker.dequeue(
             body["Schedulers"], body.get("Timeout", 0.5))
-        return {"Eval": to_dict(ev) if ev else None, "Token": token}
+        # WaitIndex: the leader's committed index at dequeue time. The
+        # worker's scheduling snapshot must include every commit that
+        # preceded this dequeue (ModifyIndex alone misses plans committed
+        # after this eval was CREATED but before it was dequeued — a
+        # duplicate eval would double-place its job from a stale follower
+        # replica).
+        return {"Eval": to_dict(ev) if ev else None, "Token": token,
+                "WaitIndex": self.server.state.latest_index()}
 
     def eval_ack(self, body) -> Dict[str, Any]:
         if not self.server.eval_broker.enabled():

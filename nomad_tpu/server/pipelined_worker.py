@@ -292,14 +292,31 @@ class PipelinedWorker(Worker):
             finally:
                 with self._pending_lock:
                     self._pending_windows -= 1
-                    if self._pending_windows == 0:
+                    drained = self._pending_windows == 0
+                    if drained:
                         self._drained.set()
+                if drained:
+                    # The NEXT window will rebase onto committed usage and
+                    # pay the dirty-row refresh (one blocking host->device
+                    # RTT after a storm). This thread is idle until then —
+                    # prefetch the refresh now so dispatch finds clean
+                    # device state. Serialized with dispatch by the tensor
+                    # lock; a no-op when nothing is dirty.
+                    try:
+                        self.tindex.nt.device_arrays()
+                    except Exception:
+                        pass  # next dispatch retries synchronously
 
     def _dequeue_window(self) -> List[Tuple[Evaluation, str]]:
         got = self._dequeue_evaluation()
         if got is None:
             return []
-        batch = [got]
+        ev0, token0, wait_index = got
+        # Snapshot freshness barrier for the window (see worker.py
+        # dequeue WaitIndex); trivially satisfied on the leader, where the
+        # pipelined worker runs against its own committed state.
+        self._window_wait_index = wait_index
+        batch = [(ev0, token0)]
         while len(batch) < self.window:
             try:
                 ev, token = self.eval_broker.dequeue(self.schedulers,
@@ -332,7 +349,9 @@ class PipelinedWorker(Worker):
         batch = live
         if not batch:
             return None
-        self._wait_for_index(max(ev.ModifyIndex for ev, _ in batch))
+        self._wait_for_index(max(
+            [ev.ModifyIndex for ev, _ in batch]
+            + [getattr(self, "_window_wait_index", 0)]))
         snap = self.raft.fsm.state.snapshot()
         t0 = time.perf_counter()
 

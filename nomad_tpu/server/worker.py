@@ -61,8 +61,14 @@ class LocalBackend:
         return self.eval_broker.enabled()
 
     def dequeue(self, schedulers: List[str], timeout: float
-                ) -> Tuple[Optional[Evaluation], str]:
-        return self.eval_broker.dequeue(schedulers, timeout)
+                ) -> Tuple[Optional[Evaluation], str, int]:
+        ev, token = self.eval_broker.dequeue(schedulers, timeout)
+        # WaitIndex: everything committed BEFORE this dequeue must be in
+        # the scheduling snapshot. ModifyIndex alone is not enough: a
+        # duplicate eval created before an earlier eval's plan committed
+        # would schedule against pre-plan state and double-place the job
+        # (the soak test's 6-of-3 duplication).
+        return ev, token, self.raft.fsm.state.latest_index()
 
     def ack(self, eval_id: str, token: str) -> None:
         self.eval_broker.ack(eval_id, token)
@@ -107,11 +113,11 @@ class RemoteBackend:
         return self._leader() is not None
 
     def dequeue(self, schedulers: List[str], timeout: float
-                ) -> Tuple[Optional[Evaluation], str]:
+                ) -> Tuple[Optional[Evaluation], str, int]:
         leader = self._leader()
         if leader is None:
             time.sleep(0.1)
-            return None, ""
+            return None, "", 0
         try:
             resp = self.pool.call(leader, "Eval.Dequeue",
                                   {"Schedulers": list(schedulers),
@@ -121,10 +127,10 @@ class RemoteBackend:
             # Leader churn / transport failure: treat as an empty dequeue;
             # the run loop retries against the next leader hint.
             time.sleep(0.1)
-            return None, ""
+            return None, "", 0
         ev = resp.get("Eval")
-        return (from_dict(Evaluation, ev) if ev else None), \
-            resp.get("Token", "")
+        return ((from_dict(Evaluation, ev) if ev else None),
+                resp.get("Token", ""), int(resp.get("WaitIndex", 0) or 0))
 
     @staticmethod
     def _retype(exc) -> None:
@@ -230,10 +236,10 @@ class Worker:
             got = self._dequeue_evaluation()
             if got is None:
                 continue
-            ev, token = got
+            ev, token, wait_index = got
             self._eval, self._token = ev, token
             try:
-                self._wait_for_index(ev.ModifyIndex)
+                self._wait_for_index(max(ev.ModifyIndex, wait_index))
                 self._invoke_scheduler(ev, token)
             except Exception:
                 # Leadership loss tears down the plan queue / broker under a
@@ -253,9 +259,9 @@ class Worker:
         got = self._dequeue_evaluation(timeout)
         if got is None:
             return False
-        ev, token = got
+        ev, token, wait_index = got
         try:
-            self._wait_for_index(ev.ModifyIndex)
+            self._wait_for_index(max(ev.ModifyIndex, wait_index))
             self._invoke_scheduler(ev, token)
         except Exception:
             logger.exception("worker: failed to process eval %s", ev.ID)
@@ -265,15 +271,16 @@ class Worker:
         return True
 
     def _dequeue_evaluation(self, timeout: float = DEQUEUE_TIMEOUT
-                            ) -> Optional[Tuple[Evaluation, str]]:
+                            ) -> Optional[Tuple[Evaluation, str, int]]:
         try:
-            ev, token = self.backend.dequeue(self.schedulers, timeout)
+            ev, token, wait_index = self.backend.dequeue(self.schedulers,
+                                                         timeout)
         except RuntimeError:
             time.sleep(BACKOFF_BASELINE)
             return None
         if ev is None:
             return None
-        return ev, token
+        return ev, token, wait_index
 
     def _wait_for_index(self, index: int) -> None:
         """Raft-sync barrier (reference: worker.go:214-244)."""

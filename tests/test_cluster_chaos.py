@@ -12,6 +12,7 @@ Asserted invariants:
   - throughput recovers: jobs submitted AFTER the kill also complete
 """
 
+import os
 import random
 import threading
 import time
@@ -49,11 +50,11 @@ PARTITION_AT = 60   # jobs submitted before a survivor's gossip partitions
 TERMINAL = (EvalStatusComplete, EvalStatusFailed, EvalStatusCancelled)
 
 
-def boot(name, join=None):
+def boot(name, join=None, expect=3, raft_config=None):
     cs = ClusterServer(ServerConfig(
-        node_id="", num_schedulers=1, bootstrap_expect=3,
+        node_id="", num_schedulers=1, bootstrap_expect=expect,
         scheduler_window=8))
-    cs.connect([], raft_config=FAST)
+    cs.connect([], raft_config=raft_config or FAST)
     cs.start()
     cs.enable_gossip(name, join=join, gossip_config=GossipConfig.fast())
     return cs
@@ -220,3 +221,108 @@ def _rpc_retry(live, method, args, attempts=40, delay=0.25):
                 last = e
         time.sleep(delay)
     raise last if last is not None else RuntimeError("no live servers")
+
+
+@pytest.mark.skipif(not os.environ.get("NOMAD_TPU_SOAK"),
+                    reason="set NOMAD_TPU_SOAK=1 for the extended soak")
+class TestExtendedSoak:
+    def test_sustained_storm_with_repeated_leader_kills(self):
+        """Soak: a longer storm with TWO leader kills and a gossip
+        partition; same invariants as the chaos test at 3x the load. Run
+        with NOMAD_TPU_SOAK=1 (not part of the default CI budget).
+
+        Raft timings are LOOSER than the quick chaos test's: four servers
+        plus a sustained storm share one Python process here, and
+        100ms-class election timeouts under that load produce perpetual
+        leadership churn (a harness artifact, not a cluster property —
+        real deployments run 150-500ms timeouts per the raft paper's
+        guidance for their actual network, not their GIL)."""
+        soak_raft = RaftConfig(heartbeat_interval=0.05,
+                               election_timeout_min=0.30,
+                               election_timeout_max=0.60,
+                               apply_timeout=10.0)
+        n_jobs = 240
+        nodes = [boot("s0", raft_config=soak_raft)]
+        nodes.append(boot("s1", join=[_gaddr(nodes[0])],
+                          raft_config=soak_raft))
+        nodes.append(boot("s2", join=[_gaddr(nodes[0])],
+                          raft_config=soak_raft))
+        nodes.append(boot("s3", join=[_gaddr(nodes[0])],
+                          raft_config=soak_raft))
+        live = list(nodes)
+        try:
+            assert wait_for(lambda: leader_of(live) is not None, timeout=30)
+            for _ in range(N_NODES):
+                _rpc_retry(live, "Node.Register",
+                           {"Node": to_dict(mock.node())})
+            jobs = [make_job() for _ in range(n_jobs)]
+            submitted = {}
+            partitioned = []
+
+            def kill_leader():
+                victim = leader_of(live)
+                if victim is None or len(live) <= 2:
+                    return
+                live.remove(victim)
+                victim.shutdown()
+                # Rolling failures: the next kill must wait until gossip
+                # failure detection has pruned this peer from the raft
+                # config, or quorum would become unreachable — the same
+                # operational constraint the reference has (you can't lose
+                # 2 of 4 voters before reconciliation). Asserting the
+                # prune IS part of the soak.
+                assert wait_for(
+                    lambda: (ldr := leader_of(live)) is not None
+                    and victim.addr not in ldr.server.raft.peers,
+                    timeout=30), "dead peer never pruned from raft config"
+
+            for i, job in enumerate(jobs):
+                if i in (60, 150):
+                    kill_leader()
+                if i == 100:
+                    target = next((n for n in live
+                                   if n is not leader_of(live)), None)
+                    if target is not None and target.membership is not None:
+                        ml = target.membership.memberlist
+                        ml.transport_filter = lambda dest, msgs: False
+                        partitioned.append(ml)
+                if i == 200:
+                    for ml in partitioned:
+                        ml.transport_filter = None
+                resp = _rpc_retry(live, "Job.Register",
+                                  {"Job": to_dict(job)})
+                submitted[job.ID] = resp["EvalID"]
+                time.sleep(0.005)
+
+            def all_terminal():
+                ldr = leader_of(live)
+                if ldr is None:
+                    return False
+                state = ldr.server.state
+                return all(
+                    (e := state.eval_by_id(eid)) is not None
+                    and e.Status in TERMINAL
+                    for eid in submitted.values())
+
+            assert wait_for(all_terminal, timeout=180, interval=0.3)
+            state = leader_of(live).server.state
+            for job in jobs:
+                allocs = [a for a in state.allocs_by_job(job.ID)
+                          if not a.terminal_status()]
+                assert len(allocs) == PER_JOB, (job.ID, len(allocs))
+            cap = {n.ID: resources_vec(n.Resources) for n in state.nodes()}
+            used = {}
+            for a in state.allocs():
+                if a.terminal_status():
+                    continue
+                u = used.setdefault(a.NodeID,
+                                    np.zeros(5, dtype=np.float64))
+                u += alloc_vec(a)
+            for nid, u in used.items():
+                assert (u <= cap[nid] + 1e-6).all()
+        finally:
+            for n in nodes:
+                try:
+                    n.shutdown()
+                except Exception:
+                    pass

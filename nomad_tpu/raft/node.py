@@ -229,6 +229,12 @@ class RaftNode:
                 "last_log_index": self.last_index,
                 "num_peers": len(self._peers),
                 "snapshot_index": self._snap_index,
+                # True once this node holds a real cluster configuration:
+                # explicit peers at construction, bootstrap_cluster(), or
+                # admission via a committed Config entry. Virgin gossip
+                # servers are False — the bootstrap-expect probe keys off
+                # this, NOT off the peer set (which always contains self).
+                "configured": self._electable,
             }
 
     # -------------------------------------------------------------- helpers
@@ -576,11 +582,15 @@ class RaftNode:
         discovered set; the usual election then picks one leader (reference:
         maybeBootstrap's SetPeers, nomad/serf.go:80-139)."""
         with self._lock:
-            # Empty log + no snapshot + no peer set = virgin. (A bumped
-            # term alone — e.g. we granted a vote to an already-
-            # bootstrapped peer — does not disqualify: the log/config
-            # decide whether a cluster exists.)
-            if self.last_index > 0 or self._snap_index > 0 or self._peers:
+            # Empty log + no snapshot + no configuration = virgin. The
+            # peer set ALWAYS contains self (set at construction), so the
+            # tests are "knows peers beyond itself" and "already electable"
+            # — not peer-set truthiness. (A bumped term alone — e.g. we
+            # granted a vote to an already-bootstrapped peer — does not
+            # disqualify: the log/config decide whether a cluster exists.)
+            if (self.last_index > 0 or self._snap_index > 0
+                    or self._electable
+                    or any(p != self.id for p in self._peers)):
                 return False
             self._peers = list(peers)
             if self.id not in self._peers:

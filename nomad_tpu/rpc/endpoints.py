@@ -215,13 +215,20 @@ class Endpoints:
         raft = self.server.raft
         if hasattr(raft, "stats"):
             stats = raft.stats()
-            # A CONFIGURED PEER SET counts as bootstrapped even with an
-            # empty log: between bootstrap_cluster and the first leader's
-            # noop entry the log index is 0, and a late joiner probing in
-            # that window must not form a SECOND cluster config.
+            # A node counts as bootstrapped when it holds log/snapshot
+            # state, knows peers BEYOND itself, or carries an explicit
+            # cluster configuration ("configured": bootstrap_cluster /
+            # Config admission / explicit peers). The last covers the
+            # window between bootstrap_cluster and the first leader's noop
+            # entry, when the log index is still 0 but a late joiner must
+            # not form a SECOND cluster. Virgin servers always have
+            # themselves in the peer set, so raw peer-set truthiness is
+            # meaningless — round-3 regression: every virgin server
+            # reported true and no cluster ever formed.
             return {"Bootstrapped": stats.get("last_log_index", 0) > 0
                     or stats.get("snapshot_index", 0) > 0
-                    or bool(getattr(raft, "peers", ())),
+                    or stats.get("num_peers", 0) > 1
+                    or bool(stats.get("configured")),
                     "Stats": stats}
         return {"Bootstrapped": True, "Stats": {}}  # dev mode
 

@@ -261,6 +261,22 @@ class ServerMembership:
         with self._lock:
             if self._bootstrapped:
                 return
+        # If our own raft already carries a cluster — a log, a snapshot, or
+        # an explicit configuration (a leader's Config entry admitted us
+        # while we were still counting expect-peers) — bootstrap is moot:
+        # latch and stop probing.
+        raft = self.server.raft
+        if hasattr(raft, "stats"):
+            st = raft.stats()
+            if (st.get("last_log_index", 0) > 0
+                    or st.get("snapshot_index", 0) > 0
+                    or st.get("configured")):
+                with self._lock:
+                    self._bootstrapped = True
+                return
+        with self._lock:
+            if self._bootstrapped:
+                return
             local = [p for p in self.peers.get(self.region, {}).values()
                      if p.status in ("alive", "suspect")]
             # All discovered servers must agree on the expect count
@@ -289,11 +305,15 @@ class ServerMembership:
                          self.gossip_name, addr, exc)
                 return
             if resp.get("Bootstrapped"):
+                # Do NOT latch _bootstrapped here: that cluster's leader
+                # will admit us via reconcile → Config entry, and the
+                # own-raft check above latches once it does. Latching on a
+                # probe answer wedged round 3 — a wrong "true" (or a
+                # cluster that dies before adding us) would leave this
+                # node permanently unelectable.
                 LOG.info("%s: existing cluster found at %s; waiting to be "
                          "added instead of bootstrapping", self.gossip_name,
                          addr)
-                with self._lock:
-                    self._bootstrapped = True
                 return
         with self._lock:
             if self._bootstrapped:

@@ -238,3 +238,59 @@ class TestFederation:
         finally:
             a.shutdown()
             b.shutdown()
+
+
+class TestBootstrapProbe:
+    """Status.RaftStats is the bootstrap-expect probe (reference:
+    maybeBootstrap probing peers before forming a cluster,
+    nomad/serf.go:104-130). Round-3 regression class: the raft peer set
+    always contains self, so peer-set truthiness made every VIRGIN server
+    report Bootstrapped=true — three virgin servers all deferred to each
+    other forever and no cluster formed."""
+
+    def test_virgin_server_reports_not_bootstrapped(self):
+        cs = boot("probe-v0", expect=3)
+        try:
+            resp = cs.endpoints.handle("Status.RaftStats", {})
+            assert resp["Bootstrapped"] is False
+            assert resp["Stats"]["num_peers"] == 1  # self only
+            assert not resp["Stats"]["configured"]
+        finally:
+            cs.shutdown()
+
+    def test_live_cluster_reports_bootstrapped(self):
+        cs = boot("probe-l0", expect=1)
+        try:
+            assert wait_for(lambda: leader_of([cs]) is not None)
+            resp = cs.endpoints.handle("Status.RaftStats", {})
+            assert resp["Bootstrapped"] is True
+            # A live node must refuse a second bootstrap.
+            assert cs.server.raft.bootstrap_cluster(["bogus:1"]) is False
+        finally:
+            cs.shutdown()
+
+    def test_virgin_joiner_defers_to_live_cluster(self):
+        """1 virgin + 1 live cluster: the virgin server meets its expect
+        count but must NOT form a second cluster — it defers on the probe
+        and is admitted by the leader's reconcile instead."""
+        nodes = [boot("probe-a", expect=2)]
+        nodes.append(boot("probe-b", expect=2,
+                          join=[gossip_addr(nodes[0])]))
+        try:
+            assert wait_for(lambda: leader_of(nodes) is not None)
+            late = boot("probe-c", expect=2, join=[gossip_addr(nodes[0])])
+            nodes.append(late)
+            addrs = sorted(n.addr for n in nodes)
+            # Admitted via Config entry, not a fresh bootstrap: all three
+            # converge on ONE cluster with ONE shared leader.
+            for n in nodes:
+                assert wait_for(
+                    lambda n=n: sorted(n.server.raft.peers) == addrs)
+            assert wait_for(
+                lambda: len({n.server.raft.leader_id for n in nodes}) == 1
+                and nodes[0].server.raft.leader_id)
+            assert sum(1 for n in nodes
+                       if n.server.is_leader() and n.server._leader) == 1
+        finally:
+            for n in nodes:
+                n.shutdown()

@@ -162,6 +162,16 @@ class RaftNode:
         if was_leader:
             self._leader_events.put(False)
         self._leader_events.put(None)
+        # Join our loops before returning: the apply loop drives the FSM,
+        # whose state commits touch the tensor index (JAX device arrays) —
+        # a daemon thread left mid-dispatch at interpreter exit aborts XLA
+        # teardown. Skip the current thread: shutdown can be reached from
+        # the notify loop's own leader-change callback.
+        deadline = time.monotonic() + 30.0
+        for t in self._threads:
+            if t.is_alive() and t is not threading.current_thread():
+                t.join(timeout=max(0.1, deadline - time.monotonic()))
+        self._threads = []
 
     def _restore_from_disk(self) -> None:
         snap = self.log.latest_snapshot()

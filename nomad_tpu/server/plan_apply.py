@@ -305,6 +305,15 @@ class PlanApplier:
     def stop(self) -> None:
         self._stop.set()
 
+    def join(self, timeout: float = 30.0) -> None:
+        """The apply path commits plan results into the tensor index (JAX
+        device arrays); an unjoined thread there at interpreter exit
+        aborts XLA teardown."""
+        t = self._thread
+        if (t is not None and t.is_alive()
+                and t is not threading.current_thread()):
+            t.join(timeout)
+
     def run(self) -> None:
         self._pool = ThreadPoolExecutor(max_workers=self._pool_size,
                                         thread_name_prefix="plan-eval")
@@ -382,7 +391,10 @@ class PlanApplier:
         finally:
             if wait is not None:
                 wait.join()
-            self._pool.shutdown(wait=False)
+            # Pool work is synchronous within _verify, so the pool is idle
+            # here; wait=True is immediate and leaves no worker for the
+            # interpreter-exit join to trip over.
+            self._pool.shutdown(wait=True)
             self._pool = None
 
     def _verify_group(self, batch: List[PendingPlan],

@@ -172,6 +172,11 @@ class Server:
         self.periodic = PeriodicDispatch(self._dispatch_periodic)
         self.workers: List[Worker] = []
         self.remote_workers: List[Worker] = []
+        # Workers stopped on leadership loss keep running until their
+        # current eval finishes; shutdown() must join them (their threads
+        # dispatch XLA work — abandoning one at interpreter exit aborts
+        # the process).
+        self._retired_workers: List[Worker] = []
         self._leader = False
         self._shutdown = threading.Event()
         self._reapers: List[threading.Thread] = []
@@ -217,6 +222,10 @@ class Server:
         """(reference: monitorLeadership consuming leaderCh,
         nomad/leader.go:24-56)"""
         with self._leadership_lock:
+            if self._shutdown.is_set():
+                # A True event racing shutdown must not start fresh worker
+                # / plan-applier threads after shutdown's join loop ran.
+                return
             if is_leader and not self._leader:
                 # Barrier: apply everything from prior terms before
                 # rehydrating leader state (reference: leader.go:60-68).
@@ -297,7 +306,10 @@ class Server:
         self.periodic.set_enabled(False)
         self.heartbeats.clear_all()
         for w in self.workers:
-            w.stop()
+            w.stop()  # non-blocking: may run on the raft notify thread
+        self._retired_workers = [w for w in self._retired_workers
+                                 if w._thread and w._thread.is_alive()]
+        self._retired_workers.extend(self.workers)
         self.workers = []
         self.fsm.on_eval_update = None
         self.fsm.on_node_ready = None
@@ -309,12 +321,34 @@ class Server:
 
     def shutdown(self) -> None:
         self._shutdown.set()
-        for w in self.remote_workers:
-            w.stop()
-        self.remote_workers = []
-        self.revoke_leadership()
+        # Serialize against in-flight leadership transitions on the raft
+        # notify thread: both paths mutate workers/_retired_workers, and an
+        # unserialized pair of revoke_leadership runs can drop a worker
+        # from the retired list (never joined → XLA-teardown abort). The
+        # _shutdown check in _leadership_transition keeps later True
+        # events from starting fresh threads once we release the lock.
+        with self._leadership_lock:
+            remote = self.remote_workers
+            for w in remote:
+                w.stop()
+            self.remote_workers = []
+            self.revoke_leadership()
         if hasattr(self.raft, "shutdown"):
             self.raft.shutdown()
+        # Join every thread that can touch JAX before returning: a daemon
+        # thread still inside an XLA dispatch races CPython/XLA teardown
+        # and aborts the interpreter (round-3 regression: BENCH rc=134,
+        # MULTICHIP ok:false). Workers were signalled above, so joins
+        # overlap their wind-down; the deadline bounds a wedged thread.
+        deadline = time.monotonic() + 60.0
+        for w in remote + self._retired_workers:
+            w.join(timeout=max(0.1, deadline - time.monotonic()))
+        self._retired_workers = []
+        self.plan_applier.join(timeout=max(0.1, deadline - time.monotonic()))
+        for t in self._reapers:
+            if t.is_alive() and t is not threading.current_thread():
+                t.join(timeout=max(0.1, deadline - time.monotonic()))
+        self._reapers = []
 
     def _emit_stats(self) -> None:
         """Leader-side operational gauges, emitted every second

@@ -217,7 +217,18 @@ class Worker:
         self._thread.start()
 
     def stop(self) -> None:
+        """Signal the run loop to exit without blocking (the leadership-flap
+        path calls this from the raft notify thread). The Server keeps a
+        reference and joins retired workers at shutdown — a worker thread
+        left inside an XLA dispatch at interpreter exit aborts the whole
+        process (round-3 regression: bench rc=134)."""
         self._stop.set()
+
+    def join(self, timeout: float = 30.0) -> None:
+        t = self._thread
+        if (t is not None and t.is_alive()
+                and t is not threading.current_thread()):
+            t.join(timeout)
 
     def set_pause(self, paused: bool) -> None:
         """(reference: worker.go:81-99) Pause during leadership transitions."""

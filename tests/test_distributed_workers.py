@@ -149,3 +149,38 @@ class TestDistributedWorkers:
             assert len(new_leader.server.state.allocs_by_job(job.ID)) == 10
         finally:
             shutdown_all(nodes)
+
+
+class TestShutdownHygiene:
+    """Round-3 regression class: daemon threads (workers, plan applier,
+    raft loops) left inside an XLA dispatch at interpreter exit abort
+    CPython/XLA teardown (bench rc=134). Server.shutdown() must join every
+    JAX-touching thread before returning."""
+
+    def test_shutdown_joins_all_server_threads(self):
+        import threading
+
+        nodes = make_cluster(n=3, num_schedulers=1)
+        try:
+            assert wait_for(lambda: leader_of(nodes) is not None)
+            leader = leader_of(nodes)
+            # Put real scheduling work through so worker threads have
+            # actually dispatched device work before we tear down.
+            for _ in range(2):
+                leader.server.node_register(mock.node())
+            job = mock.job()
+            eval_id, _, _ = leader.server.job_register(job)
+            assert wait_for(lambda: (
+                (e := leader.server.state.eval_by_id(eval_id)) is not None
+                and e.Status == EvalStatusComplete), timeout=30)
+        finally:
+            shutdown_all(nodes)
+        # Every framework thread must be gone (or never started). Daemon
+        # helpers that idle forever by design (timer wheel pool) are
+        # exempt; worker/plan-apply/raft threads are not.
+        deadline_names = ("worker", "remote-worker", "plan-apply",
+                          "plan-eval", "raft-tick", "raft-apply",
+                          "raft-notify", "raft-repl", "pipelined")
+        leftovers = [t.name for t in threading.enumerate()
+                     if any(t.name.startswith(p) for p in deadline_names)]
+        assert not leftovers, f"threads survived shutdown: {leftovers}"

@@ -24,6 +24,7 @@ from __future__ import annotations
 import logging
 import os
 import threading
+import time
 from concurrent.futures import ThreadPoolExecutor
 from typing import Dict, List, Optional, Set, Tuple
 
@@ -287,6 +288,7 @@ class PlanApplier:
         self.tindex = tindex
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        self._retired: List[threading.Thread] = []
         self._pool_size = pool_size or max(1, (os.cpu_count() or 2) // 2)
         self._pool: Optional[ThreadPoolExecutor] = None
         # Counters for telemetry/tests.
@@ -297,9 +299,20 @@ class PlanApplier:
         return self.tindex.nt if self.tindex is not None else None
 
     def start(self) -> None:
-        self._stop.clear()
-        self._thread = threading.Thread(target=self.run, daemon=True,
-                                        name="plan-apply")
+        """Each run gets its OWN stop event, handed to the thread — a
+        leadership flap that calls stop();start() must not revive the old
+        run by clearing a shared flag (two live appliers would break the
+        one-apply-in-flight invariant and could over-commit). The new run
+        serializes behind the old thread before consuming the queue, and
+        the old thread is retired for join() so shutdown still reaps it."""
+        prev = self._thread
+        if prev is not None and prev.is_alive():
+            self._retired.append(prev)
+        self._retired = [t for t in self._retired if t.is_alive()]
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self.run, args=(self._stop, prev), daemon=True,
+            name="plan-apply")
         self._thread.start()
 
     def stop(self) -> None:
@@ -308,13 +321,21 @@ class PlanApplier:
     def join(self, timeout: float = 30.0) -> None:
         """The apply path commits plan results into the tensor index (JAX
         device arrays); an unjoined thread there at interpreter exit
-        aborts XLA teardown."""
-        t = self._thread
-        if (t is not None and t.is_alive()
-                and t is not threading.current_thread()):
-            t.join(timeout)
+        aborts XLA teardown. Joins retired (flap-era) runs too."""
+        deadline = time.monotonic() + timeout
+        for t in [*self._retired, self._thread]:
+            if (t is not None and t.is_alive()
+                    and t is not threading.current_thread()):
+                t.join(max(0.1, deadline - time.monotonic()))
 
-    def run(self) -> None:
+    def run(self, stop: Optional[threading.Event] = None,
+            prev: Optional[threading.Thread] = None) -> None:
+        stop = stop if stop is not None else self._stop
+        if prev is not None and prev.is_alive():
+            # One applier at a time: wait out the previous run's last
+            # iteration (bounded by its 0.5s dequeue poll + in-flight
+            # apply) before touching the queue.
+            prev.join(timeout=60.0)
         self._pool = ThreadPoolExecutor(max_workers=self._pool_size,
                                         thread_name_prefix="plan-eval")
         # One in-flight raft apply at a time; while it commits, the NEXT
@@ -328,7 +349,7 @@ class PlanApplier:
         wait: Optional[threading.Thread] = None
         opt: Optional[OptimisticSnapshot] = None
         try:
-            while not self._stop.is_set():
+            while not stop.is_set():
                 try:
                     pending = self.plan_queue.dequeue(timeout=0.5)
                     batch = [pending] if pending is not None else []

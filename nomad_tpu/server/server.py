@@ -49,6 +49,7 @@ from nomad_tpu.structs.structs import (
 )
 from nomad_tpu.telemetry import metrics
 from nomad_tpu.tensor import TensorIndex
+from nomad_tpu.raft import NotLeaderError
 
 from .blocked_evals import BlockedEvals
 from .core_sched import CoreScheduler
@@ -101,11 +102,29 @@ class ServerConfig:
     # Scheduling workers on follower servers, dequeuing/submitting over
     # leader RPC (reference: workers on every server, worker.go:101-130).
     distributed_workers: bool = True
+    # Server-side coalescing of Node.UpdateAlloc: concurrent client RPCs
+    # within this window share ONE raft entry / future (reference:
+    # batchUpdateInterval + batchFuture, node_endpoint.go:530-593). At 10k
+    # clients x task churn, one consensus apply per RPC is the
+    # consensus-throughput wall. 0 disables (one apply per RPC).
+    alloc_update_batch_interval: float = 0.05
     dev_mode: bool = False
     # Replicated deployment (reference: nomad/config.go RaftConfig +
     # BootstrapExpect). node_id doubles as the raft/transport address.
     node_id: str = ""
     bootstrap_expect: int = 1
+
+
+class _BatchAllocUpdate:
+    """Shared future for one coalesced window of client alloc updates
+    (reference: structs.BatchFuture, node_endpoint.go:530-545)."""
+
+    __slots__ = ("event", "index", "error")
+
+    def __init__(self) -> None:
+        self.event = threading.Event()
+        self.index = 0
+        self.error: Optional[Exception] = None
 
 
 class Server:
@@ -180,6 +199,11 @@ class Server:
         self._leader = False
         self._shutdown = threading.Event()
         self._reapers: List[threading.Thread] = []
+        # Coalesced Node.UpdateAlloc window (node_endpoint.go:530-593).
+        self._alloc_update_cond = threading.Condition()
+        self._alloc_update_pending: List[Allocation] = []
+        self._alloc_update_future: Optional[_BatchAllocUpdate] = None
+        self._alloc_flush_thread: Optional[threading.Thread] = None
 
     # ------------------------------------------------------------ leadership
     def start(self) -> None:
@@ -335,6 +359,12 @@ class Server:
             self.revoke_leadership()
         if hasattr(self.raft, "shutdown"):
             self.raft.shutdown()
+        # Wake the alloc-update flusher so it drains any open window (the
+        # waiters get NotLeaderError from the dead raft) and exits.
+        with self._alloc_update_cond:
+            self._alloc_update_cond.notify_all()
+        if self._alloc_flush_thread is not None:
+            self._alloc_flush_thread.join(timeout=30.0)
         # Join every thread that can touch JAX before returning: a daemon
         # thread still inside an XLA dispatch races CPython/XLA teardown
         # and aborts the interpreter (round-3 regression: BENCH rc=134,
@@ -768,9 +798,76 @@ class Server:
         return [e.ID for e in evals]
 
     def node_update_allocs(self, allocs: List[Allocation]) -> int:
-        """Client alloc status sync (reference: node_endpoint.go:530-593)."""
-        return self.raft.apply(MessageType.AllocClientUpdate,
-                               {"Alloc": allocs})
+        """Client alloc status sync, coalesced server-side: all RPCs that
+        land within one batch window ride a single raft entry and share a
+        future carrying the commit index (reference: batchFuture +
+        batchUpdateInterval, node_endpoint.go:530-593). FSM apply order
+        within the batch preserves arrival order, so a later update to the
+        same alloc wins — same as the reference's appended updates."""
+        interval = self.config.alloc_update_batch_interval
+        if interval <= 0:
+            return self.raft.apply(MessageType.AllocClientUpdate,
+                                   {"Alloc": allocs})
+        # Leader-only batching, as in the reference: a follower must raise
+        # NotLeaderError synchronously so the endpoint layer forwards at
+        # once, instead of parking the RPC a full window behind a doomed
+        # apply. (Losing leadership after this check is fine — the flush's
+        # apply raises into the shared future.)
+        if hasattr(self.raft, "is_leader") and not self.raft.is_leader():
+            raise NotLeaderError(getattr(self.raft, "leader_id", None))
+        with self._alloc_update_cond:
+            self._alloc_update_pending.extend(allocs)
+            fut = self._alloc_update_future
+            if fut is None:
+                fut = self._alloc_update_future = _BatchAllocUpdate()
+                if (self._alloc_flush_thread is None
+                        or not self._alloc_flush_thread.is_alive()):
+                    self._alloc_flush_thread = threading.Thread(
+                        target=self._alloc_flush_loop, daemon=True,
+                        name="alloc-update-flush")
+                    self._alloc_flush_thread.start()
+                self._alloc_update_cond.notify()
+        if not fut.event.wait(timeout=interval + 60.0):
+            raise TimeoutError(
+                "alloc update batch did not resolve within "
+                f"{interval + 60.0:.0f}s (consensus stalled?)")
+        if fut.error is not None:
+            raise fut.error
+        return fut.index
+
+    def _alloc_flush_loop(self) -> None:
+        """Dedicated flusher: waits for a window to open, lets it fill for
+        one batch interval, commits it as one entry, and wakes every
+        waiting RPC with the shared result. A single long-lived thread —
+        NOT the shared timer-wheel pool, where a consensus stall's worth of
+        heartbeat callbacks could queue a flush behind them for minutes."""
+        while True:
+            with self._alloc_update_cond:
+                while (self._alloc_update_future is None
+                       and not self._shutdown.is_set()):
+                    self._alloc_update_cond.wait(timeout=0.5)
+                if self._shutdown.is_set() and self._alloc_update_future is None:
+                    return
+            self._shutdown.wait(self.config.alloc_update_batch_interval)
+            self._flush_alloc_updates()
+
+    def _flush_alloc_updates(self) -> None:
+        with self._alloc_update_cond:
+            batch = self._alloc_update_pending
+            fut = self._alloc_update_future
+            self._alloc_update_pending = []
+            self._alloc_update_future = None
+        if fut is None:
+            return
+        metrics.set_gauge(("nomad", "client", "update_alloc_batch"),
+                          len(batch))
+        try:
+            fut.index = self.raft.apply(MessageType.AllocClientUpdate,
+                                        {"Alloc": batch})
+        except Exception as e:  # NotLeaderError et al: every waiter sees it
+            fut.error = e
+        finally:
+            fut.event.set()
 
     # Service registry (standalone replacement for the reference's Consul
     # delegation, command/agent/consul/syncer.go — see structs.ServiceRegistration)

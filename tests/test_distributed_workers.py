@@ -160,6 +160,9 @@ class TestShutdownHygiene:
     def test_shutdown_joins_all_server_threads(self):
         import threading
 
+        # Only judge threads THIS test creates: an earlier test in the
+        # process may have legitimately leaked past its own join timeout.
+        preexisting = set(threading.enumerate())
         nodes = make_cluster(n=3, num_schedulers=1)
         try:
             assert wait_for(lambda: leader_of(nodes) is not None)
@@ -180,7 +183,9 @@ class TestShutdownHygiene:
         # exempt; worker/plan-apply/raft threads are not.
         deadline_names = ("worker", "remote-worker", "plan-apply",
                           "plan-eval", "raft-tick", "raft-apply",
-                          "raft-notify", "raft-repl", "pipelined")
+                          "raft-notify", "raft-repl", "pipelined",
+                          "alloc-update-flush")
         leftovers = [t.name for t in threading.enumerate()
-                     if any(t.name.startswith(p) for p in deadline_names)]
+                     if t not in preexisting
+                     and any(t.name.startswith(p) for p in deadline_names)]
         assert not leftovers, f"threads survived shutdown: {leftovers}"

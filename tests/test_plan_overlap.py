@@ -327,3 +327,59 @@ class TestGroupedCommit:
         finally:
             applier.stop()
             queue.set_enabled(False)
+
+
+class TestLeadershipFlap:
+    def test_flap_never_revives_or_orphans_an_applier(self):
+        """stop();start() in quick succession (leadership flap) must leave
+        exactly ONE live applier: per-run stop events mean the old run
+        cannot be revived by a cleared flag, the new run serializes behind
+        it, and join() reaps retired runs."""
+        fsm = FSM()
+        raft = SlowRaft(fsm, delay=0.02)
+        queue = PlanQueue()
+        queue.set_enabled(True)
+        applier = PlanApplier(queue, raft)
+        applier.start()
+        nodes = _register_nodes(raft, 4, cpu=2000)
+        try:
+            # Keep plans flowing across the flaps.
+            stop_feed = threading.Event()
+            def feeder():
+                while not stop_feed.is_set():
+                    pending = queue.enqueue(_make_plan(nodes,
+                                                       cpu_per_alloc=100))
+                    pending.wait(timeout=10)
+            feeders = [threading.Thread(target=feeder) for _ in range(2)]
+            for t in feeders:
+                t.start()
+            for _ in range(5):  # rapid flaps
+                applier.stop()
+                applier.start()
+                time.sleep(0.05)
+            stop_feed.set()
+            for t in feeders:
+                t.join(timeout=20)
+            deadline = time.time() + 10
+            def live():
+                return [t for t in threading.enumerate()
+                        if t.name == "plan-apply" and t.is_alive()]
+            while time.time() < deadline and len(live()) > 1:
+                time.sleep(0.05)
+            assert len(live()) == 1, [t.name for t in live()]
+            # The survivor still commits plans.
+            pending = queue.enqueue(_make_plan(nodes, cpu_per_alloc=100))
+            res = pending.wait(timeout=10)
+            assert res is not None
+            # No oversubscription slipped through the flap windows.
+            for node in nodes:
+                used = sum(alloc_vec(a)[0]
+                           for a in fsm.state.allocs_by_node(node.ID)
+                           if not a.terminal_status())
+                assert used <= 2000, f"node oversubscribed: {used}"
+        finally:
+            applier.stop()
+            queue.set_enabled(False)
+            applier.join(timeout=30)
+            assert not [t for t in threading.enumerate()
+                        if t.name == "plan-apply" and t.is_alive()]

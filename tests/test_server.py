@@ -438,3 +438,91 @@ class TestServerIntegration:
                 lambda: srv.state.node_by_id(node.ID) is None, timeout=20)
         finally:
             srv.shutdown()
+
+
+class TestAllocUpdateCoalescing:
+    """Server-side batching of Node.UpdateAlloc (reference: batchFuture +
+    batchUpdateInterval, node_endpoint.go:530-593): concurrent client RPCs
+    within one window must share a single raft entry, and every caller must
+    observe that entry's commit index."""
+
+    def _place(self, srv, n_nodes=3):
+        for _ in range(n_nodes):
+            srv.node_register(mock.node())
+        job = mock.job()
+        eval_id, _, _ = srv.job_register(job)
+        assert wait_for(lambda: (
+            (e := srv.state.eval_by_id(eval_id)) is not None
+            and e.Status == EvalStatusComplete))
+        return srv.state.allocs_by_job(job.ID)
+
+    def test_concurrent_updates_share_one_raft_entry(self):
+        import threading
+
+        srv = Server(ServerConfig(
+            num_schedulers=1, alloc_update_batch_interval=0.05))
+        srv.establish_leadership()
+        real_apply = srv.raft.apply
+        try:
+            allocs = self._place(srv)
+            assert len(allocs) == 10
+            applies = []
+
+            def counting_apply(msg_type, payload):
+                if msg_type == MessageType.AllocClientUpdate:
+                    applies.append(len(payload["Alloc"]))
+                return real_apply(msg_type, payload)
+
+            srv.raft.apply = counting_apply
+            indexes = []
+            errors = []
+
+            def one_rpc(alloc):
+                upd = mock.alloc()
+                upd.ID = alloc.ID
+                upd.NodeID = alloc.NodeID
+                upd.JobID = alloc.JobID
+                upd.ClientStatus = AllocClientStatusComplete
+                try:
+                    indexes.append(srv.node_update_allocs([upd]))
+                except Exception as e:  # pragma: no cover
+                    errors.append(e)
+
+            threads = [threading.Thread(target=one_rpc, args=(a,))
+                       for a in allocs]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=30)
+            assert not errors, errors
+            # 10 concurrent RPCs -> far fewer consensus entries (typically
+            # 1-2 windows), carrying all 10 updates between them.
+            assert len(indexes) == 10
+            assert len(applies) <= 3, f"{len(applies)} raft applies"
+            assert sum(applies) == 10
+            # Every caller got a real commit index, and the state reflects
+            # every update at (or before) the index it was handed.
+            assert all(i > 0 for i in indexes)
+            for a in srv.state.allocs_by_job(allocs[0].JobID):
+                assert a.ClientStatus == AllocClientStatusComplete
+        finally:
+            srv.raft.apply = real_apply
+            srv.shutdown()
+
+    def test_batching_disabled_applies_per_rpc(self):
+        srv = Server(ServerConfig(
+            num_schedulers=1, alloc_update_batch_interval=0.0))
+        srv.establish_leadership()
+        try:
+            allocs = self._place(srv)
+            upd = mock.alloc()
+            upd.ID = allocs[0].ID
+            upd.NodeID = allocs[0].NodeID
+            upd.JobID = allocs[0].JobID
+            upd.ClientStatus = AllocClientStatusComplete
+            idx = srv.node_update_allocs([upd])
+            assert idx > 0
+            assert (srv.state.alloc_by_id(allocs[0].ID).ClientStatus
+                    == AllocClientStatusComplete)
+        finally:
+            srv.shutdown()

@@ -211,6 +211,16 @@ def bench_server_e2e(nodes, n_evals):
         stats["rep_rates"] = [round(r, 1) for r in rates]
         stats["rep_min_med_max"] = [round(min(rates), 1), round(rate, 1),
                                     round(max(rates), 1)]
+        # Served-path single-eval latency on an idle broker (the number an
+        # interactive `nomad run` pays): registration -> placement ->
+        # commit, via the host fast path when the window is shallow.
+        lats = []
+        for _ in range(5):
+            t0 = time.perf_counter()
+            run(1, poll=0.002)
+            lats.append(time.perf_counter() - t0)
+        stats["e2e_p50_eval_latency_ms"] = round(
+            float(np.percentile(lats, 50)) * 1e3, 2)
         return rate, placed, stats
     finally:
         srv.shutdown()
@@ -397,6 +407,10 @@ def main():
                                                  2)),
         "placer_only_evals_sec": round(placer_evals_sec, 2),
         "placer_p50_eval_latency_ms": round(p50 * 1e3, 2),
+        # Served-path idle-broker latency (host fast path): what one
+        # interactive job registration pays end-to-end.
+        "e2e_p50_eval_latency_ms": worker_stats.get(
+            "e2e_p50_eval_latency_ms"),
         "cpu_reference_evals_sec": round(cpu_evals_sec, 2),
         # Served-vs-served: the honest apples-to-apples ratio (same server,
         # broker, applier, raft on both sides; only the placement engine

@@ -116,6 +116,124 @@ def place_batch(
     return PlacementResult(packed, usage)
 
 
+_LOG2_10_F32 = np.float32(_LOG2_10)
+
+
+def place_batch_host(capacity, score_cap, usage, tg_masks, job_counts,
+                     demands, tg_ids, valid, noise, penalty,
+                     distinct_hosts, banned0) -> PlacementResult:
+    """Numpy mirror of place_batch for SHALLOW windows.
+
+    On a remote-attached TPU every host sync costs a fixed ~100ms round
+    trip (and the first device->host transfer pins the whole process into
+    that mode), so a lone eval's 50 placements are orders of magnitude
+    faster as host vector ops than as a device dispatch + readback. The
+    pipelined worker routes small idle-broker windows here and storms to
+    the device chain; semantics are identical — same f32 BestFit-v3
+    formula with its Inf/NaN edges (reference funcs.go:102-137), same
+    anti-affinity penalty and noise tie-break, same in-loop usage updates
+    so placement k+1 sees placement k (reference context semantics,
+    scheduler/context.go:109-140). tests/test_tensor_and_kernels.py
+    asserts parity against the device kernel."""
+    capacity = np.asarray(capacity, np.float32)
+    score_cap = np.asarray(score_cap, np.float32)
+    usage = np.array(usage, np.float32, copy=True)
+    job_counts = np.array(job_counts, np.int32, copy=True)
+    banned = np.array(banned0, bool, copy=True)
+    demands = np.asarray(demands, np.float32)
+    tg_ids = np.asarray(tg_ids, np.int32)
+    valid = np.asarray(valid, bool)
+    noise = np.asarray(noise, np.float32)
+    penalty = np.float32(penalty)
+    distinct_hosts = bool(distinct_hosts)
+    tg_masks = np.asarray(tg_masks, bool)
+
+    p = len(tg_ids)
+    packed = np.empty((p, 3), np.float32)
+    neg_inf = np.float32(-np.inf)
+
+    def full_scores(demand):
+        """Whole-table masked-score pass — the same f32 formula as the
+        device kernel's step."""
+        util2 = usage[:, :2] + demand[:2]
+        free_pct = np.float32(1.0) - util2 / score_cap
+        total = (np.exp2(free_pct[:, 0] * _LOG2_10_F32)
+                 + np.exp2(free_pct[:, 1] * _LOG2_10_F32))
+        score = np.clip(np.float32(20.0) - total,
+                        np.float32(0.0), np.float32(18.0))
+        score = np.nan_to_num(score, nan=0.0, posinf=18.0, neginf=0.0)
+        return score - job_counts.astype(np.float32) * penalty + noise
+
+    def row_score(idx, demand):
+        """One row of full_scores, recomputed after the row's usage or
+        count changed — bit-identical to the full pass for that row."""
+        util2 = usage[idx, :2] + demand[:2]
+        free_pct = np.float32(1.0) - util2 / score_cap[idx]
+        total = (np.exp2(free_pct[0] * _LOG2_10_F32)
+                 + np.exp2(free_pct[1] * _LOG2_10_F32))
+        score = np.float32(np.clip(np.float32(20.0) - total,
+                                   np.float32(0.0), np.float32(18.0)))
+        score = np.nan_to_num(score, nan=0.0, posinf=18.0, neginf=0.0)
+        return (score - np.float32(job_counts[idx]) * penalty
+                + noise[idx])
+
+    with np.errstate(divide="ignore", invalid="ignore", over="ignore"):
+        # A storm places many copies of the same task group: between two
+        # placements of one (tg, demand) key only ONE node row changes, so
+        # the masked-score vector is computed once per key and patched at
+        # the placed row afterwards — O(rows) once + O(keys) per step
+        # instead of O(rows) per step. Exactly the same f32 values as the
+        # naive loop (each row's score is a pure function of that row).
+        cache: dict = {}  # key -> [masked, ok, n_feasible, demand, tg]
+        for k in range(p):
+            demand = demands[k]
+            tg = int(tg_ids[k])
+            key = (tg, demand.tobytes())
+            ent = cache.get(key)
+            if ent is None:
+                eligible = tg_masks[tg]
+                fits = np.all(capacity - usage >= demand[None, :], axis=1)
+                ok = fits & eligible
+                if distinct_hosts:
+                    ok &= ~banned
+                masked = np.where(ok, full_scores(demand), neg_inf)
+                ent = cache[key] = [masked, ok,
+                                    np.float32(np.count_nonzero(ok)),
+                                    demand, tg]
+            masked, ok, n_feasible = ent[0], ent[1], ent[2]
+            idx = int(np.argmax(masked))
+            found = bool(ok[idx]) and bool(valid[k])
+            packed[k, 0] = np.float32(idx) if found else np.float32(-1)
+            packed[k, 1] = masked[idx] if found else neg_inf
+            packed[k, 2] = n_feasible
+            if found:
+                usage[idx] += demand
+                job_counts[idx] += 1
+                banned[idx] = True
+                # Patch the changed row into every cached key: a row's
+                # score/feasibility is a pure function of that row, so the
+                # patched vectors stay identical to a full recompute.
+                cap_row = capacity[idx]
+                usage_row = usage[idx]
+                for cent in cache.values():
+                    cmask, cok, cn, cdemand, ctg = cent
+                    old_ok = bool(cok[idx])
+                    new_ok = (bool(np.all(cap_row - usage_row >= cdemand))
+                              and bool(tg_masks[ctg, idx]))
+                    if distinct_hosts:
+                        new_ok = new_ok and not banned[idx]
+                    cok[idx] = new_ok
+                    cmask[idx] = (row_score(idx, cdemand) if new_ok
+                                  else neg_inf)
+                    if new_ok != old_ok:
+                        cent[2] = np.float32(
+                            cn + (1.0 if new_ok else -1.0))
+    # Same result type as the device kernel; both arrays are
+    # host-side numpy here — the pipelined drain dispatches on
+    # isinstance(packed, np.ndarray) and skips the readback.
+    return PlacementResult(packed, usage)
+
+
 # Note: the system scheduler's per-node sweep and the plan applier's
 # re-verification run host-side (numpy / structs.allocs_fit) — they are
 # O(nodes-in-one-plan), tiny next to the placement scan, and need exact

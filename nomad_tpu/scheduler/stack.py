@@ -85,6 +85,15 @@ class _DeviceInputCache:
 
 _dev_cache = _DeviceInputCache()
 
+# Row-steps (node rows x padded placements) under which an eval places via
+# the numpy mirror (kernels.place_batch_host) instead of a device dispatch.
+# A device readback costs a fixed ~100ms sync on remote-attached TPUs; the
+# host kernel's incremental same-demand caching covers this budget in
+# ~10-30ms (one full table pass per unique (tg, demand) + O(1) patches per
+# placement), and a lone 50-placement eval on a 1k-node table in ~2ms.
+# Deep storm windows on big tables stay on the device chain.
+HOST_ROW_STEP_BUDGET = 1 << 23
+
 
 @dataclass
 class SelectedOption:
@@ -241,15 +250,29 @@ class GenericStack:
         # The port-collision retry loop runs at most a handful of times: a
         # winner failing host-side network assignment is masked and the
         # remaining placements re-run.
+        # Small evals place host-side: a device readback pays a fixed
+        # ~100ms RTT on remote-attached TPUs, far more than numpy takes
+        # over a modest rows x placements product. Storms and huge evals
+        # keep the device path (the budget keeps host work bounded).
+        use_host = nt.n_rows * prep.p_pad <= HOST_ROW_STEP_BUDGET
         for _attempt in range(8):
             if not remaining:
                 break
-            res = self.dispatch(prep, banned=banned_extra,
-                                placed_usage=placed_usage,
-                                placed_counts=placed_counts,
-                                placed_hosts=placed_hosts, keep=remaining)
+            if use_host:
+                res = self.dispatch_host(prep, banned=banned_extra,
+                                         placed_usage=placed_usage,
+                                         placed_counts=placed_counts,
+                                         placed_hosts=placed_hosts,
+                                         keep=remaining)
+            else:
+                res = self.dispatch(prep, banned=banned_extra,
+                                    placed_usage=placed_usage,
+                                    placed_counts=placed_counts,
+                                    placed_hosts=placed_hosts,
+                                    keep=remaining)
             # ONE device->host transfer: on remote-attached TPUs a readback
-            # pays a fixed RTT, so results come back packed.
+            # pays a fixed RTT, so results come back packed (free for the
+            # host path — already numpy).
             packed = np.asarray(res.packed)
             failed_rows, remaining = self.collect(
                 prep, packed, results, remaining,
@@ -353,6 +376,14 @@ class GenericStack:
             mask_sh = NamedSharding(mesh, P(None, axis))
             rep_sh = NamedSharding(mesh, P())
         usage = usage_override if usage_override is not None else d["usage"]
+        if isinstance(usage, np.ndarray):
+            # Chain handoff from a host-placed window: one async host->
+            # device upload rejoins the device chain (uploads don't pay
+            # the sync RTT that readbacks do).
+            import jax
+
+            usage = jnp.asarray(usage) if node_sh is None else \
+                jax.device_put(usage, node_sh)
         if len(prep.evict_rows):
             usage = usage.at[prep.evict_rows].add(-prep.evict_vecs)
         if placed_usage is not None and placed_usage.any():
@@ -401,6 +432,62 @@ class GenericStack:
         if pristine:
             prep.dev_inputs = dev
         return kernels.place_batch(d["capacity"], d["score_cap"], usage, *dev)
+
+    def dispatch_host(self, prep: PreparedBatch, usage_override=None,
+                      banned: Optional[np.ndarray] = None,
+                      placed_usage: Optional[np.ndarray] = None,
+                      placed_counts: Optional[np.ndarray] = None,
+                      placed_hosts: Optional[np.ndarray] = None,
+                      keep: Optional[Sequence[int]] = None):
+        """Host-side mirror of dispatch() for shallow windows: every host
+        sync on a remote-attached TPU costs a fixed ~100ms round trip, so
+        a near-idle broker's evals place faster as numpy vector ops than
+        as a device dispatch + readback (kernels.place_batch_host). The
+        result's packed array is already host-side; the pipelined drain
+        recognizes that and skips the device RTT entirely."""
+        nt = self.tindex.nt
+        if usage_override is not None:
+            usage = np.asarray(usage_override, np.float32)
+            with nt._lock:
+                capacity = nt.capacity.copy()
+                score_cap = nt.score_cap.copy()
+        else:
+            # Snapshot under the table lock: alloc commits mutate usage
+            # rows in place, and a lock-free copy could capture a torn row
+            # (cpu updated, mem not) — the same hazard snapshot_rows
+            # documents. The device path gets this via device_arrays().
+            with nt._lock:
+                usage = nt.usage.astype(np.float32, copy=True)
+                capacity = nt.capacity.copy()
+                score_cap = nt.score_cap.copy()
+        if len(prep.evict_rows):
+            usage = usage.copy()
+            np.add.at(usage, prep.evict_rows, -prep.evict_vecs)
+        if placed_usage is not None and placed_usage.any():
+            usage = usage + placed_usage
+
+        masks = prep.tg_masks
+        if banned is not None and banned.any():
+            masks = masks & ~banned[None, :]
+        sel_valid = prep.valid
+        if keep is not None:
+            k = np.zeros(prep.p_pad, dtype=bool)
+            k[list(keep)] = True
+            sel_valid = sel_valid & k
+        counts_now = prep.job_counts
+        if placed_counts is not None:
+            counts_now = counts_now + placed_counts
+        if prep.distinct:
+            hosts = counts_now > 0
+            if placed_hosts is not None:
+                hosts = hosts | placed_hosts
+        else:
+            hosts = np.zeros(nt.n_rows, dtype=bool)
+
+        return kernels.place_batch_host(
+            capacity, score_cap, usage, masks, counts_now,
+            prep.demands, prep.tg_ids, sel_valid, prep.noise_vec,
+            prep.penalty, prep.distinct, hosts)
 
     def collect(self, prep: PreparedBatch, packed: np.ndarray,
                 results: List[Optional[SelectedOption]],

@@ -160,7 +160,8 @@ class PipelinedWorker(Worker):
         # slow = per-eval GenericScheduler, fallback = fast dispatch that
         # re-ran slow after partial commit / port collision) and where the
         # wall-clock went (t_*_ms phase totals across both threads).
-        self.stats = {"fast": 0, "slow": 0, "fallback": 0, "windows": 0,
+        self.stats = {"fast": 0, "slow": 0, "fallback": 0, "host": 0,
+                      "windows": 0,
                       "rebases": 0, "t_refresh_ms": 0.0, "t_dispatch_ms": 0.0,
                       "t_drain_ms": 0.0, "t_build_ms": 0.0,
                       "t_planwait_ms": 0.0, "t_evalupd_ms": 0.0,
@@ -357,10 +358,26 @@ class PipelinedWorker(Worker):
 
         nt = self.tindex.nt
         usage_chain = self._usage_chain(nt)
+        # Shallow windows place HOST-SIDE (kernels.place_batch_host): on a
+        # remote-attached TPU every host sync is a fixed ~100ms round trip,
+        # so a near-idle broker's evals finish in single-digit ms as numpy
+        # while storms keep the device chain. Host mode needs a host-
+        # compatible chain (None = committed table, or a previous host
+        # window's numpy tail); once an eval upgrades to device mid-window
+        # the rest of the window follows (never read a device chain back).
+        from nomad_tpu.scheduler.stack import HOST_ROW_STEP_BUDGET
+
+        host_mode = (
+            (usage_chain is None or isinstance(usage_chain, np.ndarray))
+            and len(batch) * nt.n_rows * 64 <= HOST_ROW_STEP_BUDGET)
         # With a live chain the device usage array is dead weight: skip its
         # dirty-row flush (one blocking host->device RTT mid-storm) and
-        # refresh only capacity/readiness changes.
-        tables = nt.device_arrays(skip_usage=usage_chain is not None)
+        # refresh only capacity/readiness changes. A host-mode window skips
+        # the device refresh entirely — it never reads the device tables;
+        # an eval that upgrades to device mid-window fetches them lazily
+        # inside stack.dispatch.
+        tables = None if host_mode else nt.device_arrays(
+            skip_usage=usage_chain is not None)
         self.stats["t_refresh_ms"] += (time.perf_counter() - t0) * 1e3
 
         fast: List[_FastEval] = []
@@ -382,13 +399,16 @@ class PipelinedWorker(Worker):
             rec = None
             try:
                 rec = self._try_dispatch_fast(ev, token, snap, usage_chain,
-                                              node_cache, noise_vec, tables)
+                                              node_cache, noise_vec, tables,
+                                              host=host_mode)
             except Exception:
                 logger.exception("fast dispatch failed for eval %s", ev.ID)
             if rec is None:
                 slow.append((ev, token))
             else:
                 usage_chain = rec.res.usage_after
+                if host_mode and not isinstance(usage_chain, np.ndarray):
+                    host_mode = False  # eval upgraded to device mid-window
                 fast.append(rec)
 
         if fast:
@@ -445,7 +465,8 @@ class PipelinedWorker(Worker):
                            usage_chain,
                            node_cache: Dict[tuple, tuple],
                            noise_vec: Optional[np.ndarray] = None,
-                           tables: Optional[dict] = None
+                           tables: Optional[dict] = None,
+                           host: bool = False
                            ) -> Optional[_FastEval]:
         """Launch the eval's placement kernel chained on the window's usage,
         or return None to route it through the per-eval GenericScheduler."""
@@ -513,7 +534,14 @@ class PipelinedWorker(Worker):
         td3 = time.perf_counter()
         self.stats["t_prep_ms"] = self.stats.get("t_prep_ms", 0.0) \
             + (td3 - td2) * 1e3
-        res = stack.dispatch(prep, usage_override=usage_chain, tables=tables)
+        # A huge eval blows the host budget even alone; send it to the
+        # device (the rest of the window follows — see _dispatch_window).
+        if host and len(diff.place) <= 256:
+            res = stack.dispatch_host(prep, usage_override=usage_chain)
+            self.stats["host"] = self.stats.get("host", 0) + 1
+        else:
+            res = stack.dispatch(prep, usage_override=usage_chain,
+                                 tables=tables)
         self.stats["t_launch_ms"] = self.stats.get("t_launch_ms", 0.0) \
             + (time.perf_counter() - td3) * 1e3
         return _FastEval(ev=ev, token=token, plan=plan, ctx=ctx, stack=stack,
@@ -658,20 +686,47 @@ class PipelinedWorker(Worker):
         stack arity is padded to the configured window size (repeating the
         last element) so XLA compiles ONE stack program per packed shape,
         never one per distinct window fill level."""
+        # Host-placed results are already numpy — no readback, no RTT.
+        out: List[Optional[np.ndarray]] = [None] * len(results)
+        dev_idx: List[int] = []
+        for i, res in enumerate(results):
+            if isinstance(res.packed, np.ndarray):
+                out[i] = res.packed
+            else:
+                dev_idx.append(i)
+        if not dev_idx:
+            return out
         try:
             import jax.numpy as jnp
 
             by_shape: Dict[tuple, List[int]] = {}
-            for i, res in enumerate(results):
-                by_shape.setdefault(tuple(res.packed.shape), []).append(i)
-            out: List[Optional[np.ndarray]] = [None] * len(results)
+            for i in dev_idx:
+                by_shape.setdefault(tuple(results[i].packed.shape),
+                                    []).append(i)
+            stack_ms = fetch_ms = 0.0
             for idxs in by_shape.values():
                 group = [results[i].packed for i in idxs]
                 if len(group) < self.window:
                     group = group + [group[-1]] * (self.window - len(group))
-                stacked = np.asarray(jnp.stack(group))
+                # ONE host sync per shape group: stack dispatch is async;
+                # np.asarray is the only blocking point. On the axon
+                # tunnel every host sync costs a ~95ms round trip once a
+                # process has done its first device->host transfer, so
+                # inserting block_until_ready calls here would multiply
+                # the window's drain latency.
+                t2 = time.perf_counter()
+                stacked_dev = jnp.stack(group)
+                t3 = time.perf_counter()
+                stacked = np.asarray(stacked_dev)
+                t4 = time.perf_counter()
+                stack_ms += (t3 - t2) * 1e3
+                fetch_ms += (t4 - t3) * 1e3
                 for i, arr in zip(idxs, stacked):
                     out[i] = arr
+            self.stats["t_drain_stack_ms"] = self.stats.get(
+                "t_drain_stack_ms", 0.0) + stack_ms
+            self.stats["t_drain_fetch_ms"] = self.stats.get(
+                "t_drain_fetch_ms", 0.0) + fetch_ms
             return out
         except (ImportError, TypeError, AttributeError):
             # Non-jax packed arrays (already host-side, e.g. tests).

@@ -320,3 +320,64 @@ class TestPlacementQualityParity:
 
         assert len(tpu_scores) >= len(ref_results)
         assert tpu_total >= ref_total - 1e-3
+
+
+class TestHostKernelParity:
+    """place_batch_host is the numpy mirror used for shallow windows (a
+    device readback costs a fixed ~100ms sync on remote-attached TPUs);
+    its placements must match the device kernel exactly on the same
+    inputs (same f32 BestFit-v3 + Inf/NaN edges, same anti-affinity and
+    noise tie-break, same in-loop usage chaining)."""
+
+    def _inputs(self, seed, n=256, p=48, t=8):
+        import numpy.random as nr
+
+        rng = nr.default_rng(seed)
+        capacity = rng.uniform(100, 4000, (n, 8)).astype(np.float32)
+        usage = (capacity * rng.uniform(0, 0.9, (n, 8))).astype(np.float32)
+        score_cap = capacity[:, :2] * rng.uniform(
+            0.5, 1.0, (n, 2)).astype(np.float32)
+        tg_masks = rng.random((t, n)) < 0.7
+        job_counts = rng.integers(0, 3, n).astype(np.int32)
+        demands = rng.uniform(1, 500, (p, 8)).astype(np.float32)
+        tg_ids = rng.integers(0, t, p).astype(np.int32)
+        valid = rng.random(p) < 0.9
+        noise = (rng.random(n) * 1e-3).astype(np.float32)
+        banned = rng.random(n) < 0.05
+        return (capacity, score_cap, usage, tg_masks, job_counts, demands,
+                tg_ids, valid, noise, np.float32(10.0), True, banned)
+
+    @pytest.mark.parametrize("seed", [0, 7, 42])
+    def test_host_matches_device(self, seed):
+        import jax.numpy as jnp
+
+        from nomad_tpu.scheduler import kernels
+
+        args = self._inputs(seed)
+        dev = kernels.place_batch(*[jnp.asarray(a) for a in args])
+        host = kernels.place_batch_host(*args)
+        dev_packed = np.asarray(dev.packed)
+        # Same placement decisions row-for-row.
+        np.testing.assert_array_equal(dev_packed[:, 0], host.packed[:, 0])
+        np.testing.assert_array_equal(dev_packed[:, 2], host.packed[:, 2])
+        # Scores agree to f32 tolerance (TPU transcendental approximations
+        # may differ in the last ulps from host libm).
+        finite = np.isfinite(dev_packed[:, 1])
+        np.testing.assert_array_equal(finite, np.isfinite(host.packed[:, 1]))
+        np.testing.assert_allclose(dev_packed[finite, 1],
+                                   host.packed[finite, 1],
+                                   rtol=2e-5, atol=2e-5)
+        np.testing.assert_allclose(np.asarray(dev.usage_after),
+                                   host.usage_after, rtol=1e-5, atol=1e-3)
+
+    def test_distinct_hosts_off(self):
+        import jax.numpy as jnp
+
+        from nomad_tpu.scheduler import kernels
+
+        args = list(self._inputs(3))
+        args[10] = False  # distinct_hosts off: banned must be ignored
+        dev = kernels.place_batch(*[jnp.asarray(a) for a in args])
+        host = kernels.place_batch_host(*args)
+        np.testing.assert_array_equal(
+            np.asarray(dev.packed)[:, 0], host.packed[:, 0])

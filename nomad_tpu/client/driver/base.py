@@ -78,8 +78,121 @@ class DriverHandle:
         return None
 
 
+class ConfigField:
+    """One driver-config field: type + required (reference: the FieldSchema
+    entries in helper/fields/type.go)."""
+
+    __slots__ = ("type", "required")
+
+    def __init__(self, type: str, required: bool = False):
+        self.type = type
+        self.required = required
+
+
+def _field_type_ok(value: Any, ftype: str) -> bool:
+    """Weakly-typed like the reference's mapstructure decode
+    (helper/fields/decoder.go WeaklyTypedInput): HCL frontends hand over
+    strings for scalars, so "512" satisfies an int field."""
+    if ftype == "string":
+        return isinstance(value, (str, int, float, bool))
+    if ftype == "bool":
+        return isinstance(value, bool) or (
+            isinstance(value, str)
+            and value.lower() in ("true", "false", "1", "0"))
+    if ftype == "int":
+        if isinstance(value, bool):
+            return False
+        if isinstance(value, int):
+            return True
+        if isinstance(value, str):
+            try:
+                int(value)
+                return True
+            except ValueError:
+                return False
+        return False
+    if ftype == "float":
+        if isinstance(value, bool):
+            return False
+        if isinstance(value, (int, float)):
+            return True
+        if isinstance(value, str):
+            try:
+                float(value)
+                return True
+            except ValueError:
+                return False
+        return False
+    if ftype == "list":
+        return isinstance(value, (list, tuple))
+    if ftype == "map":
+        # HCL decodes `port_map { http = 80 }` as a list of one map.
+        return isinstance(value, dict) or (
+            isinstance(value, (list, tuple))
+            and all(isinstance(v, dict) for v in value))
+    if ftype == "duration":
+        return isinstance(value, (int, float, str))
+    return True
+
+
+def config_map(value: Any) -> Dict[str, Any]:
+    """Normalize a map-typed config value: HCL decodes a repeated block
+    (`port_map { http = 80 }`) as a list of dicts; merge them in order
+    (later blocks win), matching the reference's mapstructure decode."""
+    if value is None:
+        return {}
+    if isinstance(value, dict):
+        return dict(value)
+    out: Dict[str, Any] = {}
+    for part in value:
+        out.update(part)
+    return out
+
+
+def config_bool(value: Any, default: bool = False) -> bool:
+    """Coerce a weakly-typed bool config value the way validation accepts
+    it: the string \"false\" must mean False, not truthy-string True."""
+    if value is None:
+        return default
+    if isinstance(value, bool):
+        return value
+    if isinstance(value, str):
+        return value.lower() in ("1", "true")
+    return bool(value)
+
+
+class ConfigSchema:
+    """Mini field-schema for driver task configs (reference:
+    helper/fields/type.go FieldSchema maps, used by each driver's
+    Validate — e.g. client/driver/docker.go:116-140). Unknown keys are
+    REJECTED: a typo'd config key must fail job validation loudly instead
+    of silently no-opping at runtime."""
+
+    def __init__(self, **fields: ConfigField):
+        self.fields = fields
+
+    def validate(self, config: Dict[str, Any], driver: str = "") -> None:
+        errs = []
+        tag = f" for {driver} driver" if driver else ""
+        for key, f in self.fields.items():
+            if f.required and not config.get(key):
+                errs.append(f"missing required config key {key!r}{tag}")
+        for key, value in (config or {}).items():
+            f = self.fields.get(key)
+            if f is None:
+                errs.append(f"unknown config key {key!r}{tag}")
+            elif value is not None and not _field_type_ok(value, f.type):
+                errs.append(
+                    f"config key {key!r}{tag} must be a {f.type}")
+        if errs:
+            raise ValueError("; ".join(errs))
+
+
 class Driver:
     name = "base"
+    # Per-driver config schema; None skips schema validation (base class
+    # only — every real driver defines one).
+    schema: Optional[ConfigSchema] = None
 
     def __init__(self, ctx: DriverContext):
         self.ctx = ctx
@@ -89,7 +202,10 @@ class Driver:
         raise NotImplementedError
 
     def validate(self, config: Dict[str, Any]) -> None:
-        """Raise ValueError on invalid task config."""
+        """Raise ValueError on invalid task config (schema + any
+        driver-specific checks layered by subclasses)."""
+        if self.schema is not None:
+            self.schema.validate(config or {}, driver=self.name)
 
     def start(self, ctx: ExecContext, task: Task) -> DriverHandle:
         raise NotImplementedError

@@ -15,7 +15,8 @@ from typing import Any, Dict, Optional
 
 from nomad_tpu.structs import Node, Task
 
-from .base import Driver, DriverHandle, ExecContext, WaitResult
+from .base import (ConfigField, ConfigSchema, Driver, DriverHandle,
+                   ExecContext, WaitResult, config_map)
 
 
 def docker_conn_env(config) -> dict:
@@ -307,9 +308,17 @@ class DockerDriver(Driver):
         except Exception:
             return False
 
-    def validate(self, config: Dict[str, Any]) -> None:
-        if not config.get("image"):
-            raise ValueError("missing image for docker driver")
+    # (reference: client/driver/docker.go:116-140 Validate's fields map;
+    # keys limited to what this driver implements)
+    schema = ConfigSchema(
+        image=ConfigField("string", required=True),
+        command=ConfigField("string"),
+        args=ConfigField("list"),
+        port_map=ConfigField("map"),
+        auth=ConfigField("map"),
+        labels=ConfigField("map"),
+        network_mode=ConfigField("string"),
+    )
 
     def _options(self):
         cfg = self.ctx.config if self.ctx is not None else None
@@ -340,7 +349,8 @@ class DockerDriver(Driver):
                         "--cpu-shares", str(task.Resources.CPU)])
             for net in task.Resources.Networks:
                 for label, value in net.port_labels().items():
-                    guest = task.Config.get("port_map", {}).get(label, value)
+                    guest = config_map(
+                        task.Config.get("port_map")).get(label, value)
                     cmd.extend(["-p", f"{value}:{guest}"])
         for k, v in env.build_env().items():
             cmd.extend(["-e", f"{k}={v}"])
@@ -379,7 +389,7 @@ class DockerDriver(Driver):
         server_address}` becomes a per-task docker client config passed via
         --config (reference: docker.go:683+ authenticates pulls with
         per-task credentials)."""
-        auth = task.Config.get("auth")
+        auth = config_map(task.Config.get("auth"))
         if not auth:
             return ""
         import base64

@@ -14,14 +14,9 @@ from typing import Any, Dict
 
 from nomad_tpu.structs import Node, Task
 
-from .base import (
-    Driver,
-    DriverHandle,
-    ExecContext,
-    ExecutorHandle,
-    build_executor_spec,
-    launch_executor,
-)
+from .base import (ConfigField, ConfigSchema, Driver, DriverHandle,
+                   ExecContext, ExecutorHandle, build_executor_spec,
+                   config_bool, launch_executor)
 
 
 class ExecDriver(Driver):
@@ -40,9 +35,12 @@ class ExecDriver(Driver):
         node.Attributes["driver.exec"] = "1"
         return True
 
-    def validate(self, config: Dict[str, Any]) -> None:
-        if not config.get("command"):
-            raise ValueError("missing command for exec driver")
+    # (reference: client/driver/exec.go Validate's fields map)
+    schema = ConfigSchema(
+        command=ConfigField("string", required=True),
+        args=ConfigField("list"),
+        no_chroot=ConfigField("bool"),
+    )
 
     def start(self, ctx: ExecContext, task: Task) -> DriverHandle:
         self.validate(task.Config)
@@ -57,7 +55,7 @@ class ExecDriver(Driver):
         # operator escape hatches.
         if (os.geteuid() == 0
                 and os.environ.get("NOMAD_TPU_EXEC_CHROOT", "1") != "0"
-                and not task.Config.get("no_chroot")):
+                and not config_bool(task.Config.get("no_chroot"))):
             spec["chroot"] = ctx.alloc_dir.build_chroot(task.Name)
         return launch_executor(ctx.alloc_dir.task_dirs[task.Name],
                                task.Name, spec)

@@ -8,7 +8,8 @@ from typing import Any, Dict
 
 from nomad_tpu.structs import Node, Task
 
-from .base import (Driver, DriverHandle, ExecContext, ExecutorHandle,
+from .base import (ConfigField, ConfigSchema,
+                   Driver, DriverHandle, ExecContext, ExecutorHandle,
                    build_executor_spec, launch_executor)
 
 
@@ -32,9 +33,12 @@ class JavaDriver(Driver):
         node.Attributes["driver.java.runtime"] = version_line
         return True
 
-    def validate(self, config: Dict[str, Any]) -> None:
-        if not config.get("jar_path"):
-            raise ValueError("missing jar_path for java driver")
+    # (reference: client/driver/java.go Validate's fields map)
+    schema = ConfigSchema(
+        jar_path=ConfigField("string", required=True),
+        jvm_options=ConfigField("list"),
+        args=ConfigField("list"),
+    )
 
     def start(self, ctx: ExecContext, task: Task) -> DriverHandle:
         self.validate(task.Config)

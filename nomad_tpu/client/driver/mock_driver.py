@@ -8,7 +8,8 @@ from typing import Any, Dict, Optional
 
 from nomad_tpu.structs import Node, Task
 
-from .base import Driver, DriverHandle, ExecContext, WaitResult
+from .base import (ConfigField, ConfigSchema, Driver, DriverHandle,
+                   ExecContext, WaitResult)
 
 
 def _seconds(value: Any) -> float:
@@ -49,6 +50,14 @@ class MockHandle(DriverHandle):
 
 class MockDriver(Driver):
     name = "mock_driver"
+
+    # (reference: client/driver/mock_driver.go's config shape)
+    schema = ConfigSchema(
+        run_for=ConfigField("duration"),
+        exit_code=ConfigField("int"),
+        start_error=ConfigField("string"),
+        kill_after=ConfigField("duration"),
+    )
 
     def fingerprint(self, config, node: Node) -> bool:
         node.Attributes["driver.mock_driver"] = "1"

@@ -9,8 +9,9 @@ from typing import Any, Dict
 
 from nomad_tpu.structs import Node, Task
 
-from .base import (Driver, DriverHandle, ExecContext, ExecutorHandle,
-                   build_executor_spec, launch_executor)
+from .base import (ConfigField, ConfigSchema, Driver, DriverHandle,
+                   ExecContext, ExecutorHandle, build_executor_spec,
+                   config_map, launch_executor)
 
 
 class QemuDriver(Driver):
@@ -31,9 +32,12 @@ class QemuDriver(Driver):
         node.Attributes["driver.qemu.version"] = version
         return True
 
-    def validate(self, config: Dict[str, Any]) -> None:
-        if not config.get("image_path"):
-            raise ValueError("missing image_path for qemu driver")
+    # (reference: client/driver/qemu.go Validate's fields map)
+    schema = ConfigSchema(
+        image_path=ConfigField("string", required=True),
+        accelerator=ConfigField("string"),
+        port_map=ConfigField("map"),
+    )
 
     def start(self, ctx: ExecContext, task: Task) -> DriverHandle:
         self.validate(task.Config)
@@ -45,7 +49,7 @@ class QemuDriver(Driver):
                 f"nomad_{task.Name}", "-m", f"{mem}M", "-drive",
                 f"file={image}", "-nographic", "-nodefaults"]
         # Port forwards (reference: qemu.go port_map handling).
-        port_map = task.Config.get("port_map", {})
+        port_map = config_map(task.Config.get("port_map"))
         if port_map and task.Resources and task.Resources.Networks:
             net = task.Resources.Networks[0]
             forwards = []

@@ -13,14 +13,9 @@ from typing import Any, Dict
 
 from nomad_tpu.structs import Node, Task
 
-from .base import (
-    Driver,
-    DriverHandle,
-    ExecContext,
-    ExecutorHandle,
-    build_executor_spec,
-    launch_executor,
-)
+from .base import (ConfigField, ConfigSchema, Driver, DriverHandle,
+                   ExecContext, ExecutorHandle, build_executor_spec,
+                   launch_executor)
 
 
 class RawExecDriver(Driver):
@@ -37,9 +32,11 @@ class RawExecDriver(Driver):
         node.Attributes.pop("driver.raw_exec", None)
         return False
 
-    def validate(self, config: Dict[str, Any]) -> None:
-        if not config.get("command"):
-            raise ValueError("missing command for raw_exec driver")
+    # (reference: client/driver/raw_exec.go Validate's fields map)
+    schema = ConfigSchema(
+        command=ConfigField("string", required=True),
+        args=ConfigField("list"),
+    )
 
     def start(self, ctx: ExecContext, task: Task) -> DriverHandle:
         self.validate(task.Config)

@@ -139,6 +139,14 @@ class TaskRunner:
     def _prepare(self) -> bool:
         """Validate + fetch artifacts."""
         errs = self.task.validate()
+        # Driver config schema: reject typo'd/unknown keys BEFORE any
+        # artifact download or driver start (reference: TaskRunner
+        # validateTask -> driver.Validate, client/task_runner.go:143-169).
+        try:
+            new_driver(self.task.Driver,
+                       self._driver_ctx()).validate(self.task.Config or {})
+        except ValueError as e:
+            errs = list(errs) + [str(e)]
         if errs:
             event = TaskEvent.new("Failed Validation")
             event.ValidationError = "; ".join(errs)

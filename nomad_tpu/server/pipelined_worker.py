@@ -112,6 +112,8 @@ class _WindowWork:
     slow: List[Tuple[Evaluation, str]]
     packed: Optional[List[np.ndarray]] = None  # set by the drain stage
     failed: bool = False                       # drain blew up: nack window
+    chained: bool = False       # dispatched on a previous window's tail
+    taint_seq: int = 0          # _taint_seq observed at chain-read time
 
 
 # Force a pipeline drain + chain rebase after this many chained windows: the
@@ -171,6 +173,14 @@ class PipelinedWorker(Worker):
         self._chain = None
         self._chain_epoch = -1
         self._chained_windows = 0
+        # Phantom-usage taint (both guarded by _pending_lock): the build
+        # stage bumps _taint_seq and sets _chain_dirty when a window ends
+        # with stale/fallback records, whose chained kernel placements
+        # never commit. _chain_dirty makes the next DISPATCH rebase;
+        # _taint_seq lets windows already in flight on the tainted tail
+        # detect it at finish time and quarantine their failed placements.
+        self._chain_dirty = False
+        self._taint_seq = 0
         # Stage handoffs: dispatch -> drain -> build, one window queued per
         # seam. The drain stage spends its time in a device readback (GIL
         # released) while the build stage runs host Python — splitting them
@@ -275,7 +285,7 @@ class PipelinedWorker(Worker):
                 if work.failed:
                     raise RuntimeError("window drain failed")
                 if work.fast:
-                    self._finish_fast(work.fast, work.packed)
+                    self._finish_fast(work)
                 t0 = time.perf_counter()
                 for ev, token in work.slow:
                     self._process_slow(ev, token)
@@ -357,7 +367,14 @@ class PipelinedWorker(Worker):
         t0 = time.perf_counter()
 
         nt = self.tindex.nt
+        # Capture the taint sequence BEFORE reading the chain: a taint
+        # raised in between must surface as external at finish time (the
+        # false-positive direction — quarantining an untainted window's
+        # failed evals into exact-path re-runs — is safe).
+        with self._pending_lock:
+            taint_seq_at_dispatch = self._taint_seq
         usage_chain = self._usage_chain(nt)
+        chained_at_dispatch = usage_chain is not None
         # Shallow windows place HOST-SIDE (kernels.place_batch_host): on a
         # remote-attached TPU every host sync is a fixed ~100ms round trip,
         # so a near-idle broker's evals finish in single-digit ms as numpy
@@ -423,7 +440,14 @@ class PipelinedWorker(Worker):
         self.stats["windows"] += 1
         self.stats["slow"] += len(slow)
         self.stats["t_dispatch_ms"] += (time.perf_counter() - t0) * 1e3
-        return _WindowWork(fast=fast, slow=slow)
+        work = _WindowWork(fast=fast, slow=slow)
+        # Taint bookkeeping: a window dispatched on a previous window's
+        # tail inherits any phantom usage that tail turns out to carry;
+        # record the taint sequence seen NOW so _finish_fast can detect a
+        # taint raised while this window was in flight.
+        work.chained = chained_at_dispatch
+        work.taint_seq = taint_seq_at_dispatch
+        return work
 
     def quiesce(self, timeout: float = 30.0) -> bool:
         """Wait until every dispatched window has fully finished (drained,
@@ -438,6 +462,20 @@ class PipelinedWorker(Worker):
         (= committed usage from the table) after a rebase."""
         chain = self._chain
         self._dispatch_epoch = nt.row_epoch
+        with self._pending_lock:
+            # Atomic read+clear: an unguarded check-then-clear could erase
+            # a taint the build thread raised in between, leaving later
+            # windows chained on phantom usage with no quarantine.
+            dirty = self._chain_dirty
+            self._chain_dirty = False
+        if chain is not None and dirty:
+            # A finished window had stale/fallback records: their kernel
+            # placements are baked into this chain but will never commit
+            # as dispatched — phantom usage that squeezes later windows
+            # into spurious exhaustion. Wait out the in-flight windows and
+            # restart from committed state.
+            self._drained.wait(timeout=60.0)
+            chain = None
         if chain is not None and (chain.shape[0] != nt.n_rows
                                   or self._chain_epoch != nt.row_epoch):
             # Table resized OR a row changed identity (node removed / freed
@@ -547,10 +585,10 @@ class PipelinedWorker(Worker):
         return _FastEval(ev=ev, token=token, plan=plan, ctx=ctx, stack=stack,
                          prep=prep, place=diff.place, res=res)
 
-    def _finish_fast(self, fast: List[_FastEval],
-                     packed: List[np.ndarray]) -> None:
+    def _finish_fast(self, work: _WindowWork) -> None:
         """Build + submit plans, wait, batch status updates (packed results
         already drained by stage 2)."""
+        fast, packed = work.fast, work.packed
         t1 = time.perf_counter()
 
         # Build and enqueue plans back-to-back: the applier verifies plan i
@@ -610,25 +648,55 @@ class PipelinedWorker(Worker):
         self.stats["t_build_ms"] += (t2 - t1) * 1e3
 
         # Wait for the applier; anything not fully committed re-runs sync.
+        for rec in fast:
+            if rec.fallback or rec.stale or rec.pending is None:
+                continue
+            try:
+                # Raises on timeout or applier rejection (stale token):
+                # only THIS eval falls back, not the whole window.
+                result = rec.pending.wait(timeout=30.0)
+            except Exception:
+                logger.debug("plan for eval %s not committed; re-running"
+                             " per-eval", rec.ev.ID)
+                rec.fallback = True
+                continue
+            full_commit, _, _ = result.full_commit(rec.plan)
+            if not full_commit:
+                rec.fallback = True
+
+        # Phantom-usage quarantine: a stale/fallback record's kernel
+        # placements were baked into the window's device chain but never
+        # commit as dispatched. Any eval placed BEHIND that phantom usage
+        # that could not fully place must re-run on the exact path instead
+        # of emitting a spurious blocked eval (no capacity-change event
+        # would ever unblock it — the capacity was never really taken).
+        # Two taint sources: a stale/fallback record EARLIER in this
+        # window, and a taint raised by a previously-dispatched window
+        # while this one (chained on its tail) was in flight.
+        tainted_from = next((i for i, rec in enumerate(fast)
+                             if rec.stale or rec.fallback), None)
+        with self._pending_lock:
+            external_taint = (work.chained
+                              and self._taint_seq != work.taint_seq)
+            if tainted_from is not None:
+                # Windows in flight on OUR tail inherit the phantom too.
+                self._taint_seq += 1
+                self._chain_dirty = True
+        if tainted_from is not None or external_taint:
+            start = 0 if external_taint else tainted_from + 1
+            for rec in fast[start:]:
+                if (not rec.stale and not rec.fallback
+                        and rec.failed_tg_allocs):
+                    logger.debug(
+                        "eval %s failed placements behind phantom window "
+                        "usage; re-running per-eval", rec.ev.ID)
+                    rec.fallback = True
+
         eval_updates: List[Evaluation] = []
         done: List[_FastEval] = []
         for rec in fast:
             if rec.fallback or rec.stale:
                 continue
-            if rec.pending is not None:
-                try:
-                    # Raises on timeout or applier rejection (stale token):
-                    # only THIS eval falls back, not the whole window.
-                    result = rec.pending.wait(timeout=30.0)
-                except Exception:
-                    logger.debug("plan for eval %s not committed; re-running"
-                                 " per-eval", rec.ev.ID)
-                    rec.fallback = True
-                    continue
-                full_commit, _, _ = result.full_commit(rec.plan)
-                if not full_commit:
-                    rec.fallback = True
-                    continue
             eval_updates.extend(self._status_evals(rec))
             done.append(rec)
 

@@ -239,3 +239,62 @@ job "sleeper" {
         assert wait_for(lambda: all(
             a.ClientStatus in ("complete", "failed")
             for a in srv.state.allocs_by_job("sleeper")), timeout=30)
+
+
+class TestDriverConfigSchemas:
+    """Driver config maps validate against per-driver field schemas
+    (reference: helper/fields/type.go FieldSchema maps used by each
+    driver's Validate, e.g. client/driver/docker.go:116-140). Unknown
+    keys are rejected — a typo'd key must fail loudly, not silently
+    no-op at runtime."""
+
+    def _driver(self, name):
+        from nomad_tpu.client.driver import new_driver
+        from nomad_tpu.client.driver.base import DriverContext
+
+        return new_driver(name, DriverContext())
+
+    def test_unknown_key_rejected_per_driver(self):
+        import pytest as _pytest
+
+        cases = {
+            "docker": {"image": "redis", "imge_pull": True},
+            "exec": {"command": "/bin/true", "comand": "x"},
+            "raw_exec": {"command": "/bin/true", "arg": []},
+            "java": {"jar_path": "a.jar", "jvm_opts": []},
+            "qemu": {"image_path": "a.img", "portmap": {}},
+            "mock_driver": {"run_for": 1, "runfor": 2},
+        }
+        for name, cfg in cases.items():
+            with _pytest.raises(ValueError, match="unknown config key"):
+                self._driver(name).validate(cfg)
+
+    def test_required_keys_enforced(self):
+        import pytest as _pytest
+
+        for name, key in (("docker", "image"), ("exec", "command"),
+                          ("raw_exec", "command"), ("java", "jar_path"),
+                          ("qemu", "image_path")):
+            with _pytest.raises(ValueError, match=key):
+                self._driver(name).validate({})
+
+    def test_weak_typing_matches_hcl_decode(self):
+        # HCL frontends hand over strings for scalars and single-element
+        # lists of maps for blocks (reference: WeaklyTypedInput +
+        # port_map block decoding).
+        self._driver("docker").validate({
+            "image": "redis:3.2", "args": ["-p", "6379"],
+            "port_map": [{"db": 6379}], "network_mode": "host"})
+        self._driver("qemu").validate({
+            "image_path": "linux.img", "port_map": {"ssh": 22}})
+        self._driver("mock_driver").validate({
+            "run_for": "2s", "exit_code": "1"})
+
+    def test_type_mismatch_rejected(self):
+        import pytest as _pytest
+
+        with _pytest.raises(ValueError, match="must be a list"):
+            self._driver("exec").validate(
+                {"command": "/bin/true", "args": "not-a-list"})
+        with _pytest.raises(ValueError, match="must be a int"):
+            self._driver("mock_driver").validate({"exit_code": "NaN"})

@@ -212,3 +212,136 @@ class TestChainRebase:
                 "chain must rebase after a row identity change")
         finally:
             srv.shutdown()
+
+
+class TestStalePhantomUsage:
+    """A record that goes stale/fallback mid-window leaves its chained
+    kernel placements as PHANTOM usage: later evals of the window were
+    squeezed by capacity that never commits. The worker must re-run those
+    evals on the exact path (not park them as blocked evals that no
+    capacity event will ever unblock) and rebase the next window's chain.
+    (VERDICT r3 weak #4 / ADVICE r2 #3.)"""
+
+    def test_redelivered_eval_does_not_phantom_block_the_window(self):
+        from nomad_tpu.server.pipelined_worker import PipelinedWorker
+        from nomad_tpu.structs.structs import EvalStatusBlocked
+
+        srv = Server(ServerConfig(num_schedulers=0,
+                                  pipelined_scheduling=True,
+                                  scheduler_window=16))
+        srv.establish_leadership()
+        try:
+            node = mock.node()
+            node.Resources.CPU = 1000
+            node.Resources.MemoryMB = 4000
+            node.Reserved = None
+            srv.node_register(node)
+
+            # Two jobs that cannot BOTH fit: the chained window has B see
+            # A's (ultimately phantom) 600cpu placement.
+            job_a = simple_job(count=1, cpu=600, mem=100)
+            job_b = simple_job(count=1, cpu=600, mem=100)
+            eval_a, _, _ = srv.job_register(job_a)
+            eval_b, _, _ = srv.job_register(job_b)
+
+            w = PipelinedWorker(srv.raft, srv.eval_broker, srv.plan_queue,
+                                srv.blocked_evals, srv.tindex,
+                                ["service", "batch", "system"], window=16)
+            batch = w._dequeue_window()
+            assert {ev.ID for ev, _ in batch} == {eval_a, eval_b}
+            # Deterministic chain order: A first, then B.
+            batch.sort(key=lambda p: 0 if p[0].ID == eval_a else 1)
+            work = w._dispatch_window(batch)
+            assert work is not None and len(work.fast) == 2
+
+            # Redeliver A between dispatch and build (nack-timeout shape):
+            # its token is no longer outstanding, so the build stage must
+            # mark it stale at plan-enqueue.
+            rec_a = work.fast[0]
+            srv.eval_broker.nack(rec_a.ev.ID, rec_a.token)
+
+            work.packed = w._drain_window([rec.res for rec in work.fast])
+            w._finish_fast(work)
+
+            # A was abandoned (stale), not acked, not planned.
+            assert rec_a.stale
+            assert w.stats.get("stale", 0) == 1
+            # B must NOT be parked as a blocked eval on phantom usage: the
+            # node really has 1000 cpu free, so the exact-path re-run
+            # places it.
+            e_b = srv.state.eval_by_id(eval_b)
+            assert e_b is not None and e_b.Status == EvalStatusComplete
+            allocs_b = [a for a in srv.state.allocs_by_job(job_b.ID)
+                        if not a.terminal_status()]
+            assert len(allocs_b) == 1
+            assert not [e for e in srv.state.evals_by_job(job_b.ID)
+                        if e.Status == EvalStatusBlocked]
+            # The next window must rebase off committed state instead of
+            # inheriting A's phantom usage.
+            assert w._chain_dirty
+            assert w._usage_chain(srv.tindex.nt) is None
+        finally:
+            srv.shutdown()
+
+    def test_inflight_window_detects_taint_from_earlier_window(self):
+        """Pipelining keeps windows in flight: window 2 dispatches chained
+        on window 1's device tail BEFORE window 1's build discovers its
+        record went stale. Window 2 must detect the taint at finish time
+        (taint sequence) and re-run its squeezed evals instead of parking
+        them blocked."""
+        from nomad_tpu.server.pipelined_worker import PipelinedWorker
+        from nomad_tpu.structs.structs import EvalStatusBlocked
+
+        srv = Server(ServerConfig(num_schedulers=0,
+                                  pipelined_scheduling=True,
+                                  scheduler_window=16))
+        srv.establish_leadership()
+        try:
+            node = mock.node()
+            node.Resources.CPU = 1000
+            node.Resources.MemoryMB = 4000
+            node.Reserved = None
+            srv.node_register(node)
+
+            w = PipelinedWorker(srv.raft, srv.eval_broker, srv.plan_queue,
+                                srv.blocked_evals, srv.tindex,
+                                ["service", "batch", "system"], window=16)
+
+            job_a = simple_job(count=1, cpu=600, mem=100)
+            eval_a, _, _ = srv.job_register(job_a)
+            batch1 = w._dequeue_window()
+            work1 = w._dispatch_window(batch1)
+            assert work1 is not None and len(work1.fast) == 1
+            with w._pending_lock:   # what run() does per dispatched window
+                w._pending_windows += 1
+                w._drained.clear()
+
+            # Window 2 dispatches on window 1's (soon-phantom) tail.
+            job_b = simple_job(count=1, cpu=600, mem=100)
+            eval_b, _, _ = srv.job_register(job_b)
+            batch2 = w._dequeue_window()
+            work2 = w._dispatch_window(batch2)
+            assert work2 is not None and len(work2.fast) == 1
+            assert work2.chained
+            with w._pending_lock:
+                w._pending_windows += 1
+
+            # Window 1's record goes stale (redelivered) before its build.
+            rec_a = work1.fast[0]
+            srv.eval_broker.nack(rec_a.ev.ID, rec_a.token)
+            work1.packed = w._drain_window([r.res for r in work1.fast])
+            w._finish_fast(work1)
+            assert rec_a.stale
+
+            # Window 2 finishes AFTER the taint: its squeezed eval re-runs
+            # on the exact path and places for real.
+            work2.packed = w._drain_window([r.res for r in work2.fast])
+            w._finish_fast(work2)
+            e_b = srv.state.eval_by_id(eval_b)
+            assert e_b is not None and e_b.Status == EvalStatusComplete
+            assert len([a for a in srv.state.allocs_by_job(job_b.ID)
+                        if not a.terminal_status()]) == 1
+            assert not [e for e in srv.state.evals_by_job(job_b.ID)
+                        if e.Status == EvalStatusBlocked]
+        finally:
+            srv.shutdown()

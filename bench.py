@@ -389,6 +389,61 @@ def bench_cpu_served(nodes, n_evals, reps=3):
         srv.shutdown()
 
 
+def bench_placement_parity(n_evals=40):
+    """BASELINE's ratio is defined \"at identical placement quality\": the
+    same storm (identical node fleet, identical jobs) runs served through
+    the TPU engine and the reference CPU chain, and the committed
+    placements' bin-pack scores are compared. The TPU path's global argmax
+    must score AT LEAST as well as the reference's sampled max — a drop
+    beyond f32/noise tolerance means the fast path is trading placement
+    quality for throughput, and the bench fails loudly."""
+    from nomad_tpu.server import Server, ServerConfig
+
+    out = {}
+    for impl in ("tpu", "cpu-reference"):
+        nodes = build_nodes(1000)  # same seed => identical fleets
+        srv = Server(ServerConfig(num_schedulers=1,
+                                  pipelined_scheduling=impl == "tpu",
+                                  scheduler_impl=impl,
+                                  min_heartbeat_ttl=24 * 3600.0,
+                                  heartbeat_grace=24 * 3600.0))
+        srv.establish_leadership()
+        try:
+            for node in nodes:
+                srv.node_register(node)
+            run = _make_storm_runner(srv)
+            eval_ids = run(n_evals)
+            scores = []
+            placed = 0
+            for eid in eval_ids:
+                for a in srv.state.allocs_by_eval(eid):
+                    placed += 1
+                    s = ((a.Metrics.Scores or {}).get(
+                        f"{a.NodeID}.binpack")
+                        if a.Metrics is not None else None)
+                    if s is not None:
+                        scores.append(float(s))
+            out[impl] = {
+                "placed": placed,
+                "scored": len(scores),
+                "mean_score": round(float(np.mean(scores)), 5)
+                if scores else None,
+            }
+        finally:
+            srv.shutdown()
+    tpu, cpu = out["tpu"], out["cpu-reference"]
+    want = n_evals * PER_EVAL
+    delta = (round(tpu["mean_score"] - cpu["mean_score"], 5)
+             if tpu["mean_score"] is not None
+             and cpu["mean_score"] is not None else None)
+    # Noise tie-break adds <=1e-3 to TPU scores; everything else is f32.
+    ok = (tpu["placed"] == cpu["placed"] == want
+          and delta is not None and delta >= -2e-3)
+    return {"tpu": tpu, "cpu_reference": cpu,
+            "mean_score_delta": delta, "storm_placements": want,
+            "ok": bool(ok)}
+
+
 def main():
     nodes = build_nodes(N_NODES)
     n_evals = max(1, N_PLACEMENTS // PER_EVAL)
@@ -475,6 +530,8 @@ def main():
             "rep_rates": rep_rates,
         }
 
+    detail["placement_parity"] = (parity := bench_placement_parity())
+
     result = {
         "metric": f"end-to-end server evals/sec @{N_NODES} nodes x "
                   f"{N_PLACEMENTS} task-groups (register->broker->worker->"
@@ -487,6 +544,13 @@ def main():
         "detail": detail,
     }
     print(json.dumps(result))
+    if not parity["ok"]:
+        # Quality gate: the ratio above is only meaningful at >= reference
+        # placement quality. Fail AFTER emitting the JSON so the metric is
+        # still recorded alongside the failure.
+        sys.stderr.write(
+            f"PLACEMENT PARITY FAILED: {json.dumps(parity)}\n")
+        sys.exit(2)
 
 
 def _backend():

@@ -344,6 +344,11 @@ class DockerDriver(Driver):
         cmd.extend(["run", "-d",
                     "-v", f"{ctx.alloc_dir.shared_dir}:/alloc",
                     "-v", f"{task_dir}/local:/local"])
+        # (reference: docker.go createContainer's NetworkMode + Labels)
+        if task.Config.get("network_mode"):
+            cmd.extend(["--network", str(task.Config["network_mode"])])
+        for k, v in config_map(task.Config.get("labels")).items():
+            cmd.extend(["--label", f"{k}={v}"])
         if task.Resources is not None:
             cmd.extend(["--memory", f"{task.Resources.MemoryMB}m",
                         "--cpu-shares", str(task.Resources.CPU)])

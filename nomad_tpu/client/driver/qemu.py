@@ -45,7 +45,9 @@ class QemuDriver(Driver):
         task_dir = ctx.alloc_dir.task_dirs[task.Name]
         image = env.replace(str(task.Config["image_path"]))
         mem = task.Resources.MemoryMB if task.Resources else 512
-        args = ["-machine", "type=pc,accel=tcg", "-name",
+        # (reference: qemu.go's accelerator config, default tcg)
+        accel = str(task.Config.get("accelerator") or "tcg")
+        args = ["-machine", f"type=pc,accel={accel}", "-name",
                 f"nomad_{task.Name}", "-m", f"{mem}M", "-drive",
                 f"file={image}", "-nographic", "-nodefaults"]
         # Port forwards (reference: qemu.go port_map handling).

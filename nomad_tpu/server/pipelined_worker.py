@@ -154,9 +154,11 @@ def _prep_sig(job, place, batch: bool) -> Optional[tuple]:
 class PipelinedWorker(Worker):
     """Drop-in Worker with windowed device-chained placement."""
 
-    def __init__(self, *args, window: int = 32, **kwargs):
+    def __init__(self, *args, window: int = 32, host_placement: bool = True,
+                 **kwargs):
         super().__init__(*args, **kwargs)
         self.window = max(1, window)
+        self.host_placement = host_placement
         self._noise: Optional[np.ndarray] = None
         # Observability: how evals flowed (fast = device-chained window,
         # slow = per-eval GenericScheduler, fallback = fast dispatch that
@@ -291,6 +293,16 @@ class PipelinedWorker(Worker):
                     self._process_slow(ev, token)
                 self.stats["t_slow_ms"] += (time.perf_counter() - t0) * 1e3
             except Exception:
+                if work.fast:
+                    # None of this window's kernel placements will commit,
+                    # but they are baked into the usage chain: raise the
+                    # taint so in-flight windows quarantine their squeezed
+                    # evals and the next dispatch rebases — the same
+                    # phantom-usage hole as a stale record, via the
+                    # whole-window-failure source.
+                    with self._pending_lock:
+                        self._taint_seq += 1
+                        self._chain_dirty = True
                 if not (self._stop.is_set()
                         or not self.eval_broker.enabled()):
                     logger.exception("pipelined worker: window finish failed")
@@ -385,7 +397,8 @@ class PipelinedWorker(Worker):
         from nomad_tpu.scheduler.stack import HOST_ROW_STEP_BUDGET
 
         host_mode = (
-            (usage_chain is None or isinstance(usage_chain, np.ndarray))
+            self.host_placement
+            and (usage_chain is None or isinstance(usage_chain, np.ndarray))
             and len(batch) * nt.n_rows * 64 <= HOST_ROW_STEP_BUDGET)
         # With a live chain the device usage array is dead weight: skip its
         # dirty-row flush (one blocking host->device RTT mid-storm) and

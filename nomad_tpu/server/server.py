@@ -102,6 +102,11 @@ class ServerConfig:
     # Scheduling workers on follower servers, dequeuing/submitting over
     # leader RPC (reference: workers on every server, worker.go:101-130).
     distributed_workers: bool = True
+    # Host fast-path placement for shallow pipelined windows (numpy mirror
+    # of the device kernel — see scheduler/kernels.place_batch_host).
+    # False forces every fast-path window onto the device chain; the
+    # multichip dryrun uses that to prove the SPMD path compiles and runs.
+    host_placement: bool = True
     # Server-side coalescing of Node.UpdateAlloc: concurrent client RPCs
     # within this window share ONE raft entry / future (reference:
     # batchUpdateInterval + batchFuture, node_endpoint.go:530-593). At 10k
@@ -298,7 +303,9 @@ class Server:
                 w = PipelinedWorker(self.raft, self.eval_broker,
                                     self.plan_queue, self.blocked_evals,
                                     self.tindex, schedulers,
-                                    window=self.config.scheduler_window)
+                                    window=self.config.scheduler_window,
+                                    host_placement=self.config
+                                    .host_placement)
             else:
                 w = Worker(self.raft, self.eval_broker, self.plan_queue,
                            self.blocked_evals, self.tindex, schedulers)

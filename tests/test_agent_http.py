@@ -14,7 +14,6 @@ from nomad_tpu.structs.structs import SECOND, MINUTE
 
 from helpers import wait_for  # noqa: E402
 
-pytestmark = pytest.mark.timing_retry  # networked cluster suite: one retry
 
 @pytest.fixture(scope="module")
 def dev_agent(tmp_path_factory):

@@ -26,7 +26,6 @@ from nomad_tpu.structs.structs import (
 
 from helpers import wait_for  # noqa: E402
 
-pytestmark = pytest.mark.timing_retry  # networked cluster suite: one retry
 
 class TestFingerprint:
     def test_basics(self):

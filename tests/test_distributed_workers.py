@@ -18,7 +18,6 @@ from nomad_tpu.structs.structs import EvalStatusComplete
 
 from helpers import wait_for  # noqa: E402
 
-pytestmark = pytest.mark.timing_retry  # networked cluster suite: one retry
 
 FAST = RaftConfig(heartbeat_interval=0.02, election_timeout_min=0.08,
                   election_timeout_max=0.16, apply_timeout=5.0)

@@ -68,24 +68,23 @@ def _score(usage2: jax.Array, score_cap: jax.Array) -> jax.Array:
     return jnp.nan_to_num(score, nan=0.0, posinf=18.0, neginf=0.0)
 
 
-@functools.partial(jax.jit, donate_argnums=())
-def place_batch(
-    capacity: jax.Array,    # [N, R] total resources (fit bound)
-    score_cap: jax.Array,   # [N, 2] cpu/mem minus reserved (score denominator)
-    usage: jax.Array,       # [N, R] reserved + committed allocs (+/- plan deltas)
-    tg_masks: jax.Array,    # [T, N] bool per task group: ready & dc & class & escaped
-    job_counts: jax.Array,  # [N] int32 proposed allocs of this job per node
-    demands: jax.Array,     # [P, R] per-placement resource ask
-    tg_ids: jax.Array,      # [P] int32 task-group index into tg_masks
-    valid: jax.Array,       # [P] bool: real placement vs padding
-    noise: jax.Array,       # [N] f32 tie-break jitter in [0, 1e-3)
-    penalty: jax.Array,     # f32 job anti-affinity penalty (10 service / 5 batch)
-    distinct_hosts: jax.Array,  # bool: job has a distinct_hosts constraint
-    banned0: jax.Array,     # [N] bool: nodes already holding this job's allocs
-) -> PlacementResult:
+def _make_step(capacity, score_cap, tg_masks, noise, penalty,
+               distinct_hosts, job_counts0=None, banned0=None):
+    """The ONE definition of the per-placement scan step (fused
+    feasibility mask + BestFit-v3 score + argmax + in-register state
+    updates). place_batch uses the plain (demand, tg_id, valid) input
+    tuple; place_batch_multi adds a reset flag that reloads the per-JOB
+    state (anti-affinity counts, distinct-hosts bans) at eval boundaries.
+    Sharing the body keeps single/multi/chained parity by construction."""
+
     def step(carry, inputs):
         usage, job_counts, banned = carry
-        demand, tg_id, is_valid = inputs
+        if len(inputs) == 4:
+            demand, tg_id, is_valid, is_reset = inputs
+            job_counts = jnp.where(is_reset, job_counts0, job_counts)
+            banned = jnp.where(is_reset, banned0, banned)
+        else:
+            demand, tg_id, is_valid = inputs
         eligible = tg_masks[tg_id]
 
         fits = jnp.all(capacity - usage >= demand[None, :], axis=1)
@@ -111,8 +110,65 @@ def place_batch(
         ])
         return (usage, job_counts, banned), out
 
+    return step
+
+
+@functools.partial(jax.jit, donate_argnums=())
+def place_batch(
+    capacity: jax.Array,    # [N, R] total resources (fit bound)
+    score_cap: jax.Array,   # [N, 2] cpu/mem minus reserved (score denominator)
+    usage: jax.Array,       # [N, R] reserved + committed allocs (+/- plan deltas)
+    tg_masks: jax.Array,    # [T, N] bool per task group: ready & dc & class & escaped
+    job_counts: jax.Array,  # [N] int32 proposed allocs of this job per node
+    demands: jax.Array,     # [P, R] per-placement resource ask
+    tg_ids: jax.Array,      # [P] int32 task-group index into tg_masks
+    valid: jax.Array,       # [P] bool: real placement vs padding
+    noise: jax.Array,       # [N] f32 tie-break jitter in [0, 1e-3)
+    penalty: jax.Array,     # f32 job anti-affinity penalty (10 service / 5 batch)
+    distinct_hosts: jax.Array,  # bool: job has a distinct_hosts constraint
+    banned0: jax.Array,     # [N] bool: nodes already holding this job's allocs
+) -> PlacementResult:
+    step = _make_step(capacity, score_cap, tg_masks, noise, penalty,
+                      distinct_hosts)
     (usage, _, _), packed = jax.lax.scan(
         step, (usage, job_counts, banned0), (demands, tg_ids, valid))
+    return PlacementResult(packed, usage)
+
+
+@functools.partial(jax.jit, donate_argnums=())
+def place_batch_multi(
+    capacity: jax.Array,    # [N, R]
+    score_cap: jax.Array,   # [N, 2]
+    usage: jax.Array,       # [N, R] chain input (window-sequential)
+    tg_masks: jax.Array,    # [T, N] shared across the window's evals
+    job_counts0: jax.Array,  # [N] per-eval anti-affinity base (shared)
+    demands: jax.Array,     # [E*P, R] all evals' placements, concatenated
+    tg_ids: jax.Array,      # [E*P]
+    valid: jax.Array,       # [E*P]
+    noise: jax.Array,       # [N]
+    penalty: jax.Array,     # f32
+    distinct_hosts: jax.Array,  # bool (shared job shape)
+    banned0: jax.Array,     # [N] per-eval distinct-hosts base (shared)
+    reset: jax.Array,       # [E*P] bool: True at each eval's first step
+) -> PlacementResult:
+    """One scan over a WHOLE WINDOW of same-shaped evaluations.
+
+    A registration storm's window is N near-identical evals whose prepared
+    inputs dedupe to one PreparedBatch; dispatching place_batch per eval
+    pays a host->device launch per eval plus an eager jnp.stack over the
+    window at drain (both scale with window size and dominate on a
+    remote-attached TPU). This kernel concatenates the placements and
+    resets the per-JOB state (anti-affinity counts, distinct-hosts bans)
+    at each eval boundary, so the whole window is ONE dispatch and ONE
+    readback while usage chains exactly as the per-eval kernels did
+    (reference sequencing semantics: scheduler/context.go:109-140 within
+    an eval; optimistic worker chaining across evals)."""
+    step = _make_step(capacity, score_cap, tg_masks, noise, penalty,
+                      distinct_hosts, job_counts0=job_counts0,
+                      banned0=banned0)
+    (usage, _, _), packed = jax.lax.scan(
+        step, (usage, job_counts0, banned0),
+        (demands, tg_ids, valid, reset))
     return PlacementResult(packed, usage)
 
 

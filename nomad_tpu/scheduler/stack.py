@@ -166,6 +166,33 @@ def fit_lacking(cap: np.ndarray, usage: np.ndarray,
             < demand.astype(np.float64))
 
 
+def _mesh_shardings(nt):
+    """(node_sh, mask_sh, rep_sh) for the table's serving mesh, or Nones
+    for single-device serving. Shared by every kernel launch path so the
+    fused and per-eval launches can never diverge on sharding."""
+    mesh = nt.mesh
+    if mesh is None:
+        return None, None, None
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    axis = mesh.axis_names[0]
+    return (NamedSharding(mesh, P(axis)),
+            NamedSharding(mesh, P(None, axis)),
+            NamedSharding(mesh, P()))
+
+
+def _chain_to_device(usage, node_sh):
+    """Rejoin the device chain after a host-placed window: one async
+    host->device upload (uploads don't pay the sync RTT readbacks do)."""
+    if not isinstance(usage, np.ndarray):
+        return usage
+    import jax
+    import jax.numpy as jnp
+
+    return jnp.asarray(usage) if node_sh is None else \
+        jax.device_put(usage, node_sh)
+
+
 def make_noise_vec(n_rows: int, rng: random.Random) -> np.ndarray:
     """Per-node tie-break jitter (the load-spreading analogue of the
     reference's node shuffle, stack.go:120-133)."""
@@ -358,32 +385,15 @@ class GenericStack:
         previous eval's usage_after array device-side; tables lets a windowed
         caller fetch the node table's device arrays ONCE per window instead of
         paying the dirty-row refresh per eval."""
-        import jax.numpy as jnp
-
         nt = self.tindex.nt
         d = tables if tables is not None else nt.device_arrays()
         # Mesh serving: node-axis inputs shard over the mesh like the table
         # arrays; per-placement inputs replicate. XLA's SPMD partitioner
         # turns the same place_batch program into the multi-chip version
         # (global argmax/sum become ICI collectives).
-        mesh = nt.mesh
-        node_sh = mask_sh = rep_sh = None
-        if mesh is not None:
-            from jax.sharding import NamedSharding, PartitionSpec as P
-
-            axis = mesh.axis_names[0]
-            node_sh = NamedSharding(mesh, P(axis))
-            mask_sh = NamedSharding(mesh, P(None, axis))
-            rep_sh = NamedSharding(mesh, P())
+        node_sh, mask_sh, rep_sh = _mesh_shardings(nt)
         usage = usage_override if usage_override is not None else d["usage"]
-        if isinstance(usage, np.ndarray):
-            # Chain handoff from a host-placed window: one async host->
-            # device upload rejoins the device chain (uploads don't pay
-            # the sync RTT that readbacks do).
-            import jax
-
-            usage = jnp.asarray(usage) if node_sh is None else \
-                jax.device_put(usage, node_sh)
+        usage = _chain_to_device(usage, node_sh)
         if len(prep.evict_rows):
             usage = usage.at[prep.evict_rows].add(-prep.evict_vecs)
         if placed_usage is not None and placed_usage.any():
@@ -432,6 +442,51 @@ class GenericStack:
         if pristine:
             prep.dev_inputs = dev
         return kernels.place_batch(d["capacity"], d["score_cap"], usage, *dev)
+
+    def dispatch_multi(self, prep: PreparedBatch, n_evals: int,
+                       usage_override=None, tables: Optional[dict] = None):
+        """Launch ONE kernel for n_evals same-shaped evaluations sharing
+        this PreparedBatch (a storm window after prep dedup): placements
+        are concatenated with per-eval resets of the job-local state, so
+        the window costs one host->device dispatch and one readback
+        instead of one per eval (see kernels.place_batch_multi). Only
+        legal for the pristine shared-prep case: no prior allocs, no
+        overlays (the fast path's _prep_sig guarantees this).
+
+        Returns (result, e_pad): result.packed is [e_pad * p_pad, 3];
+        caller slices per eval. The eval axis pads to a power of two so
+        jit compiles one program per bucket, not per window fill."""
+        nt = self.tindex.nt
+        d = tables if tables is not None else nt.device_arrays()
+        node_sh, mask_sh, rep_sh = _mesh_shardings(nt)
+        usage = usage_override if usage_override is not None else d["usage"]
+        usage = _chain_to_device(usage, node_sh)
+
+        e_pad = _pad_pow2(n_evals, floor=4)
+        p = prep.p_pad
+        # Tiled per-placement inputs: byte-identical across a storm's
+        # windows, so the content-addressed cache uploads them once.
+        demands = np.tile(prep.demands, (e_pad, 1))
+        tg_ids = np.tile(prep.tg_ids, e_pad)
+        valid = np.tile(prep.valid, e_pad)
+        valid[n_evals * p:] = False  # padding evals place nothing
+        reset = np.zeros(e_pad * p, dtype=bool)
+        reset[::p] = True
+        hosts = np.zeros(nt.n_rows, dtype=bool)
+
+        dev = (_dev_cache.get(prep.tg_masks, mask_sh),
+               _dev_cache.get(prep.job_counts, node_sh),
+               _dev_cache.get(demands, rep_sh),
+               _dev_cache.get(tg_ids, rep_sh),
+               _dev_cache.get(valid, rep_sh),
+               _dev_cache.get(prep.noise_vec, node_sh),
+               _dev_cache.get(np.float32(prep.penalty), rep_sh),
+               _dev_cache.get(np.asarray(prep.distinct), rep_sh),
+               _dev_cache.get(hosts, node_sh),
+               _dev_cache.get(reset, rep_sh))
+        res = kernels.place_batch_multi(d["capacity"], d["score_cap"],
+                                        usage, *dev)
+        return res, e_pad
 
     def dispatch_host(self, prep: PreparedBatch, usage_override=None,
                       banned: Optional[np.ndarray] = None,

@@ -102,6 +102,28 @@ class _FastEval:
     pending: object = None        # PendingPlan once enqueued
     fallback: bool = False
     stale: bool = False           # redelivered mid-window: abandoned
+    shareable: bool = False       # prep eligible for place_batch_multi
+
+
+class _MultiSlice:
+    """View of one eval's rows inside a place_batch_multi result. The
+    drain stage fetches the PARENT's packed array once for the whole
+    window and slices host-side."""
+
+    __slots__ = ("parent", "index", "p_pad")
+
+    def __init__(self, parent, index: int, p_pad: int):
+        self.parent = parent
+        self.index = index
+        self.p_pad = p_pad
+
+    @property
+    def packed(self):  # device-side; drain special-cases the fetch
+        return self.parent.packed
+
+    @property
+    def usage_after(self):
+        return self.parent.usage_after
 
 
 @dataclass
@@ -436,10 +458,56 @@ class PipelinedWorker(Worker):
             if rec is None:
                 slow.append((ev, token))
             else:
-                usage_chain = rec.res.usage_after
-                if host_mode and not isinstance(usage_chain, np.ndarray):
-                    host_mode = False  # eval upgraded to device mid-window
+                if rec.res is not None:  # host path launched inline
+                    usage_chain = rec.res.usage_after
                 fast.append(rec)
+
+        # Launch the deferred device recs in window order, fusing each
+        # consecutive run of SHARED-prep evals into one place_batch_multi
+        # call: a storm window then costs ONE kernel dispatch and (at
+        # drain) ONE readback, instead of per-eval launches plus an eager
+        # window-wide stack — both of which scale with window size on the
+        # dispatch-RTT-bound tunnel. Usage chains through the deferred recs
+        # in their relative order; in a mixed host/device window the device
+        # recs chain after the host-placed ones (a pure reorder — each eval
+        # still sees every placement dispatched before its own).
+        tl0 = time.perf_counter()
+        i = 0
+        pend = [r for r in fast if r.res is None]
+        while i < len(pend):
+            rec = pend[i]
+            j = i + 1
+            if rec.shareable:
+                while (j < len(pend) and pend[j].shareable
+                       and pend[j].prep is rec.prep):
+                    j += 1
+            run = pend[i:j]
+            try:
+                if len(run) >= 2:
+                    if tables is None:
+                        tables = nt.device_arrays(
+                            skip_usage=usage_chain is not None)
+                    res, _ = rec.stack.dispatch_multi(
+                        rec.prep, len(run), usage_override=usage_chain,
+                        tables=tables)
+                    for k, r in enumerate(run):
+                        r.res = _MultiSlice(res, k, rec.prep.p_pad)
+                    usage_chain = res.usage_after
+                    self.stats["multi"] = self.stats.get("multi", 0) + 1
+                else:
+                    rec.res = rec.stack.dispatch(
+                        rec.prep, usage_override=usage_chain, tables=tables)
+                    usage_chain = rec.res.usage_after
+            except Exception:
+                logger.exception("window launch failed; routing %d evals "
+                                 "to the exact path", len(run))
+                for r in run:
+                    r.fallback = True
+                    fast.remove(r)
+                    slow.append((r.ev, r.token))
+            i = j
+        self.stats["t_launch_ms"] = self.stats.get("t_launch_ms", 0.0) \
+            + (time.perf_counter() - tl0) * 1e3
 
         if fast:
             # Next window chains on this one's device-side usage tail even
@@ -585,18 +653,27 @@ class PipelinedWorker(Worker):
         td3 = time.perf_counter()
         self.stats["t_prep_ms"] = self.stats.get("t_prep_ms", 0.0) \
             + (td3 - td2) * 1e3
-        # A huge eval blows the host budget even alone; send it to the
-        # device (the rest of the window follows — see _dispatch_window).
+        # A huge eval blows the host budget even alone; it goes to the
+        # device instead. Its launch is deferred like any device rec, so
+        # within a host-mode window it chains AFTER the host-placed evals
+        # (a pure reorder — every eval still sees a usage state containing
+        # all placements committed before its own).
         if host and len(diff.place) <= 256:
             res = stack.dispatch_host(prep, usage_override=usage_chain)
             self.stats["host"] = self.stats.get("host", 0) + 1
         else:
-            res = stack.dispatch(prep, usage_override=usage_chain,
-                                 tables=tables)
+            # Device launch is DEFERRED: the window loop groups
+            # consecutive shared-prep recs into one place_batch_multi
+            # dispatch (a storm window = one kernel, not one per eval).
+            res = None
         self.stats["t_launch_ms"] = self.stats.get("t_launch_ms", 0.0) \
             + (time.perf_counter() - td3) * 1e3
+        # shareable: prep came from (or went into) the window prep cache,
+        # which only holds value-identical jobs with NO prior allocs —
+        # exactly the precondition for the multi kernel's per-eval resets.
         return _FastEval(ev=ev, token=token, plan=plan, ctx=ctx, stack=stack,
-                         prep=prep, place=diff.place, res=res)
+                         prep=prep, place=diff.place, res=res,
+                         shareable=sig is not None)
 
     def _finish_fast(self, work: _WindowWork) -> None:
         """Build + submit plans, wait, batch status updates (packed results
@@ -770,48 +847,72 @@ class PipelinedWorker(Worker):
         # Host-placed results are already numpy — no readback, no RTT.
         out: List[Optional[np.ndarray]] = [None] * len(results)
         dev_idx: List[int] = []
+        multi: Dict[int, List[int]] = {}
+        parents: Dict[int, object] = {}
         for i, res in enumerate(results):
-            if isinstance(res.packed, np.ndarray):
+            if isinstance(res, _MultiSlice):
+                multi.setdefault(id(res.parent), []).append(i)
+                parents[id(res.parent)] = res.parent
+            elif isinstance(res.packed, np.ndarray):
                 out[i] = res.packed
             else:
                 dev_idx.append(i)
-        if not dev_idx:
+        if not dev_idx and not multi:
             return out
         try:
+            import jax
             import jax.numpy as jnp
 
+            # ONE blocking device->host call for the whole window, however
+            # it mixes multi-kernel parents and per-eval results: stacks
+            # are dispatched async and everything comes home in a single
+            # jax.device_get. Every separate host sync costs a ~95ms round
+            # trip on the axon tunnel, so the drain must never pay more
+            # than one.
+            t2 = time.perf_counter()
+            fetches: Dict[object, object] = {}
+            for pid in multi:
+                fetches[("multi", pid)] = parents[pid].packed
             by_shape: Dict[tuple, List[int]] = {}
             for i in dev_idx:
                 by_shape.setdefault(tuple(results[i].packed.shape),
                                     []).append(i)
-            stack_ms = fetch_ms = 0.0
-            for idxs in by_shape.values():
+            for shape, idxs in by_shape.items():
                 group = [results[i].packed for i in idxs]
                 if len(group) < self.window:
                     group = group + [group[-1]] * (self.window - len(group))
-                # ONE host sync per shape group: stack dispatch is async;
-                # np.asarray is the only blocking point. On the axon
-                # tunnel every host sync costs a ~95ms round trip once a
-                # process has done its first device->host transfer, so
-                # inserting block_until_ready calls here would multiply
-                # the window's drain latency.
-                t2 = time.perf_counter()
-                stacked_dev = jnp.stack(group)
-                t3 = time.perf_counter()
-                stacked = np.asarray(stacked_dev)
-                t4 = time.perf_counter()
-                stack_ms += (t3 - t2) * 1e3
-                fetch_ms += (t4 - t3) * 1e3
+                fetches[("stack", shape)] = jnp.stack(group)
+            t3 = time.perf_counter()
+            fetched = jax.device_get(fetches)
+            t4 = time.perf_counter()
+            self.stats["t_drain_stack_ms"] = self.stats.get(
+                "t_drain_stack_ms", 0.0) + (t3 - t2) * 1e3
+            self.stats["t_drain_fetch_ms"] = self.stats.get(
+                "t_drain_fetch_ms", 0.0) + (t4 - t3) * 1e3
+            for pid, idxs in multi.items():
+                arr = fetched[("multi", pid)]
+                for i in idxs:
+                    sl = results[i]
+                    out[i] = arr[sl.index * sl.p_pad:
+                                 (sl.index + 1) * sl.p_pad]
+            for shape, idxs in by_shape.items():
+                stacked = fetched[("stack", shape)]
                 for i, arr in zip(idxs, stacked):
                     out[i] = arr
-            self.stats["t_drain_stack_ms"] = self.stats.get(
-                "t_drain_stack_ms", 0.0) + stack_ms
-            self.stats["t_drain_fetch_ms"] = self.stats.get(
-                "t_drain_fetch_ms", 0.0) + fetch_ms
             return out
         except (ImportError, TypeError, AttributeError):
-            # Non-jax packed arrays (already host-side, e.g. tests).
-            return [np.asarray(res.packed) for res in results]
+            # Non-jax packed arrays (already host-side, e.g. tests). Keep
+            # the already-resolved host entries; _MultiSlice parents are
+            # sliced per eval (the parent's packed holds the WHOLE run).
+            for pid, idxs in multi.items():
+                arr = np.asarray(parents[pid].packed)
+                for i in idxs:
+                    sl = results[i]
+                    out[i] = arr[sl.index * sl.p_pad:
+                                 (sl.index + 1) * sl.p_pad]
+            return [out[i] if out[i] is not None
+                    else np.asarray(results[i].packed)
+                    for i in range(len(results))]
 
     # ------------------------------------------------------------- slow path
     def _process_slow(self, ev: Evaluation, token: str) -> None:

@@ -291,9 +291,11 @@ class PlanApplier:
         self._retired: List[threading.Thread] = []
         self._pool_size = pool_size or max(1, (os.cpu_count() or 2) // 2)
         self._pool: Optional[ThreadPoolExecutor] = None
-        # Counters for telemetry/tests.
+        # Counters for telemetry/tests (t_* wall-clock; under GIL
+        # contention these overcount serialized python, like the worker's).
         self.stats = {"applied": 0, "rejected": 0, "overlapped": 0,
-                      "apply_failed": 0}
+                      "apply_failed": 0, "t_verify_ms": 0.0,
+                      "t_apply_ms": 0.0}
 
     def _nt(self):
         return self.tindex.nt if self.tindex is not None else None
@@ -427,6 +429,7 @@ class PlanApplier:
         results respond immediately; rejected plans were answered by
         _verify."""
         group: List[Tuple[PendingPlan, PlanResult]] = []
+        tv0 = time.perf_counter()
         for pending in batch:
             result = self._verify(pending, opt,
                                   overlapped=overlapped or bool(group))
@@ -437,6 +440,7 @@ class PlanApplier:
                 continue
             opt.apply_result(result)
             group.append((pending, result))
+        self.stats["t_verify_ms"] += (time.perf_counter() - tv0) * 1e3
         return group
 
     def _verify(self, pending: PendingPlan, opt: OptimisticSnapshot,
@@ -467,6 +471,7 @@ class PlanApplier:
         """Commit a verified group as ONE consensus entry, then answer every
         waiting worker. All plans of the group share the entry's index."""
         try:
+            ta0 = time.perf_counter()
             with metrics.measure(("nomad", "plan", "apply")):
                 if len(group) == 1:
                     pending, result = group[0]
@@ -477,6 +482,7 @@ class PlanApplier:
                                    "Alloc": _result_allocs(result)}
                                   for pending, result in group],
                     })
+            self.stats["t_apply_ms"] += (time.perf_counter() - ta0) * 1e3
             for pending, result in group:
                 result.AllocIndex = index
                 self.stats["applied"] += 1

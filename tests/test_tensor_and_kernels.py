@@ -381,3 +381,61 @@ class TestHostKernelParity:
         host = kernels.place_batch_host(*args)
         np.testing.assert_array_equal(
             np.asarray(dev.packed)[:, 0], host.packed[:, 0])
+
+
+class TestMultiKernelParity:
+    """place_batch_multi fuses a window of same-shaped evals into one scan
+    with per-eval resets of the job-local state; its placements must be
+    IDENTICAL to dispatching place_batch per eval chained on usage."""
+
+    def test_multi_matches_sequential_chain(self):
+        import jax
+        import jax.numpy as jnp
+
+        from nomad_tpu.scheduler import kernels
+
+        rng = np.random.default_rng(11)
+        n, p, t, evals = 256, 16, 3, 5
+        capacity = rng.uniform(500, 3000, (n, 8)).astype(np.float32)
+        score_cap = capacity[:, :2].copy()
+        usage0 = (capacity * rng.uniform(0, 0.5, (n, 8))).astype(np.float32)
+        tg_masks = rng.random((t, n)) < 0.8
+        jc0 = np.zeros(n, np.int32)
+        demands = rng.uniform(1, 200, (p, 8)).astype(np.float32)
+        tg_ids = rng.integers(0, t, p).astype(np.int32)
+        valid = np.ones(p, bool)
+        noise = (rng.random(n) * 1e-3).astype(np.float32)
+        banned0 = np.zeros(n, bool)
+
+        # Sequential per-eval chain.
+        usage = jnp.asarray(usage0)
+        seq_packed = []
+        for _ in range(evals):
+            res = kernels.place_batch(
+                jnp.asarray(capacity), jnp.asarray(score_cap), usage,
+                jnp.asarray(tg_masks), jnp.asarray(jc0),
+                jnp.asarray(demands), jnp.asarray(tg_ids),
+                jnp.asarray(valid), jnp.asarray(noise), jnp.float32(10.0),
+                jnp.asarray(True), jnp.asarray(banned0))
+            seq_packed.append(np.asarray(res.packed))
+            usage = res.usage_after
+        seq_usage = np.asarray(usage)
+
+        # One multi kernel over the same five evals.
+        reset = np.zeros(evals * p, bool)
+        reset[::p] = True
+        multi = kernels.place_batch_multi(
+            jnp.asarray(capacity), jnp.asarray(score_cap),
+            jnp.asarray(usage0), jnp.asarray(tg_masks), jnp.asarray(jc0),
+            jnp.asarray(np.tile(demands, (evals, 1))),
+            jnp.asarray(np.tile(tg_ids, evals)),
+            jnp.asarray(np.tile(valid, evals)), jnp.asarray(noise),
+            jnp.float32(10.0), jnp.asarray(True), jnp.asarray(banned0),
+            jnp.asarray(reset))
+        multi_packed = np.asarray(multi.packed)
+        for e in range(evals):
+            np.testing.assert_array_equal(
+                multi_packed[e * p:(e + 1) * p], seq_packed[e],
+                err_msg=f"eval {e} diverged")
+        np.testing.assert_allclose(np.asarray(multi.usage_after),
+                                   seq_usage, rtol=1e-6, atol=1e-3)

@@ -467,13 +467,18 @@ class PipelinedWorker(Worker):
         # call: a storm window then costs ONE kernel dispatch and (at
         # drain) ONE readback, instead of per-eval launches plus an eager
         # window-wide stack — both of which scale with window size on the
-        # dispatch-RTT-bound tunnel. Usage chains through the deferred recs
-        # in their relative order; in a mixed host/device window the device
-        # recs chain after the host-placed ones (a pure reorder — each eval
-        # still sees every placement dispatched before its own).
+        # dispatch-RTT-bound tunnel. Deferred recs are stably grouped by
+        # prep identity first — an interleaved A,B,A,B window fuses into
+        # two runs. Reordering within a window is safe: any sequential
+        # order of optimistic placements is valid (each eval sees every
+        # placement dispatched before its own, and the plan applier
+        # re-verifies all of them against committed state).
         tl0 = time.perf_counter()
         i = 0
         pend = [r for r in fast if r.res is None]
+        group_ids: Dict[int, int] = {}
+        pend.sort(key=lambda r: group_ids.setdefault(
+            id(r.prep) if r.shareable else id(r), len(group_ids)))
         while i < len(pend):
             rec = pend[i]
             j = i + 1

@@ -345,3 +345,46 @@ class TestStalePhantomUsage:
                         if e.Status == EvalStatusBlocked]
         finally:
             srv.shutdown()
+
+
+class TestWindowFusion:
+    def test_interleaved_preps_fuse_and_place_correctly(self):
+        """A window mixing two job shapes (A,B,A,B...) fuses only
+        consecutive shared-prep runs; placements still match totals and
+        nothing oversubscribes."""
+        srv = Server(ServerConfig(num_schedulers=0,
+                                  pipelined_scheduling=True,
+                                  scheduler_window=32,
+                                  host_placement=False))
+        srv.establish_leadership()
+        try:
+            from nomad_tpu.server.pipelined_worker import PipelinedWorker
+
+            for _ in range(10):
+                srv.node_register(mock.node())
+            jobs = []
+            for i in range(8):
+                if i % 2 == 0:
+                    job = simple_job(count=2, cpu=100, mem=64)
+                else:
+                    job = simple_job(count=3, cpu=150, mem=96)
+                jobs.append(job)
+                srv.job_register(job)
+            w = PipelinedWorker(srv.raft, srv.eval_broker, srv.plan_queue,
+                                srv.blocked_evals, srv.tindex,
+                                ["service", "batch", "system"], window=32,
+                                host_placement=False)
+            batch = w._dequeue_window()
+            assert len(batch) == 8
+            work = w._dispatch_window(batch)
+            assert work is not None and len(work.fast) == 8
+            work.packed = w._drain_window([r.res for r in work.fast])
+            w._finish_fast(work)
+            for job in jobs:
+                want = job.TaskGroups[0].Count
+                got = len([a for a in srv.state.allocs_by_job(job.ID)
+                           if not a.terminal_status()])
+                assert got == want, (job.ID, got, want)
+            assert w.stats.get("multi", 0) >= 1  # at least one fused run
+        finally:
+            srv.shutdown()

@@ -88,7 +88,7 @@ logger = logging.getLogger("nomad.worker.pipelined")
 FILL_TIMEOUT = 0.002
 
 
-@dataclass
+@dataclass(eq=False)  # identity semantics: recs are tracked by object
 class _FastEval:
     ev: Evaluation
     token: str
@@ -511,6 +511,15 @@ class PipelinedWorker(Worker):
                     fast.remove(r)
                     slow.append((r.ev, r.token))
             i = j
+        # Reorder `fast` to CHAIN order (host-placed recs, then deferred
+        # device recs in their sorted launch order): the phantom-usage
+        # quarantine in _finish_fast reasons about "evals placed behind a
+        # stale record" by list position, and the shared window_usage
+        # accumulator replays the chain — both must see the order the
+        # kernels actually chained in, not dequeue order.
+        pend_ids = {id(r) for r in pend}
+        launched = [r for r in fast if id(r) not in pend_ids]
+        fast = launched + [r for r in pend if not r.fallback]
         self.stats["t_launch_ms"] = self.stats.get("t_launch_ms", 0.0) \
             + (time.perf_counter() - tl0) * 1e3
 

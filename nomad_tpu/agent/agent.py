@@ -151,16 +151,35 @@ class Agent:
         metrics.configure(statsd_addr=self.config.statsd_addr,
                           collection_interval=self.config.telemetry_interval,
                           host_label=self.config.node_name)
-        if self.config.server_enabled:
-            if self.config.dev_mode:
-                self._setup_dev_server()
-            else:
-                self._setup_cluster_server()
-        if self.config.client_enabled:
-            self._setup_client()
-        self.http = HTTPServer(self, host=self.config.bind_addr,
-                               port=self.config.http_port)
-        self.http.start()
+        try:
+            if self.config.server_enabled:
+                if self.config.dev_mode:
+                    self._setup_dev_server()
+                else:
+                    self._setup_cluster_server()
+            if self.config.client_enabled:
+                self._setup_client()
+            self.http = HTTPServer(self, host=self.config.bind_addr,
+                                   port=self.config.http_port)
+            self.http.start()
+        except Exception:
+            # A half-started agent must release everything it bound (RPC
+            # listener, gossip sockets, client state): a caller retrying
+            # start() on a transient bind failure would otherwise conflict
+            # with its OWN leaked sockets forever.
+            try:
+                self.shutdown()
+            except Exception:
+                logger.debug("agent: cleanup after failed start also "
+                             "failed", exc_info=True)
+            # shutdown() detached the log ring; a retried start() must
+            # still capture logs for the monitor endpoint.
+            logging.getLogger().addHandler(self.log_ring)
+            self.server = None
+            self.cluster = None
+            self.client = None
+            self.http = None
+            raise
         if self.server is not None:
             self._register_server_service()
 

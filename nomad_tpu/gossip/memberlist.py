@@ -173,6 +173,13 @@ class Memberlist:
         except OSError:
             pass
         try:
+            # shutdown() wakes the blocked accept(); close() alone leaves
+            # the kernel socket LISTENING under the accept thread on Linux,
+            # so a restarted agent could never rebind its serf port.
+            self._tcp.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        try:
             self._tcp.close()
         except OSError:
             pass

@@ -12,6 +12,7 @@ run outside the lock.
 
 from __future__ import annotations
 
+import json
 import logging
 import queue
 import random
@@ -95,6 +96,26 @@ class RaftNode:
         # maybeBootstrap, nomad/serf.go:80-139 — servers without peers.json
         # wait for the expect quorum before their first election).
         self._electable = electable
+        # A DURABLY STORED peer set overrides both: it is the cluster
+        # configuration this node already belonged to before a restart
+        # (the reference's peers.json in hashicorp/raft's stable store).
+        # Without this, a restarted cluster is dead — every server's
+        # bootstrap-expect probe sees an existing cluster (log > 0) and
+        # defers forever, while nobody is electable.
+        stored = self.log.get_stable("peers")
+        if stored:
+            try:
+                if isinstance(stored, bytes):
+                    stored = stored.decode()
+                restored = [str(p) for p in json.loads(stored)]
+            except (ValueError, TypeError, UnicodeDecodeError):
+                LOG.warning("%s: stored peer set unreadable (%r); booting "
+                            "dormant", node_id, stored)
+                restored = []
+            if restored:
+                self._peers = restored
+                if node_id in self._peers:
+                    self._electable = True
 
         self._commit_index = 0
         self._last_applied = 0
@@ -292,8 +313,17 @@ class RaftNode:
             self._futures.clear()
             self._leader_events.put(False)
 
+    def _save_peers_locked(self) -> None:
+        """Persist the peer set so a restart rejoins its cluster instead of
+        booting as a dormant virgin (reference: hashicorp/raft peers.json)."""
+        try:
+            self.log.set_stable("peers", json.dumps(self._peers))
+        except Exception:
+            LOG.exception("failed to persist peer set")
+
     def _set_peers_locked(self, peers: List[str]) -> None:
         self._peers = list(peers)
+        self._save_peers_locked()
         if self.id in self._peers:
             # A committed Config entry naming us means a live cluster has
             # admitted us — we may now stand for election.
@@ -605,6 +635,7 @@ class RaftNode:
             self._peers = list(peers)
             if self.id not in self._peers:
                 self._peers.append(self.id)
+            self._save_peers_locked()
             self._electable = True
             self._reset_election_timer()
             return True

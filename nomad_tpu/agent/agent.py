@@ -179,6 +179,8 @@ class Agent:
             self.cluster = None
             self.client = None
             self.http = None
+            self.rpc_endpoints = None
+            self._rpc_pool = None
             raise
         if self.server is not None:
             self._register_server_service()

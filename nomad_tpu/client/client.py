@@ -72,10 +72,39 @@ class Client:
         self._heartbeat_ttl = 10.0
 
     # ---------------------------------------------------------------- setup
+    def _persistent_node_id(self) -> str:
+        """Stable node identity across agent restarts (reference:
+        client.go's client-id file in the state dir): without it a
+        restarted client registers as a BRAND NEW node, its old node TTLs
+        down, and every alloc it was running is marked lost and
+        rescheduled instead of reattached."""
+        if self.config.node_id:
+            return self.config.node_id
+        path = os.path.join(self.config.state_dir, "client-id")
+        try:
+            with open(path) as f:
+                nid = f.read().strip()
+            if nid:
+                return nid
+        except (OSError, UnicodeDecodeError, ValueError):
+            # Unreadable/corrupt id file: fall through to a fresh identity
+            # rather than wedging every future agent start.
+            pass
+        nid = generate_uuid()
+        try:
+            os.makedirs(self.config.state_dir, exist_ok=True)
+            tmp = path + ".tmp"
+            with open(tmp, "w") as f:
+                f.write(nid)
+            os.replace(tmp, path)
+        except OSError:
+            logger.exception("failed to persist client id")
+        return nid
+
     def _build_node(self) -> Node:
         """(reference: client.go:604-700 setupNode + fingerprint + drivers)"""
         node = Node(
-            ID=self.config.node_id or generate_uuid(),
+            ID=self._persistent_node_id(),
             Datacenter=self.config.datacenter,
             Status=NodeStatusInit,
             NodeClass=self.config.node_class,

@@ -315,9 +315,16 @@ class RaftNode:
 
     def _save_peers_locked(self) -> None:
         """Persist the peer set so a restart rejoins its cluster instead of
-        booting as a dormant virgin (reference: hashicorp/raft peers.json)."""
+        booting as a dormant virgin (reference: hashicorp/raft peers.json).
+        Skips the disk write when unchanged — startup log replay walks
+        every historical Config entry and each stable write is a full
+        rewrite + fsync."""
+        encoded = json.dumps(self._peers)
+        if encoded == getattr(self, "_saved_peers", None):
+            return
         try:
-            self.log.set_stable("peers", json.dumps(self._peers))
+            self.log.set_stable("peers", encoded)
+            self._saved_peers = encoded
         except Exception:
             LOG.exception("failed to persist peer set")
 

@@ -160,3 +160,63 @@ class TestClusterColdRestart:
         finally:
             for a in agents:
                 a.shutdown()
+
+
+class TestClientRestart:
+    def test_client_restart_keeps_node_identity_and_alloc(self, tmp_path):
+        """A restarted client agent must come back as the SAME node (the
+        persisted client-id) and re-adopt its allocation instead of the
+        server rescheduling it onto a 'new' node."""
+        port = free_port()
+
+        def boot_both():
+            a = Agent(AgentConfig(server_enabled=True, client_enabled=True,
+                                  http_port=0, rpc_port=port, serf_port=0,
+                                  bootstrap_expect=1, node_name="s1",
+                                  num_schedulers=1,
+                                  data_dir=str(tmp_path)))
+            a.start()
+            return a
+
+        a = boot_both()
+        try:
+            wait_leader([a])
+            assert wait_for(lambda: any(
+                n.Status == "ready" for n in a.server.state.nodes()),
+                timeout=30)
+            node_id = a.server.state.nodes()[0].ID
+            job = mock.job()
+            tg = job.TaskGroups[0]
+            tg.Count = 1
+            task = tg.Tasks[0]
+            task.Driver = "mock_driver"
+            task.Config = {"run_for": 300}
+            task.Resources.Networks = []
+            task.Services = []
+            eval_id, _, _ = a.server.job_register(job)
+            wait_eval(a.server, eval_id)
+            assert wait_for(lambda: [
+                al for al in a.server.state.allocs_by_job(job.ID)
+                if al.ClientStatus == "running"], timeout=30)
+            alloc_id = a.server.state.allocs_by_job(job.ID)[0].ID
+        finally:
+            a.shutdown()
+
+        a2 = boot_both()
+        try:
+            wait_leader([a2])
+            # Same node identity: exactly one node, same ID, ready again.
+            assert wait_for(lambda: any(
+                n.ID == node_id and n.Status == "ready"
+                for n in a2.server.state.nodes()), timeout=30)
+            assert len(a2.server.state.nodes()) == 1
+            # Same allocation, re-adopted (running), no reschedule.
+            assert wait_for(lambda: any(
+                al.ID == alloc_id and al.ClientStatus == "running"
+                for al in a2.server.state.allocs_by_job(job.ID)),
+                timeout=30)
+            live = [al for al in a2.server.state.allocs_by_job(job.ID)
+                    if not al.terminal_status()]
+            assert [al.ID for al in live] == [alloc_id]
+        finally:
+            a2.shutdown()

@@ -361,12 +361,18 @@ def route(agent, method: str, path: str, query, get_body):
 
     # ------------------------------ nodes
     if path == "/v1/nodes":
+        prefix = query.get("prefix", [""])[0]
         if remote:
-            return rpc_read("Node.List", {}, "Nodes")
+            stubs, index = rpc_read("Node.List", {}, "Nodes")
+            if prefix:
+                stubs = [n for n in stubs if n["ID"].startswith(prefix)]
+            return stubs, index
         need_server()
 
+
         def run():
-            stubs = sorted((to_dict(n.stub()) for n in state.nodes()),
+            stubs = sorted((to_dict(n.stub()) for n in state.nodes()
+                            if n.ID.startswith(prefix)),
                            key=lambda n: n["ID"])
             return stubs, state.get_index("nodes")
 
@@ -423,12 +429,18 @@ def route(agent, method: str, path: str, query, get_body):
 
     # ------------------------------ allocations
     if path == "/v1/allocations":
+        prefix = query.get("prefix", [""])[0]
         if remote:
-            return rpc_read("Alloc.List", {}, "Allocations")
+            allocs, index = rpc_read("Alloc.List", {}, "Allocations")
+            if prefix:
+                allocs = [a for a in allocs if a["ID"].startswith(prefix)]
+            return allocs, index
         need_server()
 
+
         def run():
-            allocs = sorted((to_dict(a.stub()) for a in state.allocs()),
+            allocs = sorted((to_dict(a.stub()) for a in state.allocs()
+                             if a.ID.startswith(prefix)),
                             key=lambda a: a["ID"])
             return allocs, state.get_index("allocs")
 
@@ -483,13 +495,18 @@ def route(agent, method: str, path: str, query, get_body):
 
     # ------------------------------ evaluations
     if path == "/v1/evaluations":
+        prefix = query.get("prefix", [""])[0]
         if remote:
             evals, index = rpc_read("Eval.List", {}, "Evaluations")
+            if prefix:
+                evals = [e for e in evals if e["ID"].startswith(prefix)]
             return sorted(evals, key=lambda e: e["ID"]), index
         need_server()
 
+
         def run():
-            evals = sorted((to_dict(e) for e in state.evals()),
+            evals = sorted((to_dict(e) for e in state.evals()
+                            if e.ID.startswith(prefix)),
                            key=lambda e: e["ID"])
             return evals, state.get_index("evals")
 

@@ -20,6 +20,27 @@ def _client(args) -> Client:
     return Client(address=args.address, region=args.region or "")
 
 
+def _resolve_prefix(kind: str, given: str, list_fn) -> str:
+    """Short-ID UX (reference: every command/*.go resolves id prefixes via
+    the list endpoint's ?prefix=): a unique prefix resolves to the full
+    ID; ambiguity lists the matches and aborts."""
+    if len(given) >= 36:  # full UUID
+        return given
+    matches, _ = list_fn(QueryOptions(prefix=given))
+    # Re-check client-side: a server that ignored ?prefix= (or an older
+    # one) must fail safe instead of resolving to a wrong ID.
+    ids = [m["ID"] for m in matches if m["ID"].startswith(given)]
+    if len(ids) == 1:
+        return ids[0]
+    if not ids:
+        print(f"No {kind} found with prefix {given!r}", file=sys.stderr)
+    else:
+        print(f"Prefix {given!r} matched multiple {kind}s:", file=sys.stderr)
+        for i in ids:
+            print(f"  {i}", file=sys.stderr)
+    raise SystemExit(1)
+
+
 def _add_meta(p: argparse.ArgumentParser) -> None:
     p.add_argument("-address", default="http://127.0.0.1:4646",
                    help="HTTP API address")
@@ -549,7 +570,8 @@ def cmd_inspect(args) -> int:
     from nomad_tpu.structs import to_dict
 
     job, _ = client.jobs.info(args.job_id)
-    print(json.dumps(to_dict(job), indent=2))
+    # (reference: command/inspect.go wraps the job for `nomad run` reuse)
+    print(json.dumps({"Job": to_dict(job)}, indent=2))
     return 0
 
 
@@ -564,7 +586,8 @@ def cmd_node_status(args) -> int:
                   f"{n['NodeClass']:<12} {str(n['Drain']).lower():<6} "
                   f"{n['Status']}")
         return 0
-    node, _ = client.nodes.info(args.node_id)
+    node, _ = client.nodes.info(
+        _resolve_prefix("node", args.node_id, client.nodes.list))
     print(f"ID     = {node['ID']}")
     print(f"Name   = {node['Name']}")
     print(f"Class  = {node['NodeClass']}")
@@ -582,7 +605,8 @@ def cmd_node_status(args) -> int:
 
 def cmd_node_drain(args) -> int:
     client = _client(args)
-    client.nodes.toggle_drain(args.node_id, args.enable)
+    node_id = _resolve_prefix("node", args.node_id, client.nodes.list)
+    client.nodes.toggle_drain(node_id, args.enable)
     state = "enabled" if args.enable else "disabled"
     print(f"Node {args.node_id[:8]} drain {state}")
     return 0
@@ -590,7 +614,9 @@ def cmd_node_drain(args) -> int:
 
 def cmd_alloc_status(args) -> int:
     client = _client(args)
-    alloc, _ = client.allocations.info(args.alloc_id)
+    alloc_id = _resolve_prefix("allocation", args.alloc_id,
+                               client.allocations.list)
+    alloc, _ = client.allocations.info(alloc_id)
     print(f"ID            = {alloc['ID']}")
     print(f"Eval ID       = {alloc['EvalID'][:8]}")
     print(f"Name          = {alloc['Name']}")
@@ -615,7 +641,9 @@ def cmd_alloc_status(args) -> int:
 
 def cmd_eval_status(args) -> int:
     client = _client(args)
-    ev, _ = client.evaluations.info(args.eval_id)
+    eval_id = _resolve_prefix("evaluation", args.eval_id,
+                              client.evaluations.list)
+    ev, _ = client.evaluations.info(eval_id)
     print(f"ID           = {ev['ID'][:8]}")
     print(f"Status       = {ev['Status']}")
     print(f"Type         = {ev['Type']}")

@@ -1,0 +1,169 @@
+"""CLI surface: every user-facing command driven in-process against a live
+dev agent through the HTTP API, exactly as `python -m nomad_tpu.cli` would
+(reference style: command/*_test.go run each Command against a test agent).
+"""
+
+import json
+import os
+
+import pytest
+
+from nomad_tpu.agent import Agent, AgentConfig
+from nomad_tpu.cli.commands import main
+from nomad_tpu.structs.structs import EvalStatusComplete
+
+
+from helpers import wait_for  # noqa: E402
+
+
+@pytest.fixture(scope="module")
+def dev_agent():
+    a = Agent(AgentConfig(server_enabled=True, client_enabled=True,
+                          dev_mode=True, http_port=0, rpc_port=0,
+                          serf_port=0, node_name="cli-dev",
+                          num_schedulers=1))
+    a.start()
+    assert wait_for(lambda: a.server.is_leader() and a.server._leader)
+    assert wait_for(lambda: any(n.Status == "ready"
+                                for n in a.server.state.nodes()), timeout=30)
+    yield a
+    a.shutdown()
+
+
+@pytest.fixture(scope="module")
+def address(dev_agent):
+    return f"http://127.0.0.1:{dev_agent.http.port}"
+
+
+def run_cli(capsys, *argv):
+    rc = main(list(argv))
+    out = capsys.readouterr()
+    return rc, out.out, out.err
+
+
+@pytest.fixture(scope="module")
+def jobfile(tmp_path_factory):
+    d = tmp_path_factory.mktemp("cli")
+    path = d / "example.nomad"
+    old = os.getcwd()
+    os.chdir(d)
+    try:
+        rc = main(["init"])
+        assert rc == 0
+        assert path.exists()
+    finally:
+        os.chdir(old)
+    # Shrink the example so it places on the dev node and finishes fast.
+    text = path.read_text()
+    return str(path), text
+
+
+class TestJobLifecycle:
+    def test_validate_and_plan_and_run(self, capsys, address, jobfile,
+                                       dev_agent):
+        path, _ = jobfile
+        rc, out, _ = run_cli(capsys, "validate", path)
+        assert rc == 0
+
+        rc, out, _ = run_cli(capsys, "plan", "-address", address, path)
+        assert rc in (0, 1)  # 1 = changes would be made (job is new)
+        assert "+ Job" in out or "Job:" in out or out
+
+        rc, out, _ = run_cli(capsys, "run", "-detach", "-address", address,
+                             path)
+        assert rc == 0, out
+        eval_id = out.strip().splitlines()[-1]
+        assert wait_for(lambda: (
+            (e := dev_agent.server.state.eval_by_id(eval_id)) is not None
+            and e.Status == EvalStatusComplete), timeout=60)
+
+    def test_status_inspect_stop(self, capsys, address, jobfile):
+        rc, out, _ = run_cli(capsys, "status", "-address", address)
+        assert rc == 0 and "example" in out
+
+        rc, out, _ = run_cli(capsys, "status", "-address", address,
+                             "example")
+        assert rc == 0 and "example" in out
+
+        rc, out, _ = run_cli(capsys, "inspect", "-address", address,
+                             "example")
+        assert rc == 0
+        assert json.loads(out)["Job"]["ID"] == "example"
+
+        rc, out, _ = run_cli(capsys, "stop", "-detach", "-address", address,
+                             "example")
+        assert rc == 0
+
+    def test_run_output_mode_emits_json(self, capsys, address, jobfile):
+        path, _ = jobfile
+        rc, out, _ = run_cli(capsys, "run", "-output", path)
+        assert rc == 0
+        assert json.loads(out)["Job"]["ID"] == "example"
+
+
+class TestClusterCommands:
+    def test_node_status_and_drain(self, capsys, address, dev_agent):
+        rc, out, _ = run_cli(capsys, "node-status", "-address", address)
+        assert rc == 0 and "ready" in out
+        node_id = dev_agent.server.state.nodes()[0].ID
+
+        rc, out, _ = run_cli(capsys, "node-status", "-address", address,
+                             node_id[:8])
+        assert rc == 0 and node_id in out
+
+        rc, out, _ = run_cli(capsys, "node-drain", "-address", address,
+                             "-enable", node_id)
+        assert rc == 0
+        assert wait_for(lambda: dev_agent.server.state.node_by_id(
+            node_id).Drain)
+        rc, out, _ = run_cli(capsys, "node-drain", "-address", address,
+                             "-disable", node_id)
+        assert rc == 0
+        assert wait_for(lambda: not dev_agent.server.state.node_by_id(
+            node_id).Drain)
+
+    def test_alloc_and_eval_status(self, capsys, address, dev_agent):
+        from nomad_tpu import mock
+
+        job = mock.job()
+        tg = job.TaskGroups[0]
+        tg.Count = 1
+        task = tg.Tasks[0]
+        task.Driver = "mock_driver"
+        task.Config = {"run_for": 60}
+        task.Resources.Networks = []
+        task.Services = []
+        eval_id, _, _ = dev_agent.server.job_register(job)
+        assert wait_for(lambda: (
+            (e := dev_agent.server.state.eval_by_id(eval_id)) is not None
+            and e.Status == EvalStatusComplete), timeout=30)
+        alloc = dev_agent.server.state.allocs_by_job(job.ID)[0]
+
+        rc, out, _ = run_cli(capsys, "alloc-status", "-address", address,
+                             alloc.ID[:8])
+        assert rc == 0 and alloc.ID[:8] in out
+
+        rc, out, _ = run_cli(capsys, "eval-status", "-address", address,
+                             eval_id[:8])
+        assert rc == 0
+
+    def test_agent_level_commands(self, capsys, address):
+        rc, out, _ = run_cli(capsys, "server-members", "-address", address)
+        assert rc == 0
+
+        rc, out, _ = run_cli(capsys, "agent-info", "-address", address)
+        assert rc == 0 and "nomad" in out.lower()
+
+        rc, out, _ = run_cli(capsys, "system-gc", "-address", address)
+        assert rc == 0
+
+        rc, out, _ = run_cli(capsys, "services", "-address", address)
+        assert rc == 0
+
+        rc, out, _ = run_cli(capsys, "client-config", "-address", address)
+        assert rc == 0
+
+    def test_unknown_job_errors_cleanly(self, capsys, address):
+        rc, out, err = run_cli(capsys, "status", "-address", address,
+                               "no-such-job")
+        assert rc != 0

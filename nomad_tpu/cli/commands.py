@@ -660,6 +660,8 @@ def cmd_eval_status(args) -> int:
 
 def cmd_fs(args) -> int:
     client = _client(args)
+    args.alloc_id = _resolve_prefix("allocation", args.alloc_id,
+                                    client.allocations.list)
     if args.stat:
         info = client.alloc_fs.stat(args.alloc_id, args.path)
         print(f"{info['FileMode']} {info['Size']:>10} {info['Name']}")

@@ -11,6 +11,7 @@ leader's eval broker / blocked-evals tracker observe state transitions
 from __future__ import annotations
 
 import enum
+import logging
 import threading
 import time
 from typing import Any, Callable, Dict, List, Optional
@@ -33,6 +34,9 @@ from nomad_tpu.structs.structs import (
     JobStatusRunning,
     NodeStatusReady,
 )
+
+
+logger = logging.getLogger("nomad.fsm")
 
 
 class MessageType(enum.IntEnum):
@@ -189,6 +193,16 @@ class FSM:
     def _apply_alloc_client_update(self, index: int, req: Dict[str, Any]):
         for a in req["Alloc"]:
             alloc = from_dict(Allocation, a) if isinstance(a, dict) else a
+            # A client can report status for an alloc the server already
+            # GC'd (its sync loop races system-gc). Skip it up front:
+            # letting the store raise would poison the whole COALESCED
+            # update batch and lose every other client's statuses riding
+            # in it. (A pre-check rather than catching KeyError, which
+            # would also mask listener bugs downstream of the write.)
+            if self.state.alloc_by_id(alloc.ID) is None:
+                logger.debug("client update for unknown alloc %s dropped",
+                             alloc.ID)
+                continue
             self.state.update_alloc_from_client(index, alloc)
             # Terminal client status frees capacity: unblock by node class
             # (reference: fsm.go:395-428).

@@ -21,7 +21,8 @@ def dev_agent():
     a = Agent(AgentConfig(server_enabled=True, client_enabled=True,
                           dev_mode=True, http_port=0, rpc_port=0,
                           serf_port=0, node_name="cli-dev",
-                          num_schedulers=1))
+                          num_schedulers=1,
+                          options={"driver.raw_exec.enable": "true"}))
     a.start()
     assert wait_for(lambda: a.server.is_leader() and a.server._leader)
     assert wait_for(lambda: any(n.Status == "ready"
@@ -167,3 +168,82 @@ class TestClusterCommands:
         rc, out, err = run_cli(capsys, "status", "-address", address,
                                "no-such-job")
         assert rc != 0
+
+
+class TestFsAndMonitor:
+    def test_fs_ls_stat_cat_on_live_alloc(self, capsys, address, dev_agent):
+        """fs drives the client file API end-to-end: a raw_exec task writes
+        stdout, and ls/stat/cat read it through the server->client route."""
+        from nomad_tpu import mock
+
+        job = mock.job()
+        job.ID = job.Name = "fs-job"
+        tg = job.TaskGroups[0]
+        tg.Count = 1
+        task = tg.Tasks[0]
+        task.Name = "echoer"
+        task.Driver = "raw_exec"
+        task.Config = {"command": "/bin/sh",
+                       "args": ["-c", "echo fs-cli-test; sleep 60"]}
+        task.Resources.Networks = []
+        task.Services = []
+        eval_id, _, _ = dev_agent.server.job_register(job)
+        assert wait_for(lambda: (
+            (e := dev_agent.server.state.eval_by_id(eval_id)) is not None
+            and e.Status == EvalStatusComplete), timeout=30)
+        assert wait_for(lambda: any(
+            al.ClientStatus == "running"
+            for al in dev_agent.server.state.allocs_by_job(job.ID)),
+            timeout=30)
+        alloc = dev_agent.server.state.allocs_by_job(job.ID)[0]
+
+        rc, out, err = run_cli(capsys, "fs", "-address", address,
+                               alloc.ID[:8], "alloc/logs")
+        assert rc == 0 and "echoer" in out, (out, err)
+
+        log = next(l.split()[-1] for l in out.splitlines()
+                   if "stdout" in l)
+        assert wait_for(lambda: run_cli(
+            capsys, "fs", "-address", address, "-cat", alloc.ID,
+            f"alloc/logs/{log}")[1].find("fs-cli-test") >= 0, timeout=20)
+
+        rc, out, _ = run_cli(capsys, "fs", "-address", address, "-stat",
+                             alloc.ID, f"alloc/logs/{log}")
+        assert rc == 0 and log in out
+
+    def test_monitor_follows_eval(self, capsys, address, dev_agent):
+        from nomad_tpu import mock
+
+        job = mock.job()
+        job.ID = job.Name = "monitor-job"
+        tg = job.TaskGroups[0]
+        tg.Count = 1
+        task = tg.Tasks[0]
+        task.Driver = "mock_driver"
+        task.Config = {"run_for": 30}
+        task.Resources.Networks = []
+        task.Services = []
+        eval_id, _, _ = dev_agent.server.job_register(job)
+        rc, out, _ = run_cli(capsys, "monitor", "-address", address,
+                             eval_id)
+        assert rc == 0
+        assert "complete" in out
+
+    def test_plan_shows_diff_for_new_job(self, capsys, address, dev_agent,
+                                         jobfile):
+        path, text = jobfile
+        import shutil
+        import tempfile
+
+        # A renamed copy is guaranteed-new: plan must render a CREATE diff
+        # with added fields and the scheduler annotation summary.
+        d = tempfile.mkdtemp()
+        newpath = os.path.join(d, "planned.nomad")
+        shutil.copy(path, newpath)
+        new_text = text.replace('"example"', '"planned"')
+        with open(newpath, "w") as f:
+            f.write(new_text)
+        rc, out, _ = run_cli(capsys, "plan", "-address", address, newpath)
+        assert rc == 1  # changes would be made
+        assert "+ Job" in out or "+ job" in out.lower()
+        assert "create" in out.lower() or "+" in out

@@ -220,3 +220,52 @@ class TestClientRestart:
             assert [al.ID for al in live] == [alloc_id]
         finally:
             a2.shutdown()
+
+
+class TestTLSRestart:
+    def test_tls_server_restart_recovers(self, tmp_path):
+        """Restart with mutual TLS on: certificates reload, the stored
+        peer set makes the server electable, and the TLS-muxed raft/RPC
+        planes come back — the full operator restart path with
+        verify_incoming enabled."""
+        from test_tls import issue_cert, make_ca
+
+        ca_key, ca_crt = make_ca(str(tmp_path))
+        key, crt = issue_cert(str(tmp_path), ca_key, ca_crt, "server")
+        port = free_port()
+
+        def boot_tls():
+            a = Agent(AgentConfig(server_enabled=True, client_enabled=False,
+                                  http_port=0, rpc_port=port, serf_port=0,
+                                  bootstrap_expect=1, node_name="tls1",
+                                  num_schedulers=1,
+                                  data_dir=str(tmp_path / "data"),
+                                  tls_enable_rpc=True,
+                                  tls_ca_file=str(ca_crt),
+                                  tls_cert_file=str(crt),
+                                  tls_key_file=str(key),
+                                  tls_verify_incoming=True))
+            a.start()
+            return a
+
+        a = boot_tls()
+        try:
+            wait_leader([a])
+            a.server.node_register(mock.node())
+            job = mock.job()
+            eval_id, _, _ = a.server.job_register(job)
+            wait_eval(a.server, eval_id)
+            n1 = len(a.server.state.allocs_by_job(job.ID))
+            assert n1 > 0
+        finally:
+            a.shutdown()
+
+        a2 = boot_tls()
+        try:
+            wait_leader([a2])
+            assert len(a2.server.state.allocs_by_job(job.ID)) == n1
+            job2 = mock.job()
+            eval2, _, _ = a2.server.job_register(job2)
+            wait_eval(a2.server, eval2)
+        finally:
+            a2.shutdown()

@@ -57,13 +57,17 @@ class PlacementResult(NamedTuple):
 def _score(usage2: jax.Array, score_cap: jax.Array) -> jax.Array:
     """BestFit-v3: 20 - 10^freeCpuPct - 10^freeMemPct, clamped to [0, 18].
 
-    usage2 [N, 2] is proposed (cpu, mem) utilization including reserved;
-    score_cap [N, 2] is capacity minus reserved. Division by zero follows
-    IEEE (Inf/NaN) exactly like the Go reference; NaN sanitizes to 0.
+    usage2 [..., 2] is proposed (cpu, mem) utilization including reserved;
+    score_cap [..., 2] is capacity minus reserved (broadcastable). Division
+    by zero follows IEEE (Inf/NaN) exactly like the Go reference; NaN
+    sanitizes to 0. THE one definition of the formula — the monolithic
+    scan, the keyed kernel's three passes, and the host mirror must all
+    agree bit-for-bit.
     """
     free_pct = 1.0 - usage2 / score_cap
     # 10^x on the MXU-friendly path: exp2(x * log2 10).
-    total = jnp.exp2(free_pct[:, 0] * _LOG2_10) + jnp.exp2(free_pct[:, 1] * _LOG2_10)
+    total = (jnp.exp2(free_pct[..., 0] * _LOG2_10)
+             + jnp.exp2(free_pct[..., 1] * _LOG2_10))
     score = jnp.clip(20.0 - total, 0.0, 18.0)
     return jnp.nan_to_num(score, nan=0.0, posinf=18.0, neginf=0.0)
 
@@ -287,6 +291,281 @@ def place_batch_host(capacity, score_cap, usage, tg_masks, job_counts,
     # Same result type as the device kernel; both arrays are
     # host-side numpy here — the pipelined drain dispatches on
     # isinstance(packed, np.ndarray) and skips the readback.
+    return PlacementResult(packed, usage)
+
+
+# ----------------------------------------------------- keyed candidates
+# Candidate-set placement: the storm kernel for meshes AND single chips.
+#
+# Every PreparedBatch satisfies demands[p] == tg_demands[tg_ids[p]]
+# (stack.prepare), so a window of P placements draws from at most T
+# distinct (task-group, demand) KEYS — and the monolithic scan's full
+# score pass per placement is P/T-fold redundant. Worse, under SPMD
+# sharding that scan issues a global argmax plus a global sum PER
+# PLACEMENT over the sharded node axis — two latency-bound ICI
+# collectives serialized by the scan (measured 0.65x at 8 devices in
+# round 4). This kernel restructures the whole window around candidate
+# sets:
+#
+#   1. ONE vectorized score pass per KEY over local rows at window start
+#      (masked BestFit-v3, [T, n_loc]) — no collective.
+#   2. Each shard takes its local top-K candidate rows per key
+#      (lax.top_k; ties break to the lowest index, same as argmax),
+#      where K = the window's valid placement count.
+#   3. ONE all_gather ships the candidate packets (row data + per-key
+#      eligibility, (2R + 6 + T) f32 each).
+#   4. Candidates sort by global row id (argmax tie parity), dedup, and
+#      trim to the GLOBAL top-K per key, so the replay size is
+#      independent of the device count.
+#   5. Every device replays the exact P-step sequential chain — resets,
+#      bans, anti-affinity, the same f32 score ops — over the replicated
+#      candidate table; each shard then applies the winners' usage
+#      updates to rows it owns. One psum publishes the packed result.
+#
+# Exactness: at step j, every modified row is a prior winner (in the
+# candidate set by induction, and within its key's global top-K by this
+# same argument). The winner is either such a row, or the best
+# UNMODIFIED row — every row ranked above it at window start for its key
+# is modified (else it would win now), so its window-start rank is
+# <= j <= K and it survives both the local top-K and the global trim.
+# Feasibility is monotonic within a window (usage only grows, bans only
+# appear mid-eval, eligibility is static) and eval-boundary resets
+# restore unmodified rows to exactly their window-start scores, so the
+# window-start ranking remains valid across resets. The replay
+# recomputes scores from shipped row data with the exact same f32 ops as
+# the monolithic step, so results are bit-identical for valid
+# placements (tests assert this against place_batch/place_batch_multi).
+# For padding placements (valid=False) chosen=-1 and score=-inf as
+# always, but the n_feasible column is unspecified (the monolithic
+# kernels compute it with the padding's zeroed demand; no consumer reads
+# it).
+#
+# Collectives per window: 2 (one all_gather, one psum) — versus 2P for
+# the naive SPMD scan. Total work per window: one score pass per key
+# plus an O(K * T)-row replay — versus P full-table passes.
+
+
+@functools.lru_cache(maxsize=64)
+def _keyed_program(mesh, k_cand: int):
+    """Build the jitted keyed-candidate program. mesh=None compiles the
+    single-device variant (no collectives, same candidate semantics)."""
+    if mesh is not None:
+        axis = mesh.axis_names[0]
+        n_shards = int(mesh.devices.size)
+    else:
+        axis = None
+        n_shards = 1
+
+    def local_fn(capacity, score_cap, usage, tg_masks, job_counts0,
+                 key_demands, tg_ids, valid, noise, penalty, distinct,
+                 banned0, reset):
+        n_loc, r_dims = capacity.shape
+        n_keys = key_demands.shape[0]
+        if axis is not None:
+            my = jax.lax.axis_index(axis)
+            row_base = (my * n_loc).astype(jnp.int32)
+        else:
+            my = jnp.int32(0)
+            row_base = jnp.int32(0)
+
+        # ---- window-start score pass: one per key over local rows.
+        fits0 = jnp.all(capacity[None] - usage[None]
+                        >= key_demands[:, None, :], axis=-1)
+        ok0 = fits0 & tg_masks & ~(distinct & banned0)[None, :]
+        util2 = usage[None, :, :2] + key_demands[:, None, :2]
+        score = _score(util2, score_cap[None])
+        score = (score - job_counts0.astype(jnp.float32)[None, :] * penalty
+                 + noise[None, :])
+        masked0 = jnp.where(ok0, score, -jnp.inf)        # [T, n_loc]
+        nf0_loc = jnp.sum(ok0, axis=1).astype(jnp.int32)  # [T]
+
+        # ---- local top-K candidates per key -> gathered packets.
+        kc = min(k_cand, n_loc)
+        _, loc_idx = jax.lax.top_k(masked0, kc)          # [T, kc]
+        cand = loc_idx.reshape(-1)                       # [T*kc]
+        pkt = jnp.concatenate([
+            (cand + row_base)[:, None].astype(jnp.float32),
+            capacity[cand],
+            score_cap[cand],
+            usage[cand],
+            job_counts0[cand][:, None].astype(jnp.float32),
+            banned0[cand][:, None].astype(jnp.float32),
+            noise[cand][:, None],
+            tg_masks[:, cand].T.astype(jnp.float32),     # [T*kc, T]
+        ], axis=1)
+        if axis is not None:
+            pkt_all, nf_all = jax.lax.all_gather((pkt, nf0_loc), axis)
+            pkt_all = pkt_all.reshape(n_shards * n_keys * kc, -1)
+            nf0 = jnp.sum(nf_all, axis=0)                # [T]
+        else:
+            pkt_all = pkt
+            nf0 = nf0_loc
+        n_cand = pkt_all.shape[0]
+
+        # Ascending global-row order makes every later argmax break ties
+        # toward the lowest row — the monolithic kernel's behavior.
+        rows_g = pkt_all[:, 0].astype(jnp.int32)
+        order = jnp.argsort(rows_g)
+        pkt_s = pkt_all[order]
+        rows_s = pkt_s[:, 0].astype(jnp.int32)
+        keep = jnp.concatenate(
+            [jnp.ones((1,), bool), rows_s[1:] != rows_s[:-1]])
+
+        c_cap = pkt_s[:, 1:1 + r_dims]
+        c_sc = pkt_s[:, 1 + r_dims:3 + r_dims]
+        c_use0 = pkt_s[:, 3 + r_dims:3 + 2 * r_dims]
+        c_cnt0 = pkt_s[:, 3 + 2 * r_dims].astype(jnp.int32)
+        c_ban0 = pkt_s[:, 4 + 2 * r_dims] > 0.5
+        c_noise = pkt_s[:, 5 + 2 * r_dims]
+        c_elig = pkt_s[:, 6 + 2 * r_dims:] > 0.5         # [C, T]
+
+        # Window-start ok/score per candidate per key — the n_feasible
+        # delta baseline, and the ranking for the global trim. Rows
+        # outside the candidate set cannot change feasibility within a
+        # window, so deltas over candidates are exact. ok0c_raw is
+        # keep-independent: every copy of a row carries identical data,
+        # so after compaction re-picks which copy survives, the raw
+        # values stay valid for whichever copy that is.
+        fits0c = jnp.all(c_cap[:, None, :] - c_use0[:, None, :]
+                         >= key_demands[None, :, :], axis=-1)  # [C, T]
+        ok0c_raw = fits0c & c_elig & ~(distinct & c_ban0)[:, None]
+        util2c = c_use0[:, None, :2] + key_demands[None, :, :2]
+        sc0c = _score(util2c, c_sc[:, None, :])
+        sc0c = (sc0c - c_cnt0.astype(jnp.float32)[:, None] * penalty
+                + c_noise[:, None])
+        # Duplicate copies score -inf here so one row cannot occupy two
+        # trim slots of the same key.
+        masked0c = jnp.where(ok0c_raw & keep[:, None], sc0c, -jnp.inf)
+
+        # Global trim + COMPACT: keep only each key's global top-K
+        # candidates and shrink the arrays to that static size, so the
+        # replay cost is independent of the device count. Winners
+        # provably rank <= K for their key, so the trim is lossless.
+        k_trim = min(k_cand, n_cand)
+        if n_keys * k_trim < n_cand:
+            _, tidx = jax.lax.top_k(masked0c.T, k_trim)  # [T, k_trim]
+            sel = tidx.reshape(-1)                       # [T*k_trim]
+            # Re-sort the compacted set by global row (argmax tie parity)
+            # and rebuild the dedup mask FROM SCRATCH: a key short of
+            # feasible candidates pads its trim slots with -inf entries
+            # that can be a row's keep=False duplicate, and if that copy
+            # sorts first, carrying the old keep forward would AND it
+            # with first-occurrence and drop the row entirely. Copies are
+            # identical, so first-occurrence alone is the right mask.
+            sel = sel[jnp.argsort(rows_s[sel])]
+            rows_s = rows_s[sel]
+            keep = jnp.concatenate(
+                [jnp.ones((1,), bool), rows_s[1:] != rows_s[:-1]])
+            c_cap = c_cap[sel]
+            c_sc = c_sc[sel]
+            c_use0 = c_use0[sel]
+            c_cnt0 = c_cnt0[sel]
+            c_ban0 = c_ban0[sel]
+            c_noise = c_noise[sel]
+            c_elig = c_elig[sel]
+            ok0c_raw = ok0c_raw[sel]
+        ok0c = ok0c_raw & keep[:, None]
+
+        # Per-placement demand, zeroed for padding steps exactly like the
+        # monolithic kernels' zero-padded demand rows.
+        kd_p = key_demands[tg_ids] * valid[:, None].astype(jnp.float32)
+
+        def replay(carry, xs):
+            c_use, c_cnt, c_ban = carry
+            t_j, v_j, r_j, d_j = xs
+            c_cnt = jnp.where(r_j, c_cnt0, c_cnt)
+            c_ban = jnp.where(r_j, c_ban0, c_ban)
+            elig_j = jax.lax.dynamic_index_in_dim(
+                c_elig, t_j, axis=1, keepdims=False)
+            fits_c = jnp.all(c_cap - c_use >= d_j[None, :], axis=1)
+            ok_c = fits_c & elig_j & ~(distinct & c_ban) & keep
+            sc = _score(c_use[:, :2] + d_j[None, :2], c_sc)
+            sc = sc - c_cnt.astype(jnp.float32) * penalty + c_noise
+            m = jnp.where(ok_c, sc, -jnp.inf)
+            i = jnp.argmax(m)
+            found = ok_c[i] & v_j
+            one = found.astype(c_use.dtype)
+            c_use = c_use.at[i].add(d_j * one)
+            c_cnt = c_cnt.at[i].add(found.astype(jnp.int32))
+            c_ban = c_ban.at[i].set(c_ban[i] | found)
+            ok0_j = jax.lax.dynamic_index_in_dim(
+                ok0c, t_j, axis=1, keepdims=False)
+            nf0_j = jax.lax.dynamic_index_in_dim(
+                nf0, t_j, keepdims=False)
+            nf = nf0_j + jnp.sum(ok_c) - jnp.sum(ok0_j)
+            out = jnp.stack([
+                jnp.where(found, rows_s[i], -1).astype(jnp.float32),
+                jnp.where(found, m[i], -jnp.inf),
+                nf.astype(jnp.float32),
+            ])
+            return (c_use, c_cnt, c_ban), out
+
+        (c_use_f, _, _), packed = jax.lax.scan(
+            replay, (c_use0, c_cnt0, c_ban0),
+            (tg_ids, valid, reset, kd_p))                # [P, 3]
+
+        # Publish the replay's FINAL candidate usage into the owning
+        # shard's rows by scatter-SET: c_use_f accumulated each row's won
+        # demands sequentially in placement order, bit-identical to the
+        # monolithic scan's in-register adds — a scatter-ADD of per-
+        # placement demands would apply duplicate indices in XLA-defined
+        # order and could drift by an ulp when one row wins repeatedly.
+        # Untouched candidate rows set their unchanged value (a no-op),
+        # and kept rows are unique so the set order is immaterial.
+        lr = rows_s - row_base
+        mine = keep & (lr >= 0) & (lr < n_loc)
+        # Foreign/duplicate entries get an out-of-range index and drop —
+        # a clipped index could collide with a real winner row and race
+        # its write with a stale gathered value.
+        usage = usage.at[jnp.where(mine, lr, n_loc)].set(
+            c_use_f, mode="drop")
+
+        if axis is not None:
+            # Every device computed the identical replay; one psum makes
+            # that replication visible to the type system (and is the
+            # only other collective — per WINDOW, not per placement).
+            packed = jax.lax.psum(
+                jnp.where(my == 0, packed, 0.0), axis)
+        return packed, usage
+
+    if mesh is None:
+        return jax.jit(local_fn)
+
+    import jax.sharding as jsh
+
+    node = jsh.PartitionSpec(axis)
+    mask2 = jsh.PartitionSpec(None, axis)
+    rep = jsh.PartitionSpec()
+    smapped = jax.shard_map(
+        local_fn, mesh=mesh,
+        in_specs=(node, node, node, mask2, node, rep, rep, rep, node,
+                  rep, rep, node, rep),
+        out_specs=(rep, node))
+    return jax.jit(smapped)
+
+
+def keyed_cand_count(n_valid: int) -> int:
+    """Candidate budget for a window with n_valid real placements, padded
+    to a power of two so jit compiles one program per bucket."""
+    k = 8
+    while k < n_valid:
+        k *= 2
+    return k
+
+
+def place_batch_keyed(mesh, capacity, score_cap, usage, tg_masks,
+                      job_counts0, key_demands, tg_ids, valid, noise,
+                      penalty, distinct_hosts, banned0, reset,
+                      n_valid: int) -> PlacementResult:
+    """place_batch / place_batch_multi semantics via the keyed candidate
+    kernel. key_demands is [T, R] with demands[p] == key_demands[tg_ids[p]]
+    for every valid placement (stack.prepare's tg_demands). n_valid is the
+    window's real placement count (host-known), which bounds the candidate
+    sets. mesh=None runs single-device."""
+    fn = _keyed_program(mesh, keyed_cand_count(n_valid))
+    packed, usage = fn(capacity, score_cap, usage, tg_masks, job_counts0,
+                      key_demands, tg_ids, valid, noise, penalty,
+                      distinct_hosts, banned0, reset)
     return PlacementResult(packed, usage)
 
 

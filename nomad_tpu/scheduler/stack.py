@@ -94,6 +94,11 @@ _dev_cache = _DeviceInputCache()
 # Deep storm windows on big tables stay on the device chain.
 HOST_ROW_STEP_BUDGET = 1 << 23
 
+# Candidate-table budget for the keyed kernel (keys x candidates x devices).
+# Within it, every device dispatch uses kernels.place_batch_keyed; beyond it
+# (degenerate many-key mega-windows) the monolithic scan kernels take over.
+KEYED_CAND_BUDGET = 1 << 17
+
 
 @dataclass
 class SelectedOption:
@@ -127,9 +132,14 @@ class PreparedBatch:
     noise_vec: np.ndarray         # [N] f32 tie-break jitter
     tg_mask_sums: np.ndarray      # [U] eligible-node count per unique TG
     cand_sum: int                 # candidate node count (metrics base)
+    # Real (non-padding) placement count — REQUIRED: it bounds the keyed
+    # kernel's candidate sets, and an understated value would silently
+    # trim true winners out of the candidate table.
+    n_valid: int
     # Memo of the resolved device-side inputs for the unmodified first
-    # dispatch (no bans/placed overlays): a window re-dispatching an
-    # identical prep skips the content-hash lookups entirely.
+    # dispatch (no bans/placed overlays): a (kernel-kind, tuple) pair so a
+    # window re-dispatching an identical prep skips the content-hash
+    # lookups entirely.
     dev_inputs: Optional[tuple] = None
 
 
@@ -281,7 +291,11 @@ class GenericStack:
         # ~100ms RTT on remote-attached TPUs, far more than numpy takes
         # over a modest rows x placements product. Storms and huge evals
         # keep the device path (the budget keeps host work bounded).
-        use_host = nt.n_rows * prep.p_pad <= HOST_ROW_STEP_BUDGET
+        # allow_host_select mirrors ServerConfig.host_placement so that
+        # host_placement=False forces the device kernel on the slow path
+        # too (the multichip dry run proves the SPMD path end to end).
+        use_host = (self.tindex.allow_host_select
+                    and nt.n_rows * prep.p_pad <= HOST_ROW_STEP_BUDGET)
         for _attempt in range(8):
             if not remaining:
                 break
@@ -371,7 +385,62 @@ class GenericStack:
             evict_vecs=evict_vecs, job_counts=job_counts, distinct=distinct,
             penalty=penalty, noise_vec=noise_vec,
             tg_mask_sums=tg_masks.sum(axis=1),
-            cand_sum=int(self._cand_mask.sum()))
+            cand_sum=int(self._cand_mask.sum()), n_valid=len(tgs))
+
+    def _device_kind(self, prep: PreparedBatch, n_valid: int) -> str:
+        """Pick the device kernel: the keyed-candidate kernel whenever its
+        candidate table stays within budget (always, in practice — the
+        bound only trips on degenerate many-key mega-windows), else the
+        monolithic scan. Keyed is bit-identical and does one score pass
+        per unique task group instead of one per placement; on a sharded
+        mesh it costs 2 collectives per WINDOW instead of 2 per placement
+        (kernels.py: 'keyed candidates')."""
+        nt = self.tindex.nt
+        n_dev = nt.mesh.devices.size if nt.mesh is not None else 1
+        n_keys = prep.tg_masks.shape[0]
+        if n_keys * kernels.keyed_cand_count(n_valid) * n_dev \
+                <= KEYED_CAND_BUDGET:
+            return "keyed"
+        return "scan"
+
+    def _launch_device(self, d, usage, kind: str, dev: tuple, n_valid: int):
+        nt = self.tindex.nt
+        if kind == "keyed":
+            mesh = nt.mesh
+            if mesh is not None and mesh.devices.size == 1:
+                mesh = None  # plain jit; no shard_map needed
+            return kernels.place_batch_keyed(
+                mesh, d["capacity"], d["score_cap"], usage, *dev,
+                n_valid=n_valid)
+        return kernels.place_batch(d["capacity"], d["score_cap"], usage,
+                                   *dev)
+
+    def _assemble_dev(self, kind: str, prep: PreparedBatch,
+                      masks: np.ndarray, counts: np.ndarray,
+                      tg_ids: np.ndarray, valid: np.ndarray,
+                      hosts: np.ndarray, reset: Optional[np.ndarray],
+                      demands: Optional[np.ndarray] = None) -> tuple:
+        """THE one assembly of the positional device-input tuple shared by
+        dispatch and dispatch_multi: keyed kernels take tg_demands plus a
+        reset vector; scan kernels take per-placement demands (reset only
+        for the multi-eval scan). Every host array goes through the
+        content-addressed transfer cache, so a storm's byte-identical
+        masks/demands/zero arrays pay ZERO host->device puts per eval
+        (each put is a full RTT on remote-attached TPUs)."""
+        node_sh, mask_sh, rep_sh = _mesh_shardings(self.tindex.nt)
+        mid = prep.tg_demands if kind == "keyed" else demands
+        dev = (_dev_cache.get(masks, mask_sh),
+               _dev_cache.get(counts, node_sh),
+               _dev_cache.get(mid, rep_sh),
+               _dev_cache.get(tg_ids, rep_sh),
+               _dev_cache.get(valid, rep_sh),
+               _dev_cache.get(prep.noise_vec, node_sh),
+               _dev_cache.get(np.float32(prep.penalty), rep_sh),
+               _dev_cache.get(np.asarray(prep.distinct), rep_sh),
+               _dev_cache.get(hosts, node_sh))
+        if reset is not None:
+            dev = dev + (_dev_cache.get(reset, rep_sh),)
+        return dev
 
     def dispatch(self, prep: PreparedBatch, usage_override=None,
                  banned: Optional[np.ndarray] = None,
@@ -388,10 +457,10 @@ class GenericStack:
         nt = self.tindex.nt
         d = tables if tables is not None else nt.device_arrays()
         # Mesh serving: node-axis inputs shard over the mesh like the table
-        # arrays; per-placement inputs replicate. XLA's SPMD partitioner
-        # turns the same place_batch program into the multi-chip version
-        # (global argmax/sum become ICI collectives).
-        node_sh, mask_sh, rep_sh = _mesh_shardings(nt)
+        # arrays; per-placement inputs replicate. The keyed kernel runs the
+        # explicit shard_map program; the scan fallback relies on XLA's
+        # SPMD partitioner.
+        node_sh, _, _ = _mesh_shardings(nt)
         usage = usage_override if usage_override is not None else d["usage"]
         usage = _chain_to_device(usage, node_sh)
         if len(prep.evict_rows):
@@ -405,8 +474,8 @@ class GenericStack:
                     and placed_counts is None and placed_hosts is None
                     and keep is None)
         if pristine and prep.dev_inputs is not None:
-            return kernels.place_batch(d["capacity"], d["score_cap"], usage,
-                                       *prep.dev_inputs)
+            kind, dev = prep.dev_inputs
+            return self._launch_device(d, usage, kind, dev, prep.n_valid)
 
         masks = prep.tg_masks
         if banned is not None and banned.any():
@@ -426,22 +495,16 @@ class GenericStack:
         else:
             hosts = np.zeros(nt.n_rows, dtype=bool)
 
-        # Every host array goes through the content-addressed transfer cache:
-        # a registration storm re-dispatches with byte-identical masks/demands/
-        # zero-count/zero-host arrays, so steady state pays ZERO host->device
-        # puts per eval (each put is a full RTT on remote-attached TPUs).
-        dev = (_dev_cache.get(masks, mask_sh),
-               _dev_cache.get(counts_now, node_sh),
-               _dev_cache.get(prep.demands, rep_sh),
-               _dev_cache.get(prep.tg_ids, rep_sh),
-               _dev_cache.get(sel_valid, rep_sh),
-               _dev_cache.get(prep.noise_vec, node_sh),
-               _dev_cache.get(np.float32(prep.penalty), rep_sh),
-               _dev_cache.get(np.asarray(prep.distinct), rep_sh),
-               _dev_cache.get(hosts, node_sh))
+        n_valid = int(sel_valid.sum()) if keep is not None else prep.n_valid
+        kind = self._device_kind(prep, n_valid)
+        dev = self._assemble_dev(
+            kind, prep, masks, counts_now, prep.tg_ids, sel_valid, hosts,
+            reset=(np.zeros(prep.p_pad, dtype=bool) if kind == "keyed"
+                   else None),
+            demands=prep.demands)
         if pristine:
-            prep.dev_inputs = dev
-        return kernels.place_batch(d["capacity"], d["score_cap"], usage, *dev)
+            prep.dev_inputs = (kind, dev)
+        return self._launch_device(d, usage, kind, dev, n_valid)
 
     def dispatch_multi(self, prep: PreparedBatch, n_evals: int,
                        usage_override=None, tables: Optional[dict] = None):
@@ -458,7 +521,7 @@ class GenericStack:
         jit compiles one program per bucket, not per window fill."""
         nt = self.tindex.nt
         d = tables if tables is not None else nt.device_arrays()
-        node_sh, mask_sh, rep_sh = _mesh_shardings(nt)
+        node_sh, _, _ = _mesh_shardings(nt)
         usage = usage_override if usage_override is not None else d["usage"]
         usage = _chain_to_device(usage, node_sh)
 
@@ -466,7 +529,6 @@ class GenericStack:
         p = prep.p_pad
         # Tiled per-placement inputs: byte-identical across a storm's
         # windows, so the content-addressed cache uploads them once.
-        demands = np.tile(prep.demands, (e_pad, 1))
         tg_ids = np.tile(prep.tg_ids, e_pad)
         valid = np.tile(prep.valid, e_pad)
         valid[n_evals * p:] = False  # padding evals place nothing
@@ -474,18 +536,18 @@ class GenericStack:
         reset[::p] = True
         hosts = np.zeros(nt.n_rows, dtype=bool)
 
-        dev = (_dev_cache.get(prep.tg_masks, mask_sh),
-               _dev_cache.get(prep.job_counts, node_sh),
-               _dev_cache.get(demands, rep_sh),
-               _dev_cache.get(tg_ids, rep_sh),
-               _dev_cache.get(valid, rep_sh),
-               _dev_cache.get(prep.noise_vec, node_sh),
-               _dev_cache.get(np.float32(prep.penalty), rep_sh),
-               _dev_cache.get(np.asarray(prep.distinct), rep_sh),
-               _dev_cache.get(hosts, node_sh),
-               _dev_cache.get(reset, rep_sh))
-        res = kernels.place_batch_multi(d["capacity"], d["score_cap"],
-                                        usage, *dev)
+        n_valid = n_evals * prep.n_valid
+        kind = self._device_kind(prep, n_valid)
+        dev = self._assemble_dev(
+            kind, prep, prep.tg_masks, prep.job_counts, tg_ids, valid,
+            hosts, reset=reset,
+            demands=(None if kind == "keyed"
+                     else np.tile(prep.demands, (e_pad, 1))))
+        if kind == "keyed":
+            res = self._launch_device(d, usage, kind, dev, n_valid)
+        else:
+            res = kernels.place_batch_multi(d["capacity"], d["score_cap"],
+                                            usage, *dev)
         return res, e_pad
 
     def dispatch_host(self, prep: PreparedBatch, usage_override=None,

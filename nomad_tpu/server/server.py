@@ -162,6 +162,10 @@ class Server:
             self.raft = DevRaft(self.fsm)
         self.state: StateStore = self.fsm.state
         self.tindex = TensorIndex.attach(self.state)
+        # host_placement=False must force the DEVICE kernel everywhere —
+        # including the per-eval slow path's select_batch — so the
+        # multichip dry run proves the SPMD path end to end.
+        self.tindex.allow_host_select = self.config.host_placement
         if self.config.scheduler_mesh:
             if self.config.scheduler_mesh != "all":
                 raise ValueError(

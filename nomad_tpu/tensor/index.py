@@ -27,6 +27,10 @@ class TensorIndex:
         # True when subscribed to a store's change feed (stays in sync and
         # must not be discarded on state refresh).
         self.attached = False
+        # Mirrors ServerConfig.host_placement: False forces every stack
+        # sharing this index onto the device kernels, including the
+        # per-eval slow path (the multichip dry run relies on it).
+        self.allow_host_select = True
 
     @staticmethod
     def attach(store: StateStore) -> "TensorIndex":

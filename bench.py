@@ -46,7 +46,11 @@ N_PARTITIONS = 64
 # first (measured: 2 workers ~30 evals/s vs 1 worker ~130-230 at 400-eval
 # reps).
 N_WORKERS = int(os.environ.get("BENCH_WORKERS", 1))
-WINDOW = int(os.environ.get("BENCH_WINDOW", 256))
+# 64-eval windows measured best end-to-end in round 5: deep (256-eval)
+# windows serialize ~4x the scan steps per drain on the device chain,
+# while small windows amortize the tunnel RTT via the dispatch-time
+# async host-copy. See PROGRESS notes; p50 also improves (~19ms).
+WINDOW = int(os.environ.get("BENCH_WINDOW", 64))
 N_REPS = int(os.environ.get("BENCH_REPS", 7))
 CPU_REF_EVALS = int(os.environ.get("BENCH_CPU_EVALS", 8))
 C5_NODES = int(os.environ.get("BENCH_C5_NODES", 50_000))

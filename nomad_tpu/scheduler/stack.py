@@ -29,7 +29,13 @@ from nomad_tpu.structs import (
     Resources,
     TaskGroup,
 )
-from nomad_tpu.structs.structs import ConstraintDistinctHosts, JobTypeBatch
+from nomad_tpu.structs.structs import (
+    AllocClientStatusPending,
+    AllocDesiredStatusRun,
+    ConstraintDistinctHosts,
+    JobTypeBatch,
+    generate_uuid,
+)
 from nomad_tpu.tensor import ClassEligibility, TensorIndex, alloc_vec, resources_vec
 from nomad_tpu.tensor.node_table import DIM_NAMES, RES_DIMS
 
@@ -679,6 +685,101 @@ class GenericStack:
             self._fill_metrics(prep, *last_fill)
         flush_placed()
         return failed_rows, next_remaining
+
+    def collect_build(self, prep: PreparedBatch, packed: np.ndarray,
+                      eval_id: str, job: Job, place,
+                      plan, failed_tg_allocs,
+                      window_usage: np.ndarray) -> bool:
+        """Fused collect + build_placement_allocs for the pipelined fast
+        path: ONE pass from packed kernel output to plan allocations,
+        skipping the SelectedOption list and the placed_counts/hosts
+        accumulators the windowed caller never reads (they exist for the
+        sync path's banned-row retry loop). Returns False when a winner
+        fails host-side network assignment or its node vanished — the
+        caller falls back to the exact per-eval path, same as a non-empty
+        failed_rows from collect()."""
+        nt = self.tindex.nt
+        chosen_list = packed[:, 0].astype(np.int32).tolist()
+        scores_list = packed[:, 1].tolist()
+        n_feasible = packed[:, 2]
+
+        node_of = nt.node_of
+        nodes_by_id = self._nodes_by_id
+        tg_index = prep.tg_index
+        tgs = prep.tgs
+        metrics_ = self.ctx.metrics
+        score_node = metrics_.score_node
+
+        allocs: List[Allocation] = []
+        placed_rows: List[int] = []
+        placed_ps: List[int] = []
+        failed_counts: Dict[str, int] = {}
+        last_fill = None
+
+        def flush_placed():
+            # Exhaustion diagnostics read window_usage, so the batched
+            # accumulation must land before any _note_exhaustion.
+            if placed_rows:
+                np.add.at(window_usage,
+                          np.asarray(placed_rows, dtype=np.int64),
+                          prep.demands[placed_ps])
+                placed_rows.clear()
+                placed_ps.clear()
+
+        for p, tup in enumerate(place):
+            row = chosen_list[p]
+            tg = tgs[p]
+            ti = tg_index[tg.Name]
+            last_fill = (ti, int(n_feasible[p]))
+            if row < 0:
+                self._fill_metrics(prep, ti, int(n_feasible[p]))
+                flush_placed()
+                self._note_exhaustion(tg, prep.tg_masks[ti],
+                                      prep.tg_demands[ti], prep,
+                                      window_usage)
+                # Snapshots are deferred to after the final _fill_metrics
+                # so FailedTGAllocs carries the same end-state metrics the
+                # sync path's build_placement_allocs records.
+                failed_counts[tg.Name] = failed_counts.get(tg.Name, 0) + 1
+                continue
+            node = nodes_by_id.get(node_of[row])
+            if node is None:
+                return False
+            option = self._assign_networks(node, tg, scores_list[p])
+            if option is None:
+                return False
+            score_node(node, "binpack", scores_list[p])
+            placed_rows.append(row)
+            placed_ps.append(p)
+            allocs.append(Allocation(
+                ID=generate_uuid(),
+                EvalID=eval_id,
+                Name=tup.Name,
+                JobID=job.ID,
+                TaskGroup=tg.Name,
+                NodeID=node.ID,
+                TaskResources=option.task_resources,
+                DesiredStatus=AllocDesiredStatusRun,
+                ClientStatus=AllocClientStatusPending,
+            ))
+        if last_fill is not None:
+            self._fill_metrics(prep, *last_fill)
+        flush_placed()
+        for name, count in failed_counts.items():
+            metric = failed_tg_allocs.get(name)
+            if metric is None:
+                metric = failed_tg_allocs[name] = metrics_.copy()
+                count -= 1
+            metric.CoalescedFailures += count
+        if allocs:
+            # Scoring is final now: one immutable metric snapshot shared
+            # by every placed alloc (reference: alloc.Metrics).
+            shared_metric = metrics_.copy()
+            append_alloc = plan.append_alloc
+            for alloc in allocs:
+                alloc.Metrics = shared_metric
+                append_alloc(alloc)
+        return True
 
     # ------------------------------------------------------------- helpers
     def _eviction_deltas(self) -> Tuple[np.ndarray, np.ndarray]:

@@ -55,7 +55,6 @@ import numpy as np
 from nomad_tpu.scheduler.context import EvalContext
 from nomad_tpu.scheduler.generic_sched import (
     _HANDLED_TRIGGERS,
-    build_placement_allocs,
     class_eligibility,
     filter_complete_allocs,
     has_escaped,
@@ -520,6 +519,24 @@ class PipelinedWorker(Worker):
         pend_ids = {id(r) for r in pend}
         launched = [r for r in fast if id(r) not in pend_ids]
         fast = launched + [r for r in pend if not r.fallback]
+        # Start the device->host copies NOW (async): the drain stage's
+        # blocking fetch otherwise pays kernel time PLUS a full tunnel
+        # round trip per window, serialized. With the copy enqueued behind
+        # the window's kernels at dispatch time, the RTT overlaps the next
+        # window's compute and the drain finds the bytes already en route.
+        # Only fused parents benefit: the drain fetches them directly,
+        # while singleton device results get stacked into a fresh array
+        # first — pre-copying those would be dead tunnel traffic.
+        seen_packed = set()
+        for r in fast:
+            parent = getattr(r.res, "parent", None)
+            if parent is None or id(parent.packed) in seen_packed:
+                continue
+            seen_packed.add(id(parent.packed))
+            try:
+                parent.packed.copy_to_host_async()
+            except Exception:
+                pass  # fetch still works without the head start
         self.stats["t_launch_ms"] = self.stats.get("t_launch_ms", 0.0) \
             + (time.perf_counter() - tl0) * 1e3
 
@@ -706,30 +723,22 @@ class PipelinedWorker(Worker):
             if rec.stale:
                 continue  # redelivered between stages: abandoned
             tc0 = time.perf_counter()
-            results = [None] * len(rec.prep.tgs)
-            placed_counts = np.zeros(nt.n_rows, dtype=np.int32)
-            placed_hosts = np.zeros(nt.n_rows, dtype=bool)
             try:
-                failed_rows, _ = rec.stack.collect(
-                    rec.prep, pk, results, range(len(rec.prep.tgs)),
-                    window_usage, placed_counts, placed_hosts)
+                ok = rec.stack.collect_build(
+                    rec.prep, pk, rec.ev.ID, rec.plan.Job, rec.place,
+                    rec.plan, rec.failed_tg_allocs, window_usage)
             except Exception:
                 logger.exception("collect failed for eval %s", rec.ev.ID)
                 rec.fallback = True
                 continue
-            if failed_rows:
-                # Port collision against the cached index: rare; the sync
-                # path's banned-row retry loop owns it.
+            if not ok:
+                # Port collision against the cached index (or a node that
+                # vanished mid-window): rare; the sync path's banned-row
+                # retry loop owns it.
                 rec.fallback = True
                 continue
-            tc1 = time.perf_counter()
             self.stats["t_collect_ms"] = self.stats.get("t_collect_ms", 0.0) \
-                + (tc1 - tc0) * 1e3
-            build_placement_allocs(rec.ev, rec.plan.Job, rec.ctx,
-                                   rec.place, results, rec.plan,
-                                   rec.failed_tg_allocs)
-            self.stats["t_bpa_ms"] = self.stats.get("t_bpa_ms", 0.0) \
-                + (time.perf_counter() - tc1) * 1e3
+                + (time.perf_counter() - tc0) * 1e3
             if rec.plan.is_no_op() and not rec.failed_tg_allocs:
                 rec.fallback = True  # nothing placeable; let sync path decide
                 continue

@@ -23,6 +23,7 @@ from __future__ import annotations
 import threading
 import weakref
 from bisect import bisect_right
+from dataclasses import replace
 from typing import Any, Callable, Dict, Iterable, List, Optional, Set, Tuple
 
 from nomad_tpu.structs import (
@@ -585,9 +586,11 @@ class StateStore(_ReadAPI):
             new_status = force or self._derive_job_status(job, eval_delete)
             if job.Status == new_status:
                 continue
-            updated = job.copy()
-            updated.Status = new_status
-            updated.ModifyIndex = index
+            # Committed jobs are value-frozen: share the nested task tree
+            # and replace only the scalars that change. A deepcopy here
+            # walks the whole job (~1ms) inside the serialized FSM apply,
+            # once per eval at storm rates.
+            updated = replace(job, Status=new_status, ModifyIndex=index)
             self._tables["jobs"].write(index, job_id, updated)
             watch_items.add(Item(job=job_id))
             touched.append("jobs")

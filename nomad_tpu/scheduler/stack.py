@@ -714,6 +714,11 @@ class GenericStack:
         placed_rows: List[int] = []
         placed_ps: List[int] = []
         failed_counts: Dict[str, int] = {}
+        # Every alloc of a task group carries the same resource vector;
+        # pre-seeding the per-instance memo (immutable by contract) saves
+        # a resources_vec walk per alloc downstream (plan verify, usage
+        # listener, optimistic overlay).
+        shared_vecs: Dict[int, np.ndarray] = {}
         last_fill = None
 
         def flush_placed():
@@ -751,7 +756,7 @@ class GenericStack:
             score_node(node, "binpack", scores_list[p])
             placed_rows.append(row)
             placed_ps.append(p)
-            allocs.append(Allocation(
+            alloc = Allocation(
                 ID=generate_uuid(),
                 EvalID=eval_id,
                 Name=tup.Name,
@@ -761,7 +766,13 @@ class GenericStack:
                 TaskResources=option.task_resources,
                 DesiredStatus=AllocDesiredStatusRun,
                 ClientStatus=AllocClientStatusPending,
-            ))
+            )
+            vec = shared_vecs.get(ti)
+            if vec is None:
+                shared_vecs[ti] = alloc_vec(alloc)
+            else:
+                alloc._resvec_cache = vec
+            allocs.append(alloc)
         if last_fill is not None:
             self._fill_metrics(prep, *last_fill)
         flush_placed()

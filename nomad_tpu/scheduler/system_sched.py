@@ -34,7 +34,7 @@ from nomad_tpu.structs.structs import (
     EvalTriggerJobRegister,
     EvalTriggerNodeUpdate,
 )
-from nomad_tpu.tensor import TensorIndex
+from nomad_tpu.tensor import TensorIndex, alloc_vec
 
 from .context import EvalContext
 from .scheduler import Planner, SetStatusError, State
@@ -53,6 +53,14 @@ from .util import (
 )
 
 MAX_SYSTEM_SCHEDULE_ATTEMPTS = 5
+
+# A 10k-node system sweep produces one monolithic plan whose verify+apply
+# monopolizes the applier for hundreds of ms. Chunking streams it through
+# the plan queue so verify(i+1) overlaps apply(i) and other evals' plans
+# interleave between chunks (reference anchor: plan_apply.go:41-119's
+# verify/apply overlap; the reference commits system sweeps whole, which
+# is exactly the latency cliff this avoids).
+SYSTEM_PLAN_CHUNK = 2048
 
 _HANDLED = (EvalTriggerJobRegister, EvalTriggerNodeUpdate,
             EvalTriggerJobDeregister)
@@ -116,7 +124,7 @@ class SystemScheduler:
         if self.plan.is_no_op():
             return True
 
-        result, new_state = self.planner.submit_plan(self.plan)
+        result, new_state = self._submit_chunked(self.plan)
         self.plan_result = result
         if new_state is not None:
             self.state = new_state
@@ -129,6 +137,71 @@ class SystemScheduler:
                               self.eval.ID, expected, actual)
             return False
         return True
+
+    def _submit_chunked(self, plan: Plan):
+        """Submit the sweep's plan in SYSTEM_PLAN_CHUNK-alloc chunks (node
+        boundaries preserved; evictions ride the first chunk) and merge the
+        results. Chunking exists for FAIRNESS: with other plans contending
+        for the applier, a 10k-alloc sweep would otherwise monopolize it
+        for hundreds of ms while interactive evals queue behind it. With
+        an empty queue the monolithic submit is strictly cheaper (chunk
+        verify/apply overhead buys nothing without contention), so small
+        plans and uncontended sweeps take the ordinary path."""
+        n_allocs = sum(len(v) for v in plan.NodeAllocation.values())
+        depth_fn = getattr(self.planner, "plan_queue_depth", None)
+        contended = depth_fn is not None and depth_fn() > 0
+        if n_allocs <= SYSTEM_PLAN_CHUNK or not contended:
+            return self.planner.submit_plan(plan)
+
+        chunks: List[Plan] = []
+        current = None
+        count = 0
+        # Each node's evictions travel WITH its placements so the per-node
+        # remove-then-add stays atomic in one chunk's verify — an eviction
+        # stranded in an earlier chunk would double-count capacity against
+        # the replacement under the one-sided optimistic overlay and force
+        # spurious partial commits on tight nodes. Evict-only nodes fill
+        # chunks like placements do (they count toward the budget, so a
+        # fleet-wide destructive update cannot recreate the monolithic
+        # plan as "chunk 0").
+        node_ids = list(dict.fromkeys(
+            list(plan.NodeAllocation) + list(plan.NodeUpdate)))
+        for node_id in node_ids:
+            if current is None or count >= SYSTEM_PLAN_CHUNK:
+                current = Plan(EvalID=plan.EvalID, Priority=plan.Priority,
+                               Job=plan.Job, AllAtOnce=plan.AllAtOnce)
+                chunks.append(current)
+                count = 0
+            placed = plan.NodeAllocation.get(node_id)
+            if placed:
+                current.NodeAllocation[node_id] = placed
+                count += len(placed)
+            updates = plan.NodeUpdate.get(node_id)
+            if updates:
+                current.NodeUpdate[node_id] = updates
+                count += len(updates)
+        chunks[0].Annotations = plan.Annotations
+
+        submit = getattr(self.planner, "submit_plans", None)
+        if submit is not None:
+            results, new_state = submit(chunks)
+        else:  # harness planners: sequential fallback
+            results = []
+            new_state = None
+            for chunk in chunks:
+                r, ns = self.planner.submit_plan(chunk)
+                results.append(r)
+                new_state = ns or new_state
+
+        merged = PlanResult()
+        for r in results:
+            if r is None:
+                return None, new_state
+            merged.NodeUpdate.update(r.NodeUpdate)
+            merged.NodeAllocation.update(r.NodeAllocation)
+            merged.RefreshIndex = max(merged.RefreshIndex, r.RefreshIndex)
+            merged.AllocIndex = max(merged.AllocIndex, r.AllocIndex)
+        return merged, new_state
 
     def _compute_job_allocs(self) -> None:
         """(reference: system_sched.go:165-216)"""
@@ -188,7 +261,13 @@ class SystemScheduler:
             # One shared metrics snapshot per TG (scoring is done by now;
             # a copy per alloc walks the metric maps P times — the same
             # O(P^2) the generic path's build_placement_allocs avoids).
+            # The resource vector is likewise identical for every alloc of
+            # a TG: computing it once and pre-seeding the per-instance
+            # memo saves a resources_vec walk per alloc in the plan
+            # applier, the usage listener, and the optimistic overlay
+            # (the memo contract forbids mutation, so sharing is safe).
             shared_metric = None
+            shared_vec = None
             for (tup, node), option in zip(pairs, options):
                 if option is None:
                     metric = self.failed_tg_allocs.get(tup.TaskGroup.Name)
@@ -212,4 +291,8 @@ class SystemScheduler:
                     DesiredStatus=AllocDesiredStatusRun,
                     ClientStatus=AllocClientStatusPending,
                 )
+                if shared_vec is None:
+                    shared_vec = alloc_vec(alloc)
+                else:
+                    alloc._resvec_cache = shared_vec
                 self.plan.append_alloc(alloc)

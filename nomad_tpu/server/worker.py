@@ -82,6 +82,19 @@ class LocalBackend:
         self.eval_broker.outstanding_reset(plan.EvalID, plan.EvalToken)
         return pending.wait(timeout=PLAN_WAIT)
 
+    def submit_plans(self, plans: List[Plan]) -> List[Optional[PlanResult]]:
+        """Pipelined multi-plan submit (chunked system sweeps): every chunk
+        enters the plan queue up front, so the applier verifies chunk i+1
+        while chunk i commits; the caller then blocks one chunk at a time
+        (reference model: plan_apply.go's verify/apply overlap, applied
+        across one eval's chunks instead of across evals)."""
+        pendings = [self.plan_queue.enqueue(p) for p in plans]
+        out = []
+        for plan, pending in zip(plans, pendings):
+            self.eval_broker.outstanding_reset(plan.EvalID, plan.EvalToken)
+            out.append(pending.wait(timeout=PLAN_WAIT))
+        return out
+
     def eval_update(self, evals: List[Evaluation], token: str,
                     reset_id: str) -> None:
         if reset_id:
@@ -362,6 +375,37 @@ class Worker:
             self._wait_for_index(result.RefreshIndex)
             state = self.raft.fsm.state.snapshot()
         return result, state
+
+    def plan_queue_depth(self) -> int:
+        """Pending plans contending for the applier — the system
+        scheduler's chunk-or-not signal."""
+        try:
+            return self.backend.plan_queue.stats["Depth"]
+        except AttributeError:
+            return 0  # remote backend: no local queue visibility
+
+    def submit_plans(self, plans: List[Plan]
+                     ) -> Tuple[List[Optional[PlanResult]], Optional[object]]:
+        """Chunked-plan Planner seam: pipelined queue entry, one refresh
+        wait for the highest RefreshIndex across chunks."""
+        start = time.monotonic()
+        for plan in plans:
+            plan.EvalToken = self._token
+        try:
+            submit = getattr(self.backend, "submit_plans", None)
+            if submit is not None:
+                results = submit(plans)
+            else:
+                results = [self.backend.submit_plan(p) for p in plans]
+        finally:
+            metrics.measure_since(("nomad", "worker", "submit_plan"), start)
+        refresh = max((r.RefreshIndex for r in results if r is not None),
+                      default=0)
+        state = None
+        if refresh > 0:
+            self._wait_for_index(refresh)
+            state = self.raft.fsm.state.snapshot()
+        return results, state
 
     def update_eval(self, ev: Evaluation) -> None:
         """(reference: worker.go:345-371)"""

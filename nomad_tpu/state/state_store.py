@@ -495,6 +495,7 @@ class StateStore(_ReadAPI):
             # hashing a frozen 9-field Item costs ~10x a str.
             evals: set = set()
             nodes: set = set()
+            nonterminal_jobs: set = set()
             for alloc in allocs:
                 existing = self._get("allocs", alloc.ID)
                 if existing is None:
@@ -518,6 +519,8 @@ class StateStore(_ReadAPI):
                 evals.add(alloc.EvalID)
                 nodes.add(alloc.NodeID)
                 jobs.setdefault(alloc.JobID, "")
+                if not alloc.terminal_status():
+                    nonterminal_jobs.add(alloc.JobID)
                 events.append(("alloc", existing, alloc))
             for ev_id in evals:
                 watch_items.add(Item(alloc_eval=ev_id))
@@ -525,6 +528,15 @@ class StateStore(_ReadAPI):
                 watch_items.add(Item(alloc_job=job_id))
             for node_id in nodes:
                 watch_items.add(Item(alloc_node=node_id))
+            # A RUNNING job that just received a non-terminal alloc cannot
+            # change status (one live alloc <=> running): skip the
+            # derivation, which walks every alloc of the job — O(fleet)
+            # per chunk for 10k-alloc system sweeps.
+            for job_id in nonterminal_jobs:
+                if job_id in jobs:
+                    job = self._get("jobs", job_id)
+                    if job is not None and job.Status == JobStatusRunning:
+                        del jobs[job_id]
             touched = self._set_job_statuses(index, watch_items, jobs,
                                              eval_delete=False)
             self._commit(index, ["allocs"] + touched, watch_items)

@@ -154,3 +154,147 @@ class TestSystemSchedScenarios:
         assert len(stopped) == 1
         assert stopped[0].NodeID == victim.ID
         assert placed(h) == []  # system jobs don't migrate off-node
+
+class TestSystemPlanChunking:
+    """A 10k-alloc system sweep must not monopolize the plan applier when
+    other plans are contending: the scheduler streams it in
+    SYSTEM_PLAN_CHUNK-alloc chunks (reference frame: plan_apply.go's
+    verify/apply overlap; system_sched.go:54-281 commits sweeps whole,
+    which is the latency cliff this avoids)."""
+
+    def _sweep_plan(self, n_nodes, per_node=1):
+        import logging
+        import random
+
+        from nomad_tpu.scheduler.system_sched import SystemScheduler
+        from nomad_tpu.state.state_store import StateStore
+        from nomad_tpu.structs import compute_node_class
+        from nomad_tpu.tensor import TensorIndex
+
+        store = StateStore()
+        tindex = TensorIndex.attach(store)
+        idx = 1
+        for _ in range(n_nodes):
+            n = mock.node()
+            compute_node_class(n)
+            idx += 1
+            store.upsert_node(idx, n)
+        job = mock.system_job()
+        t = job.TaskGroups[0].Tasks[0]
+        t.Resources.Networks = []
+        t.Services = []
+        job.init_fields()
+        idx += 1
+        store.upsert_job(idx, job)
+        ev = make_eval(job)
+        sched = SystemScheduler(store, None, tindex,
+                                logging.getLogger("test"),
+                                rng=random.Random(1))
+        sched.eval = ev
+        return sched, job, ev
+
+    def test_contended_sweep_chunks_and_merges(self):
+        from nomad_tpu.scheduler import system_sched as ss
+        from nomad_tpu.structs import PlanResult
+
+        class Capture:
+            def __init__(self, depth):
+                self.depth = depth
+                self.batches = []
+
+            def plan_queue_depth(self):
+                return self.depth
+
+            def _result(self, plan):
+                r = PlanResult()
+                r.NodeUpdate = dict(plan.NodeUpdate)
+                r.NodeAllocation = dict(plan.NodeAllocation)
+                r.AllocIndex = len(self.batches)
+                return r
+
+            def submit_plan(self, plan):
+                self.batches.append([plan])
+                return self._result(plan), None
+
+            def submit_plans(self, plans):
+                self.batches.append(list(plans))
+                return [self._result(p) for p in plans], None
+
+            def update_eval(self, e): ...
+            def create_eval(self, e): ...
+            def reblock_eval(self, e): ...
+
+        n_nodes = ss.SYSTEM_PLAN_CHUNK + 64  # 2 chunks when contended
+        for depth, want_plans in ((0, 1), (3, 2)):
+            sched, job, ev = self._sweep_plan(n_nodes)
+            planner = Capture(depth)
+            sched.planner = planner
+            sched._process()
+            assert len(planner.batches) == 1
+            plans = planner.batches[0]
+            assert len(plans) == want_plans, (depth, len(plans))
+            total = sum(len(v) for p in plans
+                        for v in p.NodeAllocation.values())
+            assert total == n_nodes
+            if want_plans > 1:
+                # Node boundaries preserved: no node split across chunks,
+                # and the merged result covers the whole sweep.
+                seen = set()
+                for p in plans:
+                    for nid in p.NodeAllocation:
+                        assert nid not in seen
+                        seen.add(nid)
+                assert len(seen) == n_nodes
+                assert sum(
+                    len(v) for v in
+                    sched.plan_result.NodeAllocation.values()) == n_nodes
+
+    def test_interactive_eval_interleaves_with_sweep(self, monkeypatch):
+        """Live server: a small service eval submitted behind a fleet-wide
+        system sweep completes without waiting for the whole sweep. The
+        chunk size is pinned low and the contention check forced on so the
+        sweep actually exercises the live submit_plans pipelining
+        (enqueue-all, wait-in-order) rather than the monolithic path."""
+        from nomad_tpu import mock as m
+        from nomad_tpu.scheduler import system_sched as ss
+        from nomad_tpu.server import Server, ServerConfig
+        from nomad_tpu.server.worker import Worker
+        from nomad_tpu.structs import compute_node_class
+
+        from helpers import wait_for
+
+        monkeypatch.setattr(ss, "SYSTEM_PLAN_CHUNK", 16)
+        monkeypatch.setattr(Worker, "plan_queue_depth", lambda self: 1)
+        srv = Server(ServerConfig(num_schedulers=2,
+                                  pipelined_scheduling=True,
+                                  scheduler_window=8,
+                                  min_heartbeat_ttl=3600.0,
+                                  heartbeat_grace=3600.0))
+        srv.establish_leadership()
+        try:
+            for _ in range(64):
+                n = m.node()
+                compute_node_class(n)
+                srv.node_register(n)
+            sysjob = m.system_job()
+            t = sysjob.TaskGroups[0].Tasks[0]
+            t.Resources.DiskMB = 300
+            t.Resources.Networks = []
+            t.Services = []
+            sys_eval = srv.job_register(sysjob)[0]
+            svc = m.job()
+            svc.TaskGroups[0].Count = 2
+            t = svc.TaskGroups[0].Tasks[0]
+            t.Resources.CPU = 20
+            t.Resources.MemoryMB = 32
+            t.Resources.Networks = []
+            t.Services = []
+            svc_eval = srv.job_register(svc)[0]
+            wait_for(lambda: all(
+                (e := srv.state.eval_by_id(i)) is not None
+                and e.Status == EvalStatusComplete
+                for i in (sys_eval, svc_eval)), timeout=60)
+            assert len(list(srv.state.allocs_by_eval(sys_eval))) == 64
+            assert len(list(srv.state.allocs_by_eval(svc_eval))) == 2
+        finally:
+            srv.shutdown()

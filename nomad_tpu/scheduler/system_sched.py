@@ -131,6 +131,10 @@ class SystemScheduler:
             if self.tindex is not None and not self.tindex.attached:
                 self.tindex = None
             return False
+        if result is None:
+            # Planner declined (e.g. a cancelled chunk after a wait
+            # failure): count as a no-progress attempt, don't deref.
+            return False
         full_commit, expected, actual = result.full_commit(self.plan)
         if not full_commit:
             self.logger.debug("eval %s: attempted %d placements, %d placed",
@@ -140,17 +144,21 @@ class SystemScheduler:
 
     def _submit_chunked(self, plan: Plan):
         """Submit the sweep's plan in SYSTEM_PLAN_CHUNK-alloc chunks (node
-        boundaries preserved; evictions ride the first chunk) and merge the
-        results. Chunking exists for FAIRNESS: with other plans contending
-        for the applier, a 10k-alloc sweep would otherwise monopolize it
-        for hundreds of ms while interactive evals queue behind it. With
-        an empty queue the monolithic submit is strictly cheaper (chunk
-        verify/apply overhead buys nothing without contention), so small
-        plans and uncontended sweeps take the ordinary path."""
+        boundaries preserved; each node's evictions ride the same chunk as
+        its placements) and merge the results. Chunking exists for
+        FAIRNESS: with other plans contending for the applier, a 10k-alloc
+        sweep would otherwise monopolize it for hundreds of ms while
+        interactive evals queue behind it. With an empty queue the
+        monolithic submit is strictly cheaper (chunk verify/apply overhead
+        buys nothing without contention), so small plans and uncontended
+        sweeps take the ordinary path — as do AllAtOnce plans, whose
+        all-or-nothing contract the applier enforces per plan and which
+        chunking would silently weaken to per-chunk."""
         n_allocs = sum(len(v) for v in plan.NodeAllocation.values())
         depth_fn = getattr(self.planner, "plan_queue_depth", None)
         contended = depth_fn is not None and depth_fn() > 0
-        if n_allocs <= SYSTEM_PLAN_CHUNK or not contended:
+        if n_allocs <= SYSTEM_PLAN_CHUNK or not contended \
+                or plan.AllAtOnce:
             return self.planner.submit_plan(plan)
 
         chunks: List[Plan] = []
@@ -196,7 +204,7 @@ class SystemScheduler:
         merged = PlanResult()
         for r in results:
             if r is None:
-                return None, new_state
+                return None, new_state  # _process treats None as a retry
             merged.NodeUpdate.update(r.NodeUpdate)
             merged.NodeAllocation.update(r.NodeAllocation)
             merged.RefreshIndex = max(merged.RefreshIndex, r.RefreshIndex)

@@ -362,6 +362,15 @@ class PlanApplier:
                         batch.append(nxt)
                 except RuntimeError:
                     return  # queue disabled
+                live = []
+                for p in batch:
+                    if p.cancelled:
+                        # Abandoned chunk (its submitter's earlier chunk
+                        # failed): answer the future, commit nothing.
+                        p.respond(None, RuntimeError("plan cancelled"))
+                    else:
+                        live.append(p)
+                batch = live
                 if not batch:
                     continue
 

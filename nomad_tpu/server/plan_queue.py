@@ -25,6 +25,7 @@ class PendingPlan:
         self._event = threading.Event()
         self._result: Optional[PlanResult] = None
         self._error: Optional[Exception] = None
+        self.cancelled = False
 
     def wait(self, timeout: Optional[float] = None) -> PlanResult:
         if not self._event.wait(timeout):
@@ -38,6 +39,13 @@ class PendingPlan:
         self._result = result
         self._error = error
         self._event.set()
+
+    def cancel(self) -> None:
+        """Mark a still-queued plan abandoned (a chunked submit whose
+        earlier chunk failed): the applier skips it at dequeue instead of
+        committing work nobody is waiting on. Best-effort — a plan the
+        applier already picked up still lands."""
+        self.cancelled = True
 
 
 class PlanQueue:

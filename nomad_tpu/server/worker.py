@@ -83,16 +83,35 @@ class LocalBackend:
         return pending.wait(timeout=PLAN_WAIT)
 
     def submit_plans(self, plans: List[Plan]) -> List[Optional[PlanResult]]:
-        """Pipelined multi-plan submit (chunked system sweeps): every chunk
-        enters the plan queue up front, so the applier verifies chunk i+1
-        while chunk i commits; the caller then blocks one chunk at a time
-        (reference model: plan_apply.go's verify/apply overlap, applied
-        across one eval's chunks instead of across evals)."""
-        pendings = [self.plan_queue.enqueue(p) for p in plans]
-        out = []
-        for plan, pending in zip(plans, pendings):
-            self.eval_broker.outstanding_reset(plan.EvalID, plan.EvalToken)
-            out.append(pending.wait(timeout=PLAN_WAIT))
+        """Pipelined multi-plan submit (chunked system sweeps) with a
+        bounded in-queue depth of TWO chunks: enough for the applier to
+        verify chunk i+1 while chunk i commits (reference model:
+        plan_apply.go's verify/apply overlap), but never the whole sweep —
+        the queue orders same-priority plans by arrival, so enqueueing all
+        chunks up front would recreate exactly the head-of-line blocking
+        chunking exists to break. A competing plan arriving mid-sweep now
+        waits at most ~2 chunks. If a wait fails mid-sequence, the chunks
+        still in the queue are cancelled so they cannot commit behind the
+        retrying scheduler's back (a chunk already picked up by the
+        applier may still land — the same single-window race the
+        monolithic path has)."""
+        out: List[Optional[PlanResult]] = []
+        in_flight: List = []
+        next_i = 0
+        try:
+            while next_i < len(plans) or in_flight:
+                while len(in_flight) < 2 and next_i < len(plans):
+                    in_flight.append(
+                        self.plan_queue.enqueue(plans[next_i]))
+                    next_i += 1
+                pending = in_flight.pop(0)
+                self.eval_broker.outstanding_reset(
+                    pending.plan.EvalID, pending.plan.EvalToken)
+                out.append(pending.wait(timeout=PLAN_WAIT))
+        except Exception:
+            for pending in in_flight:
+                pending.cancel()
+            raise
         return out
 
     def eval_update(self, evals: List[Evaluation], token: str,

@@ -388,6 +388,7 @@ class Agent:
             "Client": self.config.client_enabled,
             "DevMode": self.config.dev_mode,
             "DataDir": self.config.data_dir,
+            "EnableDebug": self.config.enable_debug,
         }
 
     def member_info(self) -> dict:

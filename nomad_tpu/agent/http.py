@@ -85,6 +85,15 @@ def _make_handler(agent):
 
         def _respond(self, obj: Any, index: Optional[int] = None,
                      code: int = 200) -> None:
+            if isinstance(obj, bytes):
+                # Binary payloads (the cProfile-compatible profile blob):
+                # no JSON wrapping, no gzip (already dense marshal data).
+                self.send_response(code)
+                self.send_header("Content-Type", "application/octet-stream")
+                self.send_header("Content-Length", str(len(obj)))
+                self.end_headers()
+                self.wfile.write(obj)
+                return
             body = json.dumps(obj).encode()
             self.send_response(code)
             self.send_header("Content-Type", "application/json")
@@ -156,6 +165,59 @@ def _make_handler(agent):
 
 
 # ---------------------------------------------------------------- routing
+
+
+def _capture_profile(seconds: float, period: float = 0.005) -> bytes:
+    """Sample every live thread's Python stack for `seconds` and return a
+    pstats-compatible marshal blob (the format cProfile dumps and
+    pstats.Stats loads). Per function: ct approximates wall time anywhere
+    on a stack, tt time at the top of one; call counts are sample counts.
+    Sampling (vs tracing) is the only approach that can observe every
+    server thread without instrumenting them — the same trade the
+    reference's pprof CPU profile makes."""
+    import marshal
+
+    # {(file, line, name): [cc, nc, tt, ct, {caller: ...}]}
+    stats: Dict[tuple, list] = {}
+    deadline = time.monotonic() + seconds
+    me = threading.get_ident()
+    n_samples = 0
+    last = time.monotonic()
+    while True:
+        now = time.monotonic()
+        # Credit the MEASURED inter-sample gap, not the nominal period:
+        # under GIL contention or deep stacks the real gap stretches well
+        # past the sleep, and a fixed credit would undercount wall time.
+        dt = now - last
+        last = now
+        for tid, frame in sys._current_frames().items():
+            if tid == me:
+                continue
+            top = True
+            seen = set()
+            while frame is not None:
+                code = frame.f_code
+                key = (code.co_filename, code.co_firstlineno, code.co_name)
+                ent = stats.get(key)
+                if ent is None:
+                    ent = stats[key] = [0, 0, 0.0, 0.0, {}]
+                ent[0] += 1
+                ent[1] += 1
+                if top:
+                    ent[2] += dt
+                    top = False
+                if key not in seen:  # recursion: count wall time once
+                    ent[3] += dt
+                    seen.add(key)
+                frame = frame.f_back
+        n_samples += 1
+        if now >= deadline:
+            break
+        time.sleep(period)
+    stats[("~", 0, f"<sampling-profile {n_samples} samples "
+           f"@{period * 1e3:g}ms>")] = [n_samples, n_samples, 0.0, 0.0, {}]
+    return marshal.dumps({k: tuple(v[:4]) + (v[4],)
+                          for k, v in stats.items()})
 
 
 def _parse_wait(query) -> Tuple[int, float]:
@@ -597,6 +659,22 @@ def route(agent, method: str, path: str, query, get_body):
                 continue
             stacks[f"{t.name} ({t.ident})"] = traceback.format_stack(frame)
         return stacks, None
+
+    if path == "/v1/agent/debug/profile":
+        # Whole-process CPU profile capture, the analogue of the
+        # reference's pprof CPU endpoint (command/agent/http.go:133-139,
+        # mounted only under enable_debug). A tracing profiler would need
+        # a hook in every server thread; instead a sampler walks
+        # sys._current_frames() for `seconds` (5ms period) and synthesizes
+        # a standard pstats marshal blob — load it with
+        # pstats.Stats(path_to_saved_body). Sample counts scale to
+        # seconds: ct ~ wall time a function was anywhere on a stack,
+        # tt ~ time it was at the top.
+        if not getattr(agent.config, "enable_debug", False):
+            raise CodedError(404, "debug endpoints disabled "
+                                  "(set enable_debug)")
+        seconds = min(float(query.get("seconds", ["2"])[0]), 30.0)
+        return _capture_profile(seconds), None
 
     if path == "/v1/agent/metrics":
         # In-memory telemetry snapshot (reference shape: go-metrics

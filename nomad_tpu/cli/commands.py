@@ -702,7 +702,12 @@ def cmd_force_leave(args) -> int:
 
 def cmd_agent_info(args) -> int:
     client = _client(args)
-    print(json.dumps(client.agent.self(), indent=2))
+    info = client.agent.self()
+    print(json.dumps(info, indent=2))
+    if info.get("config", {}).get("EnableDebug"):
+        print("# debug endpoints: /v1/agent/debug/stacks (thread dump), "
+              "/v1/agent/debug/profile?seconds=N (CPU profile; save the "
+              "body and load with python -m pstats)", file=sys.stderr)
     return 0
 
 

@@ -3,6 +3,7 @@
 from __future__ import annotations
 
 import json
+import logging
 import os
 import signal
 import subprocess
@@ -80,13 +81,18 @@ class DriverHandle:
 
 class ConfigField:
     """One driver-config field: type + required (reference: the FieldSchema
-    entries in helper/fields/type.go)."""
+    entries in helper/fields/type.go). implemented=False accepts a
+    reference-valid key this driver does not (yet) act on: the job
+    validates — compatibility with reference job specs — but a warning
+    records that the option is ignored."""
 
-    __slots__ = ("type", "required")
+    __slots__ = ("type", "required", "implemented")
 
-    def __init__(self, type: str, required: bool = False):
+    def __init__(self, type: str, required: bool = False,
+                 implemented: bool = True):
         self.type = type
         self.required = required
+        self.implemented = implemented
 
 
 def _field_type_ok(value: Any, ftype: str) -> bool:
@@ -161,6 +167,9 @@ def config_bool(value: Any, default: bool = False) -> bool:
     return bool(value)
 
 
+_WARNED_IGNORED: set = set()
+
+
 class ConfigSchema:
     """Mini field-schema for driver task configs (reference:
     helper/fields/type.go FieldSchema maps, used by each driver's
@@ -184,6 +193,17 @@ class ConfigSchema:
             elif value is not None and not _field_type_ok(value, f.type):
                 errs.append(
                     f"config key {key!r}{tag} must be a {f.type}")
+            elif not f.implemented:
+                # Once per (driver, key) per process: validation re-runs
+                # on every task start/restart, and a crash-looping task
+                # must not spam the client log with the same notice.
+                mark = (driver, key)
+                if mark not in _WARNED_IGNORED:
+                    _WARNED_IGNORED.add(mark)
+                    logging.getLogger("nomad.driver").warning(
+                        "config key %r%s is accepted for reference "
+                        "compatibility but not implemented; it is "
+                        "ignored", key, tag)
         if errs:
             raise ValueError("; ".join(errs))
 

@@ -308,8 +308,9 @@ class DockerDriver(Driver):
         except Exception:
             return False
 
-    # (reference: client/driver/docker.go:116-140 Validate's fields map;
-    # keys limited to what this driver implements)
+    # (reference: client/driver/docker.go:167-226 Validate's fields map —
+    # the FULL reference key set so reference job specs validate;
+    # implemented=False keys are accepted with an "ignored" warning)
     schema = ConfigSchema(
         image=ConfigField("string", required=True),
         command=ConfigField("string"),
@@ -318,6 +319,18 @@ class DockerDriver(Driver):
         auth=ConfigField("map"),
         labels=ConfigField("map"),
         network_mode=ConfigField("string"),
+        load=ConfigField("list", implemented=False),
+        ipc_mode=ConfigField("string", implemented=False),
+        pid_mode=ConfigField("string", implemented=False),
+        uts_mode=ConfigField("string", implemented=False),
+        privileged=ConfigField("bool", implemented=False),
+        dns_servers=ConfigField("list", implemented=False),
+        dns_search_domains=ConfigField("list", implemented=False),
+        hostname=ConfigField("string", implemented=False),
+        ssl=ConfigField("bool", implemented=False),
+        tty=ConfigField("bool", implemented=False),
+        interactive=ConfigField("bool", implemented=False),
+        shm_size=ConfigField("int", implemented=False),
     )
 
     def _options(self):

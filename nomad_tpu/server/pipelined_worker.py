@@ -421,6 +421,12 @@ class PipelinedWorker(Worker):
             self.host_placement
             and (usage_chain is None or isinstance(usage_chain, np.ndarray))
             and len(batch) * nt.n_rows * 64 <= HOST_ROW_STEP_BUDGET)
+        # The entry gate above is an ESTIMATE (64 placements/eval); the
+        # actual spend is debited per eval from this running budget as
+        # each diff's true placement count becomes known, so a window of
+        # larger-than-estimated evals upgrades to the device mid-window
+        # instead of overshooting the documented budget ~4x.
+        self._host_rows_left = HOST_ROW_STEP_BUDGET if host_mode else 0
         # With a live chain the device usage array is dead weight: skip its
         # dirty-row flush (one blocking host->device RTT mid-storm) and
         # refresh only capacity/readiness changes. A host-mode window skips
@@ -688,8 +694,12 @@ class PipelinedWorker(Worker):
         # device instead. Its launch is deferred like any device rec, so
         # within a host-mode window it chains AFTER the host-placed evals
         # (a pure reorder — every eval still sees a usage state containing
-        # all placements committed before its own).
-        if host and len(diff.place) <= 256:
+        # all placements committed before its own). The shared window
+        # budget debits each eval's TRUE row-step cost.
+        host_cost = self.tindex.nt.n_rows * prep.p_pad
+        if host and len(diff.place) <= 256 \
+                and host_cost <= self._host_rows_left:
+            self._host_rows_left -= host_cost
             res = stack.dispatch_host(prep, usage_override=usage_chain)
             self.stats["host"] = self.stats.get("host", 0) + 1
         else:

@@ -268,6 +268,30 @@ class TestDriverConfigSchemas:
             with _pytest.raises(ValueError, match="unknown config key"):
                 self._driver(name).validate(cfg)
 
+    def test_reference_docker_keys_accepted_with_warning(self, caplog):
+        """Reference-valid docker keys this driver does not implement
+        (privileged, dns_servers, hostname, ...) validate — reference job
+        specs stay portable — with a warning that they are ignored
+        (reference field map: client/driver/docker.go:167-226)."""
+        import logging
+
+        from nomad_tpu.client.driver import base as _base
+
+        _base._WARNED_IGNORED.clear()  # once-per-process memo
+        with caplog.at_level(logging.WARNING, logger="nomad.driver"):
+            self._driver("docker").validate({
+                "image": "redis:3.2", "privileged": True,
+                "dns_servers": ["8.8.8.8"], "hostname": "cache",
+                "shm_size": 64, "ipc_mode": "host"})
+        ignored = [r for r in caplog.records if "ignored" in r.message]
+        assert len(ignored) == 5
+        # Type errors on unimplemented keys still fail loudly.
+        import pytest as _pytest
+
+        with _pytest.raises(ValueError, match="privileged"):
+            self._driver("docker").validate(
+                {"image": "redis", "privileged": "yes-please"})
+
     def test_required_keys_enforced(self):
         import pytest as _pytest
 

@@ -673,7 +673,9 @@ def route(agent, method: str, path: str, query, get_body):
         if not getattr(agent.config, "enable_debug", False):
             raise CodedError(404, "debug endpoints disabled "
                                   "(set enable_debug)")
-        seconds = min(float(query.get("seconds", ["2"])[0]), 30.0)
+        seconds = float(query.get("seconds", ["2"])[0])
+        if not (0.0 < seconds <= 30.0):  # NaN-rejecting clamp
+            seconds = 2.0
         return _capture_profile(seconds), None
 
     if path == "/v1/agent/metrics":

@@ -1,0 +1,211 @@
+"""A stub docker CLI for driver lifecycle tests.
+
+The docker driver shells out to the docker CLI (run/wait/logs/stop/rm/
+rmi/stats/exec/version), so the test double is a fake `docker`
+executable, not an HTTP daemon fake (the reference gates its docker
+suite on a live daemon — client/driver/docker_test.go — which this
+environment does not have; the stub lets the full lifecycle run
+unconditionally in CI).
+
+Containers are simulated from a state directory (env FAKE_DOCKER_STATE):
+one JSON file per container, plus invocations.jsonl recording every CLI
+call's argv and daemon-connection env (DOCKER_HOST / DOCKER_CERT_PATH /
+DOCKER_TLS_VERIFY) so tests can assert endpoint/TLS options propagate.
+
+Image-name conventions drive behavior:
+  fake/short   exits 0 after ~0.2s; logs one stdout and one stderr line
+               (including any command/args, to assert interpolation)
+  fake/long    runs until `docker stop` (exit 137)
+  fake/fail    exits 7 immediately
+"""
+
+import json
+import os
+import sys
+import time
+import uuid
+
+
+def _state_dir() -> str:
+    d = os.environ["FAKE_DOCKER_STATE"]
+    os.makedirs(d, exist_ok=True)
+    return d
+
+
+def _record(argv):
+    keys = ("DOCKER_HOST", "DOCKER_CERT_PATH", "DOCKER_TLS_VERIFY")
+    entry = {"argv": argv,
+             "env": {k: os.environ[k] for k in keys if k in os.environ}}
+    with open(os.path.join(_state_dir(), "invocations.jsonl"), "a") as f:
+        f.write(json.dumps(entry) + "\n")
+
+
+def _cpath(cid: str) -> str:
+    return os.path.join(_state_dir(), f"{cid}.json")
+
+
+def _load(cid: str) -> dict:
+    matches = [f for f in os.listdir(_state_dir())
+               if f.endswith(".json") and f.startswith(cid)]
+    if not matches:
+        raise SystemExit(f"Error: No such container: {cid}")
+    with open(os.path.join(_state_dir(), matches[0])) as f:
+        return json.load(f)
+
+
+def _save(c: dict) -> None:
+    with open(_cpath(c["id"]), "w") as f:
+        json.dump(c, f)
+
+
+def _done(c: dict):
+    """(finished, exit_code) under the simulated clock."""
+    if c.get("stopped_at") is not None:
+        return True, c["exit_code"]
+    if time.time() >= c["created"] + c["duration"]:
+        return True, c["exit_code"]
+    return False, None
+
+
+def cmd_run(argv):
+    # argv: flags... image [command args...]; parse the flags the driver
+    # emits, collect everything for assertions.
+    flags = {"volumes": [], "env": [], "labels": [], "ports": []}
+    i = 0
+    rest = []
+    while i < len(argv):
+        a = argv[i]
+        if a == "-d":
+            i += 1
+        elif a == "-v":
+            flags["volumes"].append(argv[i + 1]); i += 2
+        elif a == "-e":
+            flags["env"].append(argv[i + 1]); i += 2
+        elif a == "--label":
+            flags["labels"].append(argv[i + 1]); i += 2
+        elif a == "-p":
+            flags["ports"].append(argv[i + 1]); i += 2
+        elif a == "--network":
+            flags["network"] = argv[i + 1]; i += 2
+        elif a == "--memory":
+            flags["memory"] = argv[i + 1]; i += 2
+        elif a == "--cpu-shares":
+            flags["cpu_shares"] = argv[i + 1]; i += 2
+        else:
+            rest.append(a); i += 1
+    image, cmdargs = rest[0], rest[1:]
+    cid = uuid.uuid4().hex
+    c = {"id": cid, "image": image, "cmd": cmdargs, "flags": flags,
+         "created": time.time(), "stopped_at": None, "removed": False}
+    if image.startswith("fake/long"):
+        c.update(duration=3600.0, exit_code=0)
+    elif image.startswith("fake/fail"):
+        c.update(duration=0.0, exit_code=7)
+    else:
+        c.update(duration=0.2, exit_code=0)
+    c["stdout"] = f"out:{image}:{' '.join(cmdargs)}\n"
+    c["stderr"] = f"err:{image}\n"
+    _save(c)
+    print(cid)
+
+
+def cmd_wait(cid):
+    while True:
+        c = _load(cid)
+        finished, code = _done(c)
+        if finished:
+            print(code)
+            return
+        time.sleep(0.05)
+
+
+def cmd_logs(argv):
+    follow = "-f" in argv
+    args = [a for a in argv if not a.startswith("-")
+            and not a.replace(".", "").isdigit()]
+    cid = args[-1]
+    c = _load(cid)
+    sys.stdout.write(c["stdout"])
+    sys.stderr.write(c["stderr"])
+    sys.stdout.flush()
+    sys.stderr.flush()
+    if follow:
+        while not _done(_load(cid))[0]:
+            time.sleep(0.05)
+
+
+def cmd_stop(argv):
+    cid = argv[-1]
+    c = _load(cid)
+    if not _done(c)[0]:
+        c["exit_code"] = 137
+    c["stopped_at"] = time.time()
+    _save(c)
+    print(c["id"])
+
+
+def cmd_rm(cid):
+    c = _load(cid)
+    c["removed"] = True
+    _save(c)
+    print(c["id"])
+
+
+def cmd_stats(argv):
+    ids = [a for a in argv if not a.startswith("-")
+           and not a.startswith("{{")]
+    for cid in ids:
+        c = _load(cid)
+        if not _done(c)[0]:
+            print(f"{c['id'][:12]} 5.00% 10MiB / 256MiB")
+
+
+def cmd_exec(argv):
+    cid = argv[0]
+    rest = argv[1:]
+    if rest and rest[0] == "timeout":
+        rest = rest[2:]  # strip `timeout N`
+    _load(cid)  # must exist
+    print(f"exec:{' '.join(rest)}")
+
+
+def main():
+    argv = sys.argv[1:]
+    # Strip the global --config flag (auth): copy its config.json into
+    # state so tests can assert the credentials existed AT CALL TIME
+    # (the driver deletes the directory right after `docker run`).
+    if argv and argv[0] == "--config":
+        cfg = os.path.join(argv[1], "config.json")
+        if os.path.exists(cfg):
+            with open(cfg) as f:
+                auth = f.read()
+            with open(os.path.join(_state_dir(), "last_auth.json"),
+                      "w") as f:
+                f.write(auth)
+        argv = argv[2:]
+    _record(argv)
+    cmd, rest = argv[0], argv[1:]
+    if cmd == "version":
+        print("1.11.fake")
+    elif cmd == "run":
+        cmd_run(rest)
+    elif cmd == "wait":
+        cmd_wait(rest[-1])
+    elif cmd == "logs":
+        cmd_logs(rest)
+    elif cmd == "stop":
+        cmd_stop(rest)
+    elif cmd == "rm":
+        cmd_rm(rest[-1])
+    elif cmd == "rmi":
+        print(rest[-1])
+    elif cmd == "stats":
+        cmd_stats(rest)
+    elif cmd == "exec":
+        cmd_exec(rest)
+    else:
+        raise SystemExit(f"fake docker: unknown command {cmd}")
+
+
+if __name__ == "__main__":
+    main()

@@ -148,7 +148,9 @@ class TestClusterColdRestart:
         agents = [a0] + [boot(f"s{i}", dirs[i], rpc[i], 0, expect=3,
                               join=join2) for i in (1, 2)]
         try:
-            leader = wait_leader(agents, timeout=45)
+            # 90s: a loaded 1-core CI box has double-failed the 45s
+            # margin even through the timing retry.
+            leader = wait_leader(agents, timeout=90)
             for a in agents:
                 assert wait_for(lambda a=a: len(
                     a.server.state.allocs_by_job(job.ID)) == n_allocs,
@@ -156,7 +158,7 @@ class TestClusterColdRestart:
             # The recovered cluster serves: a fresh job schedules.
             job2 = mock.job()
             eval2, _, _ = leader.server.job_register(job2)
-            wait_eval(leader.server, eval2, timeout=45)
+            wait_eval(leader.server, eval2, timeout=90)
         finally:
             for a in agents:
                 a.shutdown()

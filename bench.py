@@ -65,6 +65,15 @@ RUN_C2 = os.environ.get("BENCH_C2", "1") != "0"
 RUN_C4 = os.environ.get("BENCH_C4", "1") != "0"
 
 
+def _freeze_heap():
+    """Collect, then freeze every survivor out of the collector's view.
+    THE one between-rep GC treatment: every timed loop (headline, config
+    benches, and the CPU-served denominator) calls this so the
+    served-vs-served ratio can never drift onto unequal GC footing."""
+    gc.collect()
+    gc.freeze()
+
+
 def _tune_gc():
     """Server-process GC tuning, applied identically before BOTH sides'
     timed reps (TPU-served and CPU-served): collect, freeze the steady-state
@@ -72,8 +81,7 @@ def _tune_gc():
     and raise the gen-0 threshold so a 20k-alloc registration storm doesn't
     trigger full-heap scans mid-rep. The analogue of running the Go
     reference with a tuned GOGC — a deployment setting, not a code path."""
-    gc.collect()
-    gc.freeze()
+    _freeze_heap()
     gc.set_threshold(50_000, 50, 50)
 
 
@@ -234,8 +242,7 @@ def bench_server_e2e(nodes, n_evals):
             # live heap and the rate decays ~30% from rep 1 to rep 9 —
             # a measurement artifact, not scheduler behavior. Same
             # steady-state-deployment rationale as _tune_gc.
-            gc.collect()
-            gc.freeze()
+            _freeze_heap()
         # Lower-middle median: never report the faster of an even pair.
         rate = sorted(rates)[(len(rates) - 1) // 2]
 
@@ -307,8 +314,7 @@ def bench_served_config(nodes, job_fn, n_evals, reps=2, warm=3,
             # Same between-rep GC treatment as the headline bench (and
             # the CPU-served denominator): freeze each rep's survivors
             # out of the collector's view, untimed.
-            gc.collect()
-            gc.freeze()
+            _freeze_heap()
         placed = sum(1 for eid in eval_ids
                      for _ in srv.state.allocs_by_eval(eid))
         lats = []
@@ -440,8 +446,7 @@ def bench_cpu_served(nodes, n_evals, reps=3):
             rates.append(n_evals / (time.perf_counter() - t0))
             # Identical between-rep GC treatment to the TPU side: the
             # served-vs-served ratio must not hide a GC-decay asymmetry.
-            gc.collect()
-            gc.freeze()
+            _freeze_heap()
         placed = sum(1 for eid in eval_ids
                      for a in srv.state.allocs_by_eval(eid))
         return sorted(rates)[(len(rates) - 1) // 2], placed, \

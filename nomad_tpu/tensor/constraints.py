@@ -69,6 +69,10 @@ def check_constraint(operand: str, l_val, r_val) -> bool:
         return {"<": l_val < r_val, "<=": l_val <= r_val,
                 ">": l_val > r_val, ">=": l_val >= r_val}[operand]
     if operand == "version":
+        # The reference converts an integer lVal to its decimal string
+        # (feasible.go checkVersionConstraint's int fallback).
+        if isinstance(l_val, int) and not isinstance(l_val, bool):
+            l_val = str(l_val)
         if not isinstance(l_val, str) or not isinstance(r_val, str):
             return False
         return check_version_constraint(l_val, r_val)
@@ -107,10 +111,11 @@ def node_meets_constraints(node: Node, constraints: Sequence[Constraint]) -> boo
 
 def node_has_drivers(node: Node, drivers: Sequence[str]) -> bool:
     """DriverChecker (reference: feasible.go:91-143): `driver.<name>` node
-    attribute must parse as a true boolean."""
+    attribute must parse as a true boolean — Go strconv.ParseBool
+    semantics, so "1", "t", "T", "true", "TRUE", "True" all pass."""
     for d in drivers:
         raw = node.Attributes.get(f"driver.{d}", "")
-        if raw.lower() not in ("1", "true"):
+        if raw not in ("1", "t", "T", "true", "TRUE", "True"):
             return False
     return True
 

@@ -1,0 +1,156 @@
+"""Feasibility checker parity grid (reference: scheduler/feasible_test.go
+— the operand/target/driver case grids). The iterator-chain tests
+(Static/Random iterators, FeasibilityWrapper) have tensor analogues in
+test_tensor_and_kernels.py; this file ports the semantic grids that must
+match the reference bit for bit: constraint operands (including the Go
+int-to-string version fallback), lexical ordering, version constraints,
+regexp, target resolution, the driver checker's boolean parsing, and the
+combined constraint checker."""
+
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.structs import Constraint
+from nomad_tpu.tensor.constraints import (
+    check_constraint,
+    node_has_drivers,
+    node_meets_constraints,
+    resolve_target,
+)
+
+
+class TestCheckConstraint:
+    """(reference: TestCheckConstraint)"""
+
+    CASES = [
+        ("=", "foo", "foo", True),
+        ("is", "foo", "foo", True),
+        ("==", "foo", "foo", True),
+        ("!=", "foo", "foo", False),
+        ("!=", "foo", "bar", True),
+        ("not", "foo", "bar", True),
+        ("version", "1.2.3", "~> 1.0", True),
+        ("regexp", "foobarbaz", r"[\w]+", True),
+        ("<", "foo", "bar", False),
+    ]
+
+    @pytest.mark.parametrize("op,l,r,want", CASES,
+                             ids=[f"{c[0]}-{c[1]}-{c[2]}" for c in CASES])
+    def test_operand(self, op, l, r, want):
+        assert check_constraint(op, l, r) is want
+
+
+class TestCheckLexicalOrder:
+    """(reference: TestCheckLexicalOrder)"""
+
+    CASES = [
+        ("<", "bar", "foo", True),
+        ("<=", "foo", "foo", True),
+        (">", "bar", "foo", False),
+        (">=", "bar", "bar", True),
+        (">", 1, "foo", False),  # non-string: never feasible
+    ]
+
+    @pytest.mark.parametrize("op,l,r,want", CASES)
+    def test_lexical(self, op, l, r, want):
+        assert check_constraint(op, l, r) is want
+
+
+class TestCheckVersionConstraint:
+    """(reference: TestCheckVersionConstraint)"""
+
+    CASES = [
+        ("1.2.3", "~> 1.0", True),
+        ("1.2.3", ">= 1.0, < 1.4", True),
+        ("2.0.1", "~> 1.0", False),
+        ("1.4", ">= 1.0, < 1.4", False),
+        (1, "~> 1.0", True),  # Go's int fallback: 1 -> "1" -> 1.0.0
+    ]
+
+    @pytest.mark.parametrize("l,r,want", CASES)
+    def test_version(self, l, r, want):
+        assert check_constraint("version", l, r) is want
+
+
+class TestCheckRegexpConstraint:
+    """(reference: TestCheckRegexpConstraint — search semantics, anchors
+    honored, non-strings and bad patterns infeasible)"""
+
+    CASES = [
+        ("foobar", "bar", True),
+        ("foobar", "^foo", True),
+        ("foobar", "^bar", False),
+        ("zipzap", "foo", False),
+        (1, "foo", False),
+        ("foobar", "(unclosed", False),  # malformed pattern: infeasible
+    ]
+
+    @pytest.mark.parametrize("l,r,want", CASES)
+    def test_regexp(self, l, r, want):
+        assert check_constraint("regexp", l, r) is want
+
+
+class TestResolveConstraintTarget:
+    """(reference: TestResolveConstraintTarget)"""
+
+    def test_targets(self):
+        node = mock.node()
+        cases = [
+            ("${node.unique.id}", node.ID, True),
+            ("${node.datacenter}", node.Datacenter, True),
+            ("${node.unique.name}", node.Name, True),
+            ("${node.class}", node.NodeClass, True),
+            ("${node.foo}", None, False),
+            ("${attr.kernel.name}", node.Attributes["kernel.name"], True),
+            ("${attr.rand}", None, False),
+            ("${meta.pci-dss}", node.Meta["pci-dss"], True),
+            ("${meta.rand}", None, False),
+        ]
+        for target, want_val, want_ok in cases:
+            val, ok = resolve_target(target, node)
+            assert ok is want_ok, target
+            if ok:
+                assert val == want_val, target
+
+
+class TestDriverChecker:
+    """(reference: TestDriverChecker — the driver attribute must parse as
+    a TRUE boolean; '0' and 'False' both fail)"""
+
+    def test_boolean_parsing(self):
+        drivers = ["exec", "foo"]
+        # Go strconv.ParseBool semantics: the reference accepts every
+        # Go boolean literal, not just "1"/"true".
+        cases = [("1", True), ("0", False), ("true", True),
+                 ("False", False), ("T", True), ("t", True),
+                 ("TRUE", True), ("f", False), ("yes", False)]
+        for raw, want in cases:
+            node = mock.node()
+            node.Attributes["driver.foo"] = raw
+            assert node_has_drivers(node, drivers) is want, raw
+        # Missing driver attribute entirely: infeasible.
+        node = mock.node()
+        node.Attributes.pop("driver.foo", None)
+        assert not node_has_drivers(node, drivers)
+
+
+class TestConstraintChecker:
+    """(reference: TestConstraintChecker — all constraints must hold;
+    any unresolvable target or failed operand rejects the node)"""
+
+    def test_combined(self):
+        nodes = [mock.node() for _ in range(4)]
+        nodes[0].Attributes["kernel.name"] = "freebsd"
+        nodes[1].Datacenter = "dc2"
+        nodes[2].NodeClass = "large"
+        constraints = [
+            Constraint(Operand="=", LTarget="${node.datacenter}",
+                       RTarget="dc1"),
+            Constraint(Operand="is", LTarget="${attr.kernel.name}",
+                       RTarget="linux"),
+            Constraint(Operand="is", LTarget="${node.class}",
+                       RTarget="large"),
+        ]
+        results = [node_meets_constraints(n, constraints) for n in nodes]
+        # node 3 has default class "" != large -> also infeasible.
+        assert results == [False, False, True, False]

@@ -186,7 +186,7 @@ class TestDiffProperties:
     @SETTINGS
     @given(jobs(), st.data())
     def test_single_scalar_edit_is_reported_exactly(self, job, data):
-        new = decode(Job, encode(job))  # independent deep copy
+        new = job.copy()  # independent deep copy
         field_name, value = data.draw(st.sampled_from([
             ("Priority", job.Priority + 1),
             ("Region", job.Region + "x"),
@@ -203,15 +203,15 @@ class TestDiffProperties:
     @SETTINGS
     @given(jobs(), _NAME)
     def test_group_add_remove_classified(self, job, name):
-        new = decode(Job, encode(job))
-        extra = decode(Job, encode(job)).TaskGroups[0]
+        new = job.copy()
+        extra = job.copy().TaskGroups[0]
         extra.Name = "zz-" + name
         new.TaskGroups.append(extra)
         d = job_diff(job, new)
         added = [tg for tg in d.TaskGroups if tg.Type == DiffTypeAdded]
         assert [tg.Name for tg in added] == ["zz-" + name]
 
-        removed = decode(Job, encode(job))
+        removed = job.copy()
         gone = removed.TaskGroups.pop(0)
         d2 = job_diff(job, removed)
         deleted = [tg for tg in d2.TaskGroups if tg.Type == DiffTypeDeleted]

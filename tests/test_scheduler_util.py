@@ -40,7 +40,6 @@ from nomad_tpu.structs import (
     Service,
     compute_node_class,
 )
-from nomad_tpu.structs.codec import decode, encode
 from nomad_tpu.structs.structs import (
     AllocDesiredStatusRun,
     Job,
@@ -51,7 +50,7 @@ logger = logging.getLogger("test.util")
 
 
 def _copy_job(job):
-    return decode(Job, encode(job))
+    return job.copy()
 
 
 class TestMaterialize:

@@ -381,3 +381,111 @@ class TestCodec:
         a2 = decode(Allocation, encode(a))
         assert a2.TaskResources["web"].Networks[0].ReservedPorts[0].Value == 5000
         assert a2.Job.Type == JobTypeService
+
+
+class TestNetworkIndexReferenceGrid:
+    """The reference's full network_test.go grid (AddAllocs accumulation,
+    AddReserved repeat-collision, yieldIP CIDR walk, and the multi-IP
+    AssignNetwork scenarios), ported case for case."""
+
+    def _node30(self):
+        from nomad_tpu.structs import Node, Resources
+
+        return Node(
+            Resources=Resources(Networks=[NetworkResource(
+                Device="eth0", CIDR="192.168.0.100/30", MBits=1000)]),
+            Reserved=Resources(Networks=[NetworkResource(
+                Device="eth0", IP="192.168.0.100", MBits=1,
+                ReservedPorts=[Port("ssh", 22)])]),
+        )
+
+    def _allocs(self):
+        from nomad_tpu.structs import Allocation, Resources
+
+        return [
+            Allocation(TaskResources={"web": Resources(Networks=[
+                NetworkResource(Device="eth0", IP="192.168.0.100",
+                                MBits=20,
+                                ReservedPorts=[Port("one", 8000),
+                                               Port("two", 9000)])])}),
+            Allocation(TaskResources={"api": Resources(Networks=[
+                NetworkResource(Device="eth0", IP="192.168.0.100",
+                                MBits=50,
+                                ReservedPorts=[Port("main", 10000)])])}),
+        ]
+
+    def test_add_allocs_accumulates(self):
+        """(reference: TestNetworkIndex_AddAllocs)"""
+        idx = NetworkIndex()
+        assert idx.add_allocs(self._allocs()) is False
+        assert idx.used_bandwidth["eth0"] == 70
+        for port in (8000, 9000, 10000):
+            assert idx.used_ports["192.168.0.100"].check(port)
+
+    def test_add_reserved_collides_on_repeat(self):
+        """(reference: TestNetworkIndex_AddReserved)"""
+        idx = NetworkIndex()
+        reserved = NetworkResource(Device="eth0", IP="192.168.0.100",
+                                   MBits=20,
+                                   ReservedPorts=[Port("one", 8000),
+                                                  Port("two", 9000)])
+        assert idx.add_reserved(reserved) is False
+        assert idx.used_bandwidth["eth0"] == 20
+        assert idx.used_ports["192.168.0.100"].check(8000)
+        assert idx.used_ports["192.168.0.100"].check(9000)
+        # Same reservation again: collision reported.
+        assert idx.add_reserved(reserved) is True
+
+    def test_yield_ip_walks_cidr(self):
+        """(reference: TestNetworkIndex_yieldIP)"""
+        idx = NetworkIndex()
+        idx.set_node(self._node30())
+        ips = [ip for _, ip in idx._yield_ips()]
+        assert ips == ["192.168.0.100", "192.168.0.101",
+                       "192.168.0.102", "192.168.0.103"]
+
+    def test_assign_network_grid(self):
+        """(reference: TestNetworkIndex_AssignNetwork): a used reserved
+        port pushes the offer to the NEXT IP of the CIDR; dynamic ports
+        land on the first IP; bandwidth exhaustion reports exactly
+        'bandwidth exceeded'."""
+        import random as _random
+
+        idx = NetworkIndex()
+        idx.set_node(self._node30())
+        idx.add_allocs(self._allocs())
+
+        # Reserved port 8000 is used on .100 -> offer comes from .101.
+        offer = idx.assign_network(
+            NetworkResource(ReservedPorts=[Port("main", 8000)]),
+            rng=_random.Random(1))
+        assert offer.IP == "192.168.0.101"
+        assert [(p.Label, p.Value) for p in offer.ReservedPorts] == \
+            [("main", 8000)]
+
+        # Dynamic ports fit on the first IP.
+        offer = idx.assign_network(
+            NetworkResource(DynamicPorts=[Port("http", 0),
+                                          Port("https", 0),
+                                          Port("admin", 0)]),
+            rng=_random.Random(1))
+        assert offer.IP == "192.168.0.100"
+        assert len(offer.DynamicPorts) == 3
+        values = [p.Value for p in offer.DynamicPorts]
+        assert all(v > 0 for v in values)
+        assert len(set(values)) == 3  # no duplicate host ports
+
+        # Reserved + dynamic together, free reserved port -> first IP.
+        offer = idx.assign_network(
+            NetworkResource(ReservedPorts=[Port("main", 2345)],
+                            DynamicPorts=[Port("http", 0),
+                                          Port("https", 0),
+                                          Port("admin", 0)]),
+            rng=_random.Random(1))
+        assert offer.IP == "192.168.0.100"
+        assert [(p.Label, p.Value) for p in offer.ReservedPorts] == \
+            [("main", 2345)]
+
+        # Too much bandwidth: the exact reference error.
+        with pytest.raises(ValueError, match="bandwidth exceeded"):
+            idx.assign_network(NetworkResource(MBits=1000))

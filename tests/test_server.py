@@ -526,3 +526,136 @@ class TestAllocUpdateCoalescing:
                     == AllocClientStatusComplete)
         finally:
             srv.shutdown()
+
+
+class TestEvalBrokerReferenceGrid:
+    """The eval_broker_test.go cases the original suite didn't cover:
+    FIFO within a priority, empty-dequeue timeout, blocked dequeue
+    wake-up, nack-timeout reset, ack at the delivery limit, and the
+    EnqueueAll requeue-then-ack/nack transitions."""
+
+    def _broker(self, **kw):
+        b = EvalBroker(**{"nack_timeout": 5.0, "delivery_limit": 3, **kw})
+        b.set_enabled(True)
+        return b
+
+    def test_dequeue_empty_times_out(self):
+        """(reference: TestEvalBroker_Dequeue_Empty_Timeout)"""
+        b = self._broker()
+        t0 = time.monotonic()
+        out, _ = b.dequeue(["service"], timeout=0.15)
+        dt = time.monotonic() - t0
+        assert out is None
+        assert 0.1 <= dt < 2.0
+
+    def test_dequeue_fifo_within_priority(self):
+        """(reference: TestEvalBroker_Dequeue_FIFO)"""
+        b = self._broker()
+        evs = []
+        for _ in range(10):
+            ev = mock.eval()
+            ev.Priority = 50
+            b.enqueue(ev)
+            evs.append(ev)
+        order = []
+        for _ in range(10):
+            out, token = b.dequeue(["service"], timeout=1)
+            order.append(out.ID)
+            b.ack(out.ID, token)
+        assert order == [e.ID for e in evs]
+
+    def test_blocked_dequeue_wakes_on_enqueue(self):
+        """(reference: TestEvalBroker_Dequeue_Blocked)"""
+        import threading as _threading
+
+        b = self._broker()
+        got = {}
+
+        def waiter():
+            got["out"], got["token"] = b.dequeue(["service"], timeout=5)
+
+        t = _threading.Thread(target=waiter)
+        t.start()
+        time.sleep(0.1)
+        ev = mock.eval()
+        b.enqueue(ev)
+        t.join(timeout=5)
+        assert not t.is_alive()
+        assert got["out"].ID == ev.ID
+
+    def test_nack_timeout_reset_defers_redelivery(self):
+        """(reference: TestEvalBroker_Nack_TimeoutReset): an
+        outstanding_reset pushes the redelivery deadline out, so the
+        eval is NOT redelivered one original-timeout after dequeue."""
+        # Generous margins: the reset must land well before the original
+        # deadline and the check well before the pushed-out one, or a
+        # loaded CI box races the wheel timer.
+        b = self._broker(nack_timeout=1.5)
+        ev = mock.eval()
+        b.enqueue(ev)
+        out, token = b.dequeue(["service"], timeout=1)
+        assert out.ID == ev.ID
+        time.sleep(0.9)
+        b.outstanding_reset(ev.ID, token)  # deadline moves to ~t+2.4
+        # At t+1.7 (past the original deadline) it must still be ours.
+        time.sleep(0.8)
+        assert b.outstanding(ev.ID) == token
+        # Eventually the pushed-out deadline fires and it redelivers.
+        out2, token2 = b.dequeue(["service"], timeout=5)
+        assert out2.ID == ev.ID
+        assert token2 != token
+
+    def test_ack_at_delivery_limit(self):
+        """(reference: TestEvalBroker_AckAtDeliveryLimit): the LAST
+        allowed delivery can still be acked normally — the limit only
+        routes the next redelivery to the failed queue."""
+        b = self._broker(nack_timeout=5.0, delivery_limit=3)
+        ev = mock.eval()
+        b.enqueue(ev)
+        for _ in range(2):
+            out, token = b.dequeue(["service"], timeout=1)
+            b.nack(out.ID, token)
+        out, token = b.dequeue(["service"], timeout=1)  # delivery 3 of 3
+        assert out.ID == ev.ID
+        b.ack(ev.ID, token)
+        assert b.outstanding(ev.ID) is None
+        none, _ = b.dequeue(["service"], timeout=0.1)
+        assert none is None
+
+    def test_enqueue_all_requeue_then_ack(self):
+        """(reference: TestEvalBroker_EnqueueAll_Requeue_Ack): a token-
+        gated requeue of an outstanding eval stays parked until the ack,
+        then becomes ready under a fresh token."""
+        b = self._broker()
+        ev = mock.eval()
+        b.enqueue(ev)
+        out, token = b.dequeue(["service"], timeout=1)
+        assert out.ID == ev.ID
+        b.enqueue_all({ev.ID: (ev, token)})
+        assert b.stats.TotalReady == 0
+        assert b.stats.TotalUnacked == 1
+        b.ack(ev.ID, token)
+        assert b.stats.TotalReady == 1
+        assert b.stats.TotalUnacked == 0
+        out2, token2 = b.dequeue(["service"], timeout=1)
+        assert out2.ID == ev.ID
+        assert token2 != token
+
+    def test_enqueue_all_requeue_then_nack_drops_requeue(self):
+        """(reference: TestEvalBroker_EnqueueAll_Requeue_Nack): a nack
+        of the outstanding token discards the parked requeue — the
+        ordinary nack redelivery takes over instead."""
+        b = self._broker()
+        ev = mock.eval()
+        b.enqueue(ev)
+        out, token = b.dequeue(["service"], timeout=1)
+        b.enqueue_all({ev.ID: (ev, token)})
+        b.nack(ev.ID, token)
+        assert b.stats.TotalReady == 1
+        assert b.stats.TotalUnacked == 0
+        # Exactly ONE ready copy: the nack redelivery, not nack + requeue.
+        out2, token2 = b.dequeue(["service"], timeout=1)
+        assert out2.ID == ev.ID
+        b.ack(ev.ID, token2)
+        none, _ = b.dequeue(["service"], timeout=0.1)
+        assert none is None

@@ -659,3 +659,133 @@ class TestEvalBrokerReferenceGrid:
         b.ack(ev.ID, token2)
         none, _ = b.dequeue(["service"], timeout=0.1)
         assert none is None
+
+
+class TestBlockedEvalsReferenceGrid:
+    """The blocked_evals_test.go cases the suite lacked: disabled no-op,
+    same-job dedup into duplicates, prior-unblock immediate release
+    (seen/unseen/escaped SnapshotIndex variants), duplicate fetch with
+    blocking timeout, reblock token flow through the broker, and
+    unblock_failed."""
+
+    def _pair(self):
+        broker = EvalBroker(nack_timeout=5.0, delivery_limit=3)
+        broker.set_enabled(True)
+        blocked = BlockedEvals(broker)
+        blocked.set_enabled(True)
+        return blocked, broker
+
+    def _eval(self, escaped=False, elig=None, snapshot=0):
+        ev = mock.eval()
+        ev.Status = EvalStatusBlocked
+        ev.EscapedComputedClass = escaped
+        ev.ClassEligibility = dict(elig or {})
+        ev.SnapshotIndex = snapshot
+        return ev
+
+    def test_block_disabled_is_noop(self):
+        """(reference: TestBlockedEvals_Block_Disabled)"""
+        blocked, _ = self._pair()
+        blocked.set_enabled(False)
+        blocked.block(self._eval(escaped=True))
+        assert blocked.stats.TotalBlocked == 0
+        assert blocked.stats.TotalEscaped == 0
+
+    def test_duplicate_wakes_blocking_fetch(self):
+        """(reference: TestBlockedEvals_GetDuplicates' second half; the
+        immediate-fetch half is already pinned by
+        TestBlockedEvals.test_duplicates): a duplicate arriving later
+        wakes a BLOCKING get_duplicates call."""
+        import threading as _threading
+
+        blocked, _ = self._pair()
+        e = self._eval()
+        blocked.block(e)
+        e3 = self._eval()
+        e3.JobID = e.JobID
+        timer = _threading.Timer(0.2, blocked.block, args=(e3,))
+        timer.start()
+        dups = blocked.get_duplicates(2.0)
+        assert [d.ID for d in dups] == [e3.ID]
+
+    def test_prior_unblock_keeps_ineligible_blocked(self):
+        """(reference: TestBlockedEvals_Block_PriorUnblocks): capacity
+        events for classes the eval is INELIGIBLE for don't release it."""
+        blocked, _ = self._pair()
+        blocked.unblock("v1:123", 1000)
+        blocked.unblock("v1:123", 1001)
+        ev = self._eval(elig={"v1:123": False, "v1:456": False},
+                        snapshot=999)
+        blocked.block(ev)
+        assert blocked.stats.TotalBlocked == 1
+
+    def test_immediate_unblock_variants(self):
+        """(reference: the three Block_ImmediateUnblock_* cases): an
+        escaped eval older than any unblock, or an eval whose snapshot
+        predates an unseen/eligible class event, releases straight to
+        the broker instead of parking."""
+        for kwargs, released in (
+            (dict(escaped=True, snapshot=900), True),      # escaped + old
+            (dict(elig={}, snapshot=900), True),           # unseen class
+            (dict(elig={"v1:123": True}, snapshot=900), True),   # eligible
+            (dict(elig={"v1:123": False}, snapshot=900), False),  # seen, inelig
+            (dict(escaped=True, snapshot=1100), False),    # newer than event
+        ):
+            blocked, broker = self._pair()
+            blocked.unblock("v1:123", 1000)
+            # Drain the async capacity watcher before blocking: a pending
+            # unblock event releases ALL escaped evals regardless of
+            # index, which would race the stays-blocked variants.
+            time.sleep(0.15)
+            blocked.block(self._eval(**kwargs))
+            if released:
+                out, token = broker.dequeue(["service"], timeout=1)
+                assert out is not None, kwargs
+                assert blocked.stats.TotalBlocked == 0
+            else:
+                assert blocked.stats.TotalBlocked == 1, kwargs
+
+    def test_reblock_token_flow(self):
+        """(reference: TestBlockedEvals_Reblock): a reblocked eval's
+        unblock parks behind its outstanding token; the ack promotes it
+        to ready under the broker's requeue path."""
+        blocked, broker = self._pair()
+        ev = self._eval(elig={"v1:123": True}, snapshot=500)
+        broker.enqueue(ev)
+        out, token = broker.dequeue([ev.Type], timeout=1)
+        assert out.ID == ev.ID
+        blocked.reblock(ev, token)
+        assert blocked.stats.TotalBlocked == 1
+        blocked.unblock("v1:123", 1000)
+        assert wait_for(lambda: blocked.stats.TotalBlocked == 0)
+        # Parked until the ack...
+        assert broker.stats.TotalReady == 0
+        assert broker.stats.TotalUnacked == 1
+        broker.ack(ev.ID, token)
+        # ...then ready under a fresh token.
+        out2, token2 = broker.dequeue([ev.Type], timeout=1)
+        assert out2.ID == ev.ID
+        assert token2 != token
+
+    def test_unblock_failed(self):
+        """(reference: TestBlockedEvals_UnblockFailed): max-plans-
+        triggered blocked evals release on unblock_failed, and the job
+        can block again afterwards."""
+        blocked, broker = self._pair()
+        from nomad_tpu.structs.structs import EvalTriggerMaxPlans
+
+        e = self._eval(escaped=True)
+        e.TriggeredBy = EvalTriggerMaxPlans
+        e2 = self._eval(elig={"v1:123": True})
+        e2.TriggeredBy = EvalTriggerMaxPlans
+        blocked.block(e)
+        blocked.block(e2)
+        blocked.unblock_failed()
+        assert blocked.stats.TotalBlocked == 0
+        assert blocked.stats.TotalEscaped == 0
+        assert wait_for(lambda: broker.stats.TotalReady == 2)
+        # The SAME job must be trackable again (the jobs-set was
+        # cleaned), not misrouted into duplicates.
+        blocked.block(e)
+        assert blocked.stats.TotalBlocked == 1
+        assert blocked.get_duplicates(0) == []

@@ -1,0 +1,160 @@
+"""Periodic dispatcher parity grid (reference: nomad/periodic_test.go —
+the dispatcher-level cases beyond test_server.py's single e2e dispatch:
+tracking add/update/remove, force-run, multi-launch ordering, same-time
+coalescing, and heap ordering semantics)."""
+
+import threading
+import time
+
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.server.periodic import PeriodicDispatch, derive_job, \
+    derived_job_id
+from nomad_tpu.structs import PeriodicConfig
+from nomad_tpu.structs.structs import JobTypeBatch, PeriodicSpecTest
+
+from helpers import wait_for  # noqa: E402
+
+
+class Capture:
+    def __init__(self):
+        self.launches = []
+        self.event = threading.Event()
+
+    def __call__(self, job, launch_time):
+        self.launches.append((job.ID, launch_time))
+        self.event.set()
+
+
+def periodic_job(*times, job=None):
+    job = job or mock.job()
+    job.Type = JobTypeBatch
+    job.Periodic = PeriodicConfig(
+        Enabled=True, SpecType=PeriodicSpecTest,
+        Spec=",".join(str(t) for t in times))
+    return job
+
+
+@pytest.fixture
+def dispatcher():
+    cap = Capture()
+    pd = PeriodicDispatch(cap)
+    pd.set_enabled(True)
+    yield pd, cap
+    pd.set_enabled(False)
+
+
+class TestPeriodicDispatch:
+    def test_add_non_periodic_untracked(self, dispatcher):
+        """(reference: TestPeriodicDispatch_Add_NonPeriodic)"""
+        pd, _ = dispatcher
+        pd.add(mock.job())
+        assert pd.tracked() == []
+
+    def test_add_update_job(self, dispatcher):
+        """(reference: TestPeriodicDispatch_Add_UpdateJob): re-adding
+        the same ID replaces the tracked job, not duplicates it."""
+        pd, _ = dispatcher
+        job = periodic_job(time.time() + 3600)
+        pd.add(job)
+        assert [j.ID for j in pd.tracked()] == [job.ID]
+        updated = periodic_job(time.time() + 7200, job=job.copy())
+        pd.add(updated)
+        tracked = pd.tracked()
+        assert [j.ID for j in tracked] == [job.ID]
+        assert tracked[0].Periodic.Spec == updated.Periodic.Spec
+
+    def test_add_disabled_update_removes(self, dispatcher):
+        """(reference: TestPeriodicDispatch_Add_RemoveJob): updating a
+        tracked job to non-periodic untracks it."""
+        pd, _ = dispatcher
+        job = periodic_job(time.time() + 3600)
+        pd.add(job)
+        assert pd.tracked()
+        plain = job.copy()
+        plain.Periodic = None
+        pd.add(plain)
+        assert pd.tracked() == []
+
+    def test_add_triggers_update(self, dispatcher):
+        """(reference: TestPeriodicDispatch_Add_TriggersUpdate): re-add
+        with an EARLIER launch time fires at the new time, not the old."""
+        pd, cap = dispatcher
+        job = periodic_job(time.time() + 3600)
+        pd.add(job)
+        pd.add(periodic_job(time.time() + 0.2, job=job.copy()))
+        assert cap.event.wait(10)
+        assert cap.launches[0][0] == job.ID
+
+    def test_remove_untracked_is_noop(self, dispatcher):
+        """(reference: TestPeriodicDispatch_Remove_Untracked)"""
+        pd, _ = dispatcher
+        pd.remove("nope")  # must not raise
+
+    def test_remove_tracked_prevents_launch(self, dispatcher):
+        """(reference: TestPeriodicDispatch_Remove_Tracked +
+        Remove_TriggersUpdate): a removed job never fires."""
+        pd, cap = dispatcher
+        job = periodic_job(time.time() + 0.3)
+        pd.add(job)
+        pd.remove(job.ID)
+        assert pd.tracked() == []
+        assert not cap.event.wait(0.8)
+        assert cap.launches == []
+
+    def test_force_run_untracked_raises(self, dispatcher):
+        """(reference: TestPeriodicDispatch_ForceRun_Untracked)"""
+        pd, _ = dispatcher
+        with pytest.raises(KeyError):
+            pd.force_run("nope")
+
+    def test_force_run_tracked_dispatches(self, dispatcher):
+        """(reference: TestPeriodicDispatch_ForceRun_Tracked)"""
+        pd, cap = dispatcher
+        job = periodic_job(time.time() + 3600)
+        pd.add(job)
+        pd.force_run(job.ID)
+        assert cap.launches and cap.launches[0][0] == job.ID
+
+    def test_run_multiple_launches_in_order(self, dispatcher):
+        """(reference: TestPeriodicDispatch_Run_Multiple): successive
+        spec times fire in order for the same job."""
+        pd, cap = dispatcher
+        now = time.time()
+        job = periodic_job(now + 0.2, now + 0.4)
+        pd.add(job)
+        assert wait_for(lambda: len(cap.launches) >= 2, timeout=10)
+        assert [l[0] for l in cap.launches[:2]] == [job.ID, job.ID]
+        assert cap.launches[0][1] <= cap.launches[1][1]
+
+    def test_run_same_time_fires_both_jobs(self, dispatcher):
+        """(reference: TestPeriodicDispatch_Run_SameTime)"""
+        pd, cap = dispatcher
+        at = time.time() + 0.25
+        j1, j2 = periodic_job(at), periodic_job(at)
+        pd.add(j1)
+        pd.add(j2)
+        assert wait_for(lambda: len(cap.launches) >= 2, timeout=10)
+        assert {l[0] for l in cap.launches} == {j1.ID, j2.ID}
+
+    def test_disabled_add_is_noop(self):
+        """(reference: periodic.go SetEnabled(false) semantics)"""
+        cap = Capture()
+        pd = PeriodicDispatch(cap)
+        pd.add(periodic_job(time.time() + 0.1))
+        assert pd.tracked() == []
+
+
+class TestDerivedJobs:
+    def test_derived_id_and_job(self):
+        """(reference: periodic.go deriveJob + TestPeriodicDispatch's
+        child naming): the child is non-periodic, parented, and named
+        with the launch timestamp."""
+        parent = periodic_job(time.time() + 3600)
+        launch = 1_700_000_000.0
+        child = derive_job(parent, launch)
+        assert child.ID == derived_job_id(parent.ID, launch)
+        assert child.ID.startswith(parent.ID + "/periodic-")
+        assert not child.is_periodic()
+        assert child.ParentID == parent.ID

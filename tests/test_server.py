@@ -668,11 +668,20 @@ class TestBlockedEvalsReferenceGrid:
     blocking timeout, reblock token flow through the broker, and
     unblock_failed."""
 
+    def setup_method(self, method):
+        self._pairs = []
+
+    def teardown_method(self, method):
+        # Stop every capacity-watcher thread the test started.
+        for blocked, broker in self._pairs:
+            blocked.set_enabled(False)
+            broker.set_enabled(False)
+
     def _pair(self):
-        broker = EvalBroker(nack_timeout=5.0, delivery_limit=3)
-        broker.set_enabled(True)
-        blocked = BlockedEvals(broker)
-        blocked.set_enabled(True)
+        # Same construction (and argument order) as
+        # TestBlockedEvals._setup, tracked for teardown.
+        broker, blocked = TestBlockedEvals._setup(self)
+        self._pairs.append((blocked, broker))
         return blocked, broker
 
     def _eval(self, escaped=False, elig=None, snapshot=0):
@@ -732,11 +741,12 @@ class TestBlockedEvalsReferenceGrid:
             (dict(escaped=True, snapshot=1100), False),    # newer than event
         ):
             blocked, broker = self._pair()
-            blocked.unblock("v1:123", 1000)
-            # Drain the async capacity watcher before blocking: a pending
-            # unblock event releases ALL escaped evals regardless of
-            # index, which would race the stays-blocked variants.
-            time.sleep(0.15)
+            # Seed the unblock index DIRECTLY instead of calling
+            # unblock(): a queued capacity event is processed async and
+            # releases ALL escaped evals regardless of index, which
+            # would race the stays-blocked variants on a loaded box.
+            with blocked._lock:
+                blocked._unblock_indexes["v1:123"] = 1000
             blocked.block(self._eval(**kwargs))
             if released:
                 out, token = broker.dequeue(["service"], timeout=1)

@@ -122,7 +122,10 @@ class TestPeriodicDispatch:
         spec times fire in order for the same job."""
         pd, cap = dispatcher
         now = time.time()
-        job = periodic_job(now + 0.2, now + 0.4)
+        # Wide gap between spec times: next() only returns times strictly
+        # after the FIRST ACTUAL fire, so a loaded box firing late must
+        # not skip past the second slot.
+        job = periodic_job(now + 0.2, now + 1.5)
         pd.add(job)
         assert wait_for(lambda: len(cap.launches) >= 2, timeout=10)
         assert [l[0] for l in cap.launches[:2]] == [job.ID, job.ID]

@@ -1,0 +1,178 @@
+"""Plan-evaluation parity grid (reference: nomad/plan_apply_test.go —
+the EvalPlan partial/AllAtOnce commits and the EvalNodePlan per-node
+fit matrix). The concurrency design (optimistic overlay, verify/apply
+overlap, grouped commits) is covered by test_plan_overlap.py; this file
+pins the admission SEMANTICS the applier must share with the
+reference."""
+
+from nomad_tpu import mock
+from nomad_tpu.server.plan_apply import _evaluate_node_plan, evaluate_plan
+from nomad_tpu.state.state_store import StateStore
+from nomad_tpu.structs import Plan
+from nomad_tpu.structs.structs import (
+    AllocDesiredStatusEvict,
+    NodeStatusDown,
+)
+
+
+def _store():
+    return StateStore()
+
+
+def _fitting_alloc(node=None):
+    alloc = mock.alloc()
+    alloc.Job = None
+    if node is not None:
+        alloc.NodeID = node.ID
+    return alloc
+
+
+def _consume_all(node, alloc):
+    """Make `alloc` consume the node entirely (the reference's
+    node.Resources = alloc.Resources; node.Reserved = nil)."""
+    alloc.NodeID = node.ID
+    node.Resources = alloc.Resources.copy()
+    node.Reserved = None
+
+
+class TestEvalPlan:
+    def test_simple_full_commit(self):
+        """(reference: TestPlanApply_EvalPlan_Simple)"""
+        state = _store()
+        node = mock.node()
+        state.upsert_node(1000, node)
+        snap = state.snapshot()
+        plan = Plan(NodeAllocation={node.ID: [_fitting_alloc(node)]})
+        result = evaluate_plan(snap, plan)
+        assert result.NodeAllocation == plan.NodeAllocation
+        assert result.RefreshIndex == 0
+
+    def test_partial_commit_sets_refresh(self):
+        """(reference: TestPlanApply_EvalPlan_Partial): the fitting node
+        commits, the overfull one is dropped, and RefreshIndex tells the
+        worker to resync."""
+        state = _store()
+        node, node2 = mock.node(), mock.node()
+        state.upsert_node(1000, node)
+        state.upsert_node(1001, node2)
+        snap = state.snapshot()
+        big = _fitting_alloc(node2)
+        big.Resources = node2.Resources.copy()
+        plan = Plan(NodeAllocation={node.ID: [_fitting_alloc(node)],
+                                    node2.ID: [big]})
+        result = evaluate_plan(snap, plan)
+        assert node.ID in result.NodeAllocation
+        assert node2.ID not in result.NodeAllocation
+        assert result.RefreshIndex == 1001
+
+    def test_all_at_once_partial_commits_nothing(self):
+        """(reference: TestPlanApply_EvalPlan_Partial_AllAtOnce)"""
+        state = _store()
+        node, node2 = mock.node(), mock.node()
+        state.upsert_node(1000, node)
+        state.upsert_node(1001, node2)
+        snap = state.snapshot()
+        big = _fitting_alloc(node2)
+        big.Resources = node2.Resources.copy()
+        plan = Plan(AllAtOnce=True,
+                    NodeAllocation={node.ID: [_fitting_alloc(node)],
+                                    node2.ID: [big]})
+        result = evaluate_plan(snap, plan)
+        assert result.NodeAllocation == {}
+        assert result.NodeUpdate == {}
+        assert result.RefreshIndex == 1001
+
+
+class TestEvalNodePlan:
+    def _ready_node_with_full_alloc(self, evict_existing=False):
+        state = _store()
+        node = mock.node()
+        alloc = mock.alloc()
+        alloc.Job = None
+        _consume_all(node, alloc)
+        if evict_existing:
+            alloc.DesiredStatus = AllocDesiredStatusEvict
+        state.upsert_node(1000, node)
+        state.upsert_allocs(1001, [alloc])
+        return state, node, alloc
+
+    def test_simple_fits(self):
+        """(reference: TestPlanApply_EvalNodePlan_Simple)"""
+        state = _store()
+        node = mock.node()
+        state.upsert_node(1000, node)
+        plan = Plan(NodeAllocation={node.ID: [_fitting_alloc(node)]})
+        assert _evaluate_node_plan(state.snapshot(), plan, node.ID)
+
+    def test_node_not_ready_rejects(self):
+        """(reference: TestPlanApply_EvalNodePlan_NodeNotReady)"""
+        state = _store()
+        node = mock.node()
+        node.Status = "initializing"
+        state.upsert_node(1000, node)
+        plan = Plan(NodeAllocation={node.ID: [_fitting_alloc(node)]})
+        assert not _evaluate_node_plan(state.snapshot(), plan, node.ID)
+
+    def test_node_drain_rejects(self):
+        """(reference: TestPlanApply_EvalNodePlan_NodeDrain)"""
+        state = _store()
+        node = mock.node()
+        node.Drain = True
+        state.upsert_node(1000, node)
+        plan = Plan(NodeAllocation={node.ID: [_fitting_alloc(node)]})
+        assert not _evaluate_node_plan(state.snapshot(), plan, node.ID)
+
+    def test_node_not_exist_rejects(self):
+        """(reference: TestPlanApply_EvalNodePlan_NodeNotExist)"""
+        state = _store()
+        ghost = "12345678-abcd-efab-cdef-123456789abc"
+        plan = Plan(NodeAllocation={ghost: [_fitting_alloc()]})
+        assert not _evaluate_node_plan(state.snapshot(), plan, ghost)
+
+    def test_node_full_rejects(self):
+        """(reference: TestPlanApply_EvalNodePlan_NodeFull)"""
+        state, node, _ = self._ready_node_with_full_alloc()
+        plan = Plan(NodeAllocation={node.ID: [_fitting_alloc(node)]})
+        assert not _evaluate_node_plan(state.snapshot(), plan, node.ID)
+
+    def test_update_existing_fits(self):
+        """(reference: TestPlanApply_EvalNodePlan_UpdateExisting): a plan
+        re-placing the SAME alloc (in-place update) discounts the live
+        copy and fits on a full node."""
+        state, node, alloc = self._ready_node_with_full_alloc()
+        plan = Plan(NodeAllocation={node.ID: [alloc]})
+        assert _evaluate_node_plan(state.snapshot(), plan, node.ID)
+
+    def test_node_full_with_planned_evict_fits(self):
+        """(reference: TestPlanApply_EvalNodePlan_NodeFull_Evict)"""
+        state, node, alloc = self._ready_node_with_full_alloc()
+        evict = alloc.copy()
+        evict.DesiredStatus = AllocDesiredStatusEvict
+        plan = Plan(NodeUpdate={node.ID: [evict]},
+                    NodeAllocation={node.ID: [_fitting_alloc(node)]})
+        assert _evaluate_node_plan(state.snapshot(), plan, node.ID)
+
+    def test_node_full_with_terminal_existing_fits(self):
+        """(reference: TestPlanApply_EvalNodePlan_NodeFull_AllocEvict):
+        an existing alloc already marked evict doesn't count against
+        capacity."""
+        state, node, _ = self._ready_node_with_full_alloc(
+            evict_existing=True)
+        plan = Plan(NodeAllocation={node.ID: [_fitting_alloc(node)]})
+        assert _evaluate_node_plan(state.snapshot(), plan, node.ID)
+
+    def test_node_down_evict_only_fits(self):
+        """(reference: TestPlanApply_EvalNodePlan_NodeDown_EvictOnly):
+        a DOWN node accepts pure evictions (no placements)."""
+        state = _store()
+        node = mock.node()
+        alloc = mock.alloc()
+        alloc.Job = None
+        _consume_all(node, alloc)
+        node.Status = NodeStatusDown
+        state.upsert_node(1000, node)
+        state.upsert_allocs(1001, [alloc])
+        evict = alloc.copy()
+        evict.DesiredStatus = AllocDesiredStatusEvict
+        plan = Plan(NodeUpdate={node.ID: [evict]})
+        assert _evaluate_node_plan(state.snapshot(), plan, node.ID)

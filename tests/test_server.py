@@ -136,7 +136,8 @@ class TestEvalBroker:
 
 
 class TestBlockedEvals:
-    def _setup(self):
+    @staticmethod
+    def _setup():
         broker = EvalBroker(5.0, 3)
         broker.set_enabled(True)
         blocked = BlockedEvals(broker)
@@ -680,7 +681,7 @@ class TestBlockedEvalsReferenceGrid:
     def _pair(self):
         # Same construction (and argument order) as
         # TestBlockedEvals._setup, tracked for teardown.
-        broker, blocked = TestBlockedEvals._setup(self)
+        broker, blocked = TestBlockedEvals._setup()
         self._pairs.append((blocked, broker))
         return blocked, broker
 

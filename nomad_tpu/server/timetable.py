@@ -16,18 +16,23 @@ class TimeTable:
     def __init__(self, granularity: float = 300.0, limit: float = 72 * 3600.0):
         self.granularity = granularity
         self.limit = limit
+        # Fixed capacity of limit/granularity entries, oldest dropped on
+        # overflow (reference: timetable.go:28-31 — a ring sized by the
+        # retention window, NOT a timestamp prune; the boundary entry at
+        # exactly `limit` age falls off when capacity is reached).
+        self._max = max(1, int(limit / granularity))
         self._lock = threading.Lock()
         self._table: List[Tuple[int, float]] = []  # newest first
 
     def witness(self, index: int, when: float) -> None:
         with self._lock:
+            # Monotonic indexes only (reference: timetable.go:73-75).
+            if self._table and index < self._table[0][0]:
+                return
             if self._table and when - self._table[0][1] < self.granularity:
                 return
             self._table.insert(0, (index, when))
-            # Prune entries beyond the limit.
-            cutoff = when - self.limit
-            while self._table and self._table[-1][1] < cutoff:
-                self._table.pop()
+            del self._table[self._max:]
 
     def nearest_index(self, when: float) -> int:
         """Largest index witnessed at or before `when`."""

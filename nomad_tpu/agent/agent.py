@@ -204,17 +204,15 @@ class Agent:
 
         def attempt() -> None:
             # Through the RPC dispatch so followers forward to the leader.
-            backoff = 0.5
-            import time as _time
-            end = _time.monotonic() + 60.0
-            while _time.monotonic() < end:
-                try:
-                    self.rpc("Service.Sync", {"Upserts": regs, "Deletes": []})
-                    return
-                except Exception:
-                    _time.sleep(backoff)
-                    backoff = min(backoff * 2, 5.0)
-            logger.warning("agent: server self-registration timed out")
+            from nomad_tpu.resilience.retry import Backoff, RetryPolicy
+
+            policy = RetryPolicy(max_attempts=None, deadline=60.0,
+                                 backoff=Backoff(base=0.5, cap=5.0))
+            try:
+                policy.call(self.rpc, "Service.Sync",
+                            {"Upserts": regs, "Deletes": []})
+            except Exception:
+                logger.warning("agent: server self-registration timed out")
 
         threading.Thread(target=attempt, daemon=True,
                          name="server-self-reg").start()
@@ -292,19 +290,21 @@ class Agent:
             if not servers and self.config.server_discovery_url:
                 # Cold boot races server self-registration (which itself
                 # waits on leader election): retry instead of crashing.
-                import time as _time
+                from nomad_tpu.resilience.retry import Backoff, RetryPolicy
 
-                deadline = _time.monotonic() + 60.0
-                backoff = 0.5
-                while not servers and _time.monotonic() < deadline:
-                    try:
-                        servers = discover_servers(
-                            self.config.server_discovery_url)
-                    except Exception:
-                        pass
-                    if not servers:
-                        _time.sleep(backoff)
-                        backoff = min(backoff * 2, 5.0)
+                def discover():
+                    found = discover_servers(
+                        self.config.server_discovery_url)
+                    if not found:
+                        raise ConnectionError("no servers registered yet")
+                    return found
+
+                try:
+                    servers = RetryPolicy(
+                        max_attempts=None, deadline=60.0,
+                        backoff=Backoff(base=0.5, cap=5.0)).call(discover)
+                except Exception:
+                    servers = []
             if not servers:
                 raise ValueError(
                     "client-only agents need config.servers (RPC addresses) "

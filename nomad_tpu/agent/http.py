@@ -673,10 +673,52 @@ def route(agent, method: str, path: str, query, get_body):
         if not getattr(agent.config, "enable_debug", False):
             raise CodedError(404, "debug endpoints disabled "
                                   "(set enable_debug)")
-        seconds = float(query.get("seconds", ["2"])[0])
+        raw_seconds = query.get("seconds", ["2"])[0]
+        try:
+            seconds = float(raw_seconds)
+        except ValueError:
+            raise CodedError(400, f"invalid seconds value "
+                                  f"{raw_seconds!r}: not a number")
         if not (0.0 < seconds <= 30.0):  # NaN-rejecting clamp
             seconds = 2.0
         return _capture_profile(seconds), None
+
+    if path == "/v1/agent/debug/faults":
+        # Fault-injection control (resilience/failpoints.py), debug-gated
+        # like stacks/profile: arming a failpoint is an operational
+        # hazard, so the agent must opt in. GET lists every known site
+        # with its armed spec and lifetime trigger count; PUT/POST arms
+        # from the shared spec grammar (?spec=... or {"Spec": ...});
+        # DELETE (or {"DisarmAll": true}) heals everything.
+        if not getattr(agent.config, "enable_debug", False):
+            raise CodedError(404, "debug endpoints disabled "
+                                  "(set enable_debug)")
+        from nomad_tpu.resilience import failpoints
+
+        if method == "GET":
+            return {"Sites": failpoints.snapshot()}, None
+        if method == "DELETE":
+            failpoints.disarm_all()
+            return {"DisarmedAll": True}, None
+        _require_write(method)
+        payload = get_body()
+        if isinstance(payload, dict) and payload.get("DisarmAll"):
+            failpoints.disarm_all()
+            return {"DisarmedAll": True}, None
+        spec = query.get("spec", [""])[0]
+        if not spec and isinstance(payload, dict):
+            spec = payload.get("Spec", "")
+        if not isinstance(spec, str):
+            raise CodedError(400, f"Spec must be a string, "
+                                  f"got {type(spec).__name__}")
+        if not spec:
+            raise CodedError(400, "need ?spec=site=mode[:p=..][:count=..]"
+                                  " or a {\"Spec\": ...} body")
+        try:
+            touched = failpoints.arm_from_spec(spec)
+        except ValueError as e:
+            raise CodedError(400, str(e))
+        return {"Touched": touched, "Sites": failpoints.snapshot()}, None
 
     if path == "/v1/agent/metrics":
         # In-memory telemetry snapshot (reference shape: go-metrics

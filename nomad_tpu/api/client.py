@@ -15,6 +15,7 @@ import urllib.request
 from dataclasses import dataclass
 from typing import Any, Dict, List, Optional, Tuple
 
+from nomad_tpu.resilience.retry import Backoff, RetryPolicy
 from nomad_tpu.structs import Job, from_dict, to_dict
 
 
@@ -45,9 +46,14 @@ class QueryMeta:
 
 class Client:
     def __init__(self, address: str = "http://127.0.0.1:4646",
-                 region: str = ""):
+                 region: str = "", retries: int = 3):
         self.address = address.rstrip("/")
         self.region = region
+        # Transient-transport retry budget for idempotent reads (an agent
+        # mid-restart, a briefly unreachable listener). Writes never
+        # retry automatically: re-sending a register is not idempotent
+        # from the caller's perspective (duplicate evals).
+        self.retries = max(1, retries)
         self.jobs = Jobs(self)
         self.nodes = Nodes(self)
         self.allocations = Allocations(self)
@@ -83,26 +89,49 @@ class Client:
                 params: Optional[Dict[str, str]] = None,
                 body: Any = None,
                 timeout: float = 330.0) -> Tuple[Any, QueryMeta]:
-        data = json.dumps(body).encode() if body is not None else None
-        req = urllib.request.Request(self._url(path, params), data=data,
-                                     method=method)
-        if data is not None:
-            req.add_header("Content-Type", "application/json")
-        req.add_header("Accept-Encoding", "gzip")
-        try:
-            with urllib.request.urlopen(req, timeout=timeout) as resp:
-                raw = resp.read()
-                if resp.headers.get("Content-Encoding") == "gzip":
-                    import gzip
+        def once() -> Tuple[Any, QueryMeta]:
+            data = json.dumps(body).encode() if body is not None else None
+            req = urllib.request.Request(self._url(path, params), data=data,
+                                         method=method)
+            if data is not None:
+                req.add_header("Content-Type", "application/json")
+            req.add_header("Accept-Encoding", "gzip")
+            try:
+                with urllib.request.urlopen(req, timeout=timeout) as resp:
+                    raw = resp.read()
+                    if resp.headers.get("Content-Encoding") == "gzip":
+                        import gzip
 
-                    raw = gzip.decompress(raw)
-                meta = QueryMeta(
-                    last_index=int(resp.headers.get("X-Nomad-Index", 0)),
-                    known_leader=resp.headers.get(
-                        "X-Nomad-KnownLeader", "") == "true")
-                return (json.loads(raw) if raw else None), meta
-        except urllib.error.HTTPError as e:
-            raise APIError(e.code, e.read().decode(errors="replace")) from e
+                        raw = gzip.decompress(raw)
+                    meta = QueryMeta(
+                        last_index=int(resp.headers.get("X-Nomad-Index", 0)),
+                        known_leader=resp.headers.get(
+                            "X-Nomad-KnownLeader", "") == "true")
+                    return (json.loads(raw) if raw else None), meta
+            except urllib.error.HTTPError as e:
+                raise APIError(e.code,
+                               e.read().decode(errors="replace")) from e
+
+        if method != "GET" or self.retries <= 1:
+            return once()
+
+        def transient(exc: BaseException) -> bool:
+            # A timed-out request already waited the full budget; against
+            # a wedged (accepting-but-silent) agent, re-waiting it
+            # retries-times over turns one hang into several. Only
+            # connection-level failures (refused/reset mid-restart) are
+            # worth re-trying.
+            return not isinstance(getattr(exc, "reason", exc),
+                                  TimeoutError)
+
+        # HTTPError never reaches the policy (mapped to APIError above),
+        # so retry_on=URLError is purely transport-level failures.
+        policy = RetryPolicy(max_attempts=self.retries,
+                             backoff=Backoff(base=0.1, cap=2.0),
+                             retry_on=(urllib.error.URLError,
+                                       ConnectionError),
+                             should_retry=transient)
+        return policy.call(once)
 
     def get(self, path: str, q: Optional[QueryOptions] = None):
         return self.request("GET", path, self._params(q))
@@ -127,12 +156,21 @@ class Jobs:
 
     def register(self, job: Job, enforce_index: Optional[int] = None
                  ) -> Tuple[str, QueryMeta]:
+        eval_id, _, meta = self.register_with_warnings(job, enforce_index)
+        return eval_id, meta
+
+    def register_with_warnings(
+            self, job: Job, enforce_index: Optional[int] = None
+    ) -> Tuple[str, List[str], QueryMeta]:
+        """Register, also returning server-side validation warnings
+        (reference: JobRegisterResponse.Warnings — e.g. accepted-but-
+        ignored driver config keys)."""
         body: Dict[str, Any] = {"Job": to_dict(job)}
         if enforce_index is not None:
             body["EnforceIndex"] = True
             body["JobModifyIndex"] = enforce_index
         out, meta = self.c.put("/v1/jobs", body)
-        return out.get("EvalID", ""), meta
+        return out.get("EvalID", ""), list(out.get("Warnings") or ()), meta
 
     def list(self, q: Optional[QueryOptions] = None):
         return self.c.get("/v1/jobs", q)
@@ -277,6 +315,16 @@ class Agent:
 
     def servers(self):
         return self.c.get("/v1/agent/servers")[0]
+
+    # Fault-injection control (debug-gated; resilience/failpoints.py)
+    def faults(self):
+        return self.c.get("/v1/agent/debug/faults")[0]
+
+    def arm_faults(self, spec: str):
+        return self.c.put("/v1/agent/debug/faults", {"Spec": spec})[0]
+
+    def disarm_faults(self):
+        return self.c.delete("/v1/agent/debug/faults")[0]
 
 
 class Services:

@@ -144,6 +144,17 @@ def main(argv) -> int:
     p = sub.add_parser("agent-info", help="agent self info")
     _add_meta(p)
 
+    p = sub.add_parser("faults",
+                       help="inspect/arm fault-injection failpoints "
+                            "(needs enable_debug on the agent)")
+    p.add_argument("spec", nargs="?", default="",
+                   help="failpoint spec, e.g. "
+                        "'raft.fsync=error:count=5;gossip.send=drop'; "
+                        "omit to list sites")
+    p.add_argument("--disarm-all", action="store_true",
+                   help="heal every armed failpoint")
+    _add_meta(p)
+
     p = sub.add_parser("system-gc", help="force garbage collection")
     _add_meta(p)
 
@@ -315,7 +326,10 @@ def cmd_run(args) -> int:
         print(json.dumps({"Job": to_dict(job)}, indent=2))
         return 0
     client = _client(args)
-    eval_id, meta = client.jobs.register(job, enforce_index=args.check_index)
+    eval_id, warnings, meta = client.jobs.register_with_warnings(
+        job, enforce_index=args.check_index)
+    for w in warnings:
+        print(f"Warning: {w}", file=sys.stderr)
     if not eval_id:  # periodic parent
         print(f'Job "{job.ID}" registered (periodic)')
         return 0
@@ -472,6 +486,12 @@ def cmd_validate(args) -> int:
     job = parse_job_file(args.jobfile)
     job.init_fields()
     errs = job.validate()
+    # Warnings print on BOTH outcomes: accepted-but-ignored driver keys
+    # matter to whoever is fixing the errors too.
+    from nomad_tpu.client.driver import job_config_warnings
+
+    for w in job_config_warnings(job):
+        print(f"Warning: {w}", file=sys.stderr)
     if errs:
         print("Job validation errors:", file=sys.stderr)
         for e in errs:
@@ -708,6 +728,37 @@ def cmd_agent_info(args) -> int:
         print("# debug endpoints: /v1/agent/debug/stacks (thread dump), "
               "/v1/agent/debug/profile?seconds=N (CPU profile; save the "
               "body and load with python -m pstats)", file=sys.stderr)
+    return 0
+
+
+def cmd_faults(args) -> int:
+    """Fault-injection control (resilience subsystem): list the agent's
+    failpoint sites, arm a spec, or heal everything."""
+    client = _client(args)
+    if args.disarm_all:
+        client.agent.disarm_faults()
+        print("All failpoints disarmed")
+        return 0
+    if args.spec:
+        out = client.agent.arm_faults(args.spec)
+        print("Armed: " + ", ".join(out.get("Touched", [])))
+        return 0
+    sites = client.agent.faults().get("Sites", {})
+    print(f"{'Site':<26} {'Armed':<28} {'Fired':>6}  Description")
+    for name, info in sites.items():
+        armed = info.get("armed")
+        if armed:
+            desc = armed["mode"]
+            if armed["mode"] == "delay":
+                desc += f"({armed['delay']:g})"
+            if armed["probability"] < 1.0:
+                desc += f":p={armed['probability']:g}"
+            if armed.get("remaining") is not None:
+                desc += f":count={armed['remaining']}"
+        else:
+            desc = "-"
+        print(f"{name:<26} {desc:<28} {info.get('fired', 0):>6}  "
+              f"{info.get('description', '')}")
     return 0
 
 

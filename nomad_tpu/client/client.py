@@ -14,6 +14,8 @@ import time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 
+from nomad_tpu.resilience import failpoints
+from nomad_tpu.resilience.retry import Backoff
 from nomad_tpu.structs import Allocation, Node, Resources, generate_uuid
 from nomad_tpu.structs.structs import NodeStatusInit, NodeStatusReady
 
@@ -159,9 +161,13 @@ class Client:
     # ------------------------------------------------------------- register
     def _register(self) -> None:
         """(reference: client.go:720-775 registerAndHeartbeat/register)"""
-        backoff = 0.5
+        backoff = Backoff(base=0.5, cap=30.0)
         while not self._shutdown.is_set():
             try:
+                if failpoints.fire("client.register") == "drop":
+                    # A lost registration RPC: no response, so the retry
+                    # loop backs off and re-sends like any failure.
+                    raise failpoints.FailpointError("client.register")
                 with self._node_lock:
                     snapshot = self.node.copy()
                 self._heartbeat_ttl = self.channel.register_node(snapshot)
@@ -172,9 +178,8 @@ class Client:
                 return
             except Exception:
                 logger.exception("client: registration failed; retrying")
-                if self._shutdown.wait(backoff):
+                if self._shutdown.wait(backoff.next()):
                     return
-                backoff = min(backoff * 2, 30.0)
 
     def _heartbeat_loop(self) -> None:
         while not self._shutdown.is_set():
@@ -182,6 +187,8 @@ class Client:
             if self._shutdown.wait(wait):
                 return
             try:
+                if failpoints.fire("client.heartbeat") == "drop":
+                    continue  # heartbeat lost in transit; TTL keeps ticking
                 self._heartbeat_ttl = self.channel.heartbeat(self.node.ID)
             except Exception:
                 logger.exception("client: heartbeat failed; re-registering")
@@ -289,6 +296,8 @@ class Client:
                 batch = list(self._alloc_updates.values())
                 self._alloc_updates.clear()
             try:
+                if failpoints.fire("client.alloc_sync") == "drop":
+                    raise ConnectionError("alloc sync dropped (failpoint)")
                 self.channel.update_allocs(batch)
             except Exception:
                 logger.exception("client: alloc sync failed; requeueing")

@@ -35,3 +35,26 @@ def new_driver(name: str, ctx: DriverContext) -> Driver:
     if cls is None:
         raise ValueError(f"unknown driver '{name}'")
     return cls(ctx)
+
+
+def job_config_warnings(job) -> list:
+    """Submitter-visible warnings for a job spec: driver config keys that
+    validate (reference compatibility) but are ignored at runtime —
+    e.g. docker's `privileged`/`pid_mode`/`dns_servers`. Returned from
+    Job.Register / surfaced by `nomad-tpu run` and `validate`, because a
+    once-per-process client log line never reaches whoever wrote the job
+    and the container would silently run with materially different
+    isolation than the reference."""
+    warnings = []
+    for tg in job.TaskGroups or ():
+        for task in tg.Tasks or ():
+            schema = getattr(BUILTIN_DRIVERS.get(task.Driver), "schema",
+                             None)
+            if schema is None:
+                continue
+            for key in schema.ignored_keys(task.Config or {}):
+                warnings.append(
+                    f"task {task.Name!r} ({task.Driver}): config key "
+                    f"{key!r} is accepted for reference compatibility "
+                    f"but not implemented; it will be ignored at runtime")
+    return warnings

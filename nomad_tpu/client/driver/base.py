@@ -11,7 +11,7 @@ import sys
 import threading
 import time
 from dataclasses import dataclass, field
-from typing import Any, Dict, Optional, Tuple
+from typing import Any, Dict, List, Optional, Tuple
 
 from nomad_tpu.client.allocdir import AllocDir
 from nomad_tpu.client.env import TaskEnv
@@ -206,6 +206,16 @@ class ConfigSchema:
                         "ignored", key, tag)
         if errs:
             raise ValueError("; ".join(errs))
+
+    def ignored_keys(self, config: Dict[str, Any]) -> List[str]:
+        """Reference-compatible keys present in `config` that this driver
+        accepts but does not act on — surfaced to the SUBMITTER as
+        job-validate warnings (a client-side log line is invisible to
+        whoever wrote the job)."""
+        return sorted(
+            key for key, value in (config or {}).items()
+            if value is not None
+            and key in self.fields and not self.fields[key].implemented)
 
 
 class Driver:

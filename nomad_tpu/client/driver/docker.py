@@ -377,6 +377,12 @@ class DockerDriver(Driver):
             cmd.append(env.replace(str(task.Config["command"])))
             cmd.extend(env.replace(str(a))
                        for a in task.Config.get("args", []))
+        from nomad_tpu.resilience import failpoints
+
+        # error/drop both model a failed container launch (drop has no
+        # discard semantic for an exec): the restart policy takes over.
+        if failpoints.fire("driver.docker.exec") == "drop":
+            raise RuntimeError("docker run dropped (failpoint)")
         out = subprocess.run(cmd, capture_output=True, text=True,
                              timeout=300, env=conn_env)
         if auth_dir:

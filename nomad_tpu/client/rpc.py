@@ -10,9 +10,9 @@ TCP) plugs in behind the same interface.
 from __future__ import annotations
 
 import threading
-import time
 from typing import Callable, Dict, List, Optional, Protocol, Tuple
 
+from nomad_tpu.resilience.retry import Backoff, CircuitBreaker, RetryPolicy
 from nomad_tpu.state.watch import Item
 from nomad_tpu.structs import Allocation, Node, from_dict, to_dict
 
@@ -103,11 +103,31 @@ class RpcProxy:
     """Client-side server list manager: primary servers learned from
     heartbeats, round-robin failover on error, manual backup seeds
     (reference: client/rpcproxy/rpcproxy.go:88-135 FindServer /
-    NotifyFailedServer / RebalanceServers)."""
+    NotifyFailedServer / RebalanceServers).
+
+    Each server carries a circuit breaker: repeated failures quarantine
+    the address, so a dead server costs one probe per reset window
+    instead of one connect timeout per call in rotation. When EVERY
+    server is quarantined the proxy degrades gracefully and serves the
+    head of the list anyway — refusing outright would turn a transient
+    full outage into a permanent client-side one."""
+
+    BREAKER_FAILURES = 3
+    BREAKER_RESET = 10.0
 
     def __init__(self, servers: Optional[List[str]] = None):
         self._lock = threading.Lock()
         self._servers: List[str] = list(servers or [])
+        self._breakers: Dict[str, CircuitBreaker] = {}
+
+    def _breaker(self, addr: str) -> CircuitBreaker:
+        """Caller holds the lock."""
+        b = self._breakers.get(addr)
+        if b is None:
+            b = self._breakers[addr] = CircuitBreaker(
+                failure_threshold=self.BREAKER_FAILURES,
+                reset_timeout=self.BREAKER_RESET)
+        return b
 
     def servers(self) -> List[str]:
         with self._lock:
@@ -115,15 +135,31 @@ class RpcProxy:
 
     def find_server(self) -> Optional[str]:
         with self._lock:
+            for addr in self._servers:
+                if self._breaker(addr).allow():
+                    return addr
+            # All quarantined: degrade to round-robin rather than failing.
             return self._servers[0] if self._servers else None
 
+    def quarantined(self) -> List[str]:
+        """Servers currently held out by an open breaker (introspection)."""
+        with self._lock:
+            return [a for a in self._servers
+                    if self._breakers.get(a) is not None
+                    and self._breakers[a].state == CircuitBreaker.OPEN]
+
     def notify_failed(self, addr: str) -> None:
-        """Rotate the failed server to the back (reference:
-        rpcproxy.go:355-377)."""
+        """Rotate the failed server to the back and feed its breaker
+        (reference: rpcproxy.go:355-377)."""
         with self._lock:
             if addr in self._servers:
                 self._servers.remove(addr)
                 self._servers.append(addr)
+            self._breaker(addr).record_failure()
+
+    def notify_success(self, addr: str) -> None:
+        with self._lock:
+            self._breaker(addr).record_success()
 
     def update(self, servers: List[str]) -> None:
         """Replace the primary list (from heartbeat NodeServerInfo,
@@ -132,6 +168,8 @@ class RpcProxy:
             keep = [s for s in self._servers if s in servers]
             new = [s for s in servers if s not in keep]
             self._servers = keep + new
+            for gone in set(self._breakers) - set(self._servers):
+                del self._breakers[gone]
 
     def rebalance(self, ping: "Callable[[str], bool]") -> Optional[str]:
         """Shuffle the list and promote the first server that answers a
@@ -146,21 +184,39 @@ class RpcProxy:
             return shuffled[0] if shuffled else None
         _random.shuffle(shuffled)
         for i, addr in enumerate(shuffled):
-            if ping(addr):
-                order = shuffled[i:] + shuffled[:i]
+            if not ping(addr):
+                # A failed rebalance ping is breaker evidence like any
+                # other failed call.
                 with self._lock:
-                    # Re-intersect with the live list: update() may have
-                    # added/removed servers during the unlocked ping window,
-                    # and a removed server must stay removed.
-                    order = [s for s in order if s in self._servers]
-                    if not order or order[0] != addr:
-                        # The pinged server itself was removed: don't promote
-                        # a server whose health was never tested.
-                        return None
-                    extra = [s for s in self._servers if s not in order]
-                    self._servers = order + extra
-                    return addr
+                    self._breaker(addr).record_failure()
+                continue
+            order = shuffled[i:] + shuffled[:i]
+            with self._lock:
+                # A ping IS a health probe: close the breaker so
+                # find_server doesn't keep skipping the server we just
+                # proved alive.
+                self._breaker(addr).record_success()
+                # Re-intersect with the live list: update() may have
+                # added/removed servers during the unlocked ping window,
+                # and a removed server must stay removed.
+                order = [s for s in order if s in self._servers]
+                if not order or order[0] != addr:
+                    # The pinged server itself was removed: don't promote
+                    # a server whose health was never tested.
+                    return None
+                extra = [s for s in self._servers if s not in order]
+                self._servers = order + extra
+                return addr
         return None
+
+
+class _TerminalRemoteError(Exception):
+    """Internal wrapper: a remote handler error that must NOT be retried
+    or failed over, carried out of the retry policy and unwrapped."""
+
+    def __init__(self, inner: Exception):
+        super().__init__(str(inner))
+        self.inner = inner
 
 
 class NetServerChannel:
@@ -216,26 +272,46 @@ class NetServerChannel:
     def _call(self, method: str, body: dict, timeout: Optional[float] = None):
         from nomad_tpu.rpc.pool import RPCError
 
-        last_exc: Optional[Exception] = None
-        for attempt in range(self.NO_LEADER_RETRIES):
+        def one_round():
+            """Walk the server list once: transport failures fail over to
+            the next server (feeding its breaker); a NotLeaderError
+            raises out for the policy to back off on."""
+            last_exc: Optional[Exception] = None
             for _ in range(max(1, len(self.proxy.servers()))):
                 addr = self.proxy.find_server()
                 if addr is None:
                     raise ConnectionError("no known servers")
                 try:
-                    return self.pool.call(addr, method, body, timeout=timeout)
+                    out = self.pool.call(addr, method, body, timeout=timeout)
                 except RPCError as exc:
+                    # The server ANSWERED: transport-wise it is healthy,
+                    # and a half-open probe must not leak _probing=True
+                    # (which would quarantine a live server forever).
+                    self.proxy.notify_success(addr)
                     if exc.remote_type == "NotLeaderError":
-                        last_exc = exc
-                        break  # election window: back off, retry
-                    raise  # real remote error: failover won't help
+                        raise  # election window: policy backs off + retries
+                    raise _TerminalRemoteError(exc)  # failover won't help
                 except Exception as exc:  # transport: try the next server
                     last_exc = exc
                     self.proxy.notify_failed(addr)
-            else:
-                raise last_exc  # type: ignore[misc]  # all servers down
-            time.sleep(self.NO_LEADER_BACKOFF)
-        raise last_exc  # type: ignore[misc]
+                    continue
+                self.proxy.notify_success(addr)
+                return out
+            raise last_exc  # type: ignore[misc]  # all servers down
+
+        # Ride out a leader election (reference: rpc.go ErrNoLeader retry
+        # with jitter); everything else surfaces after one round.
+        policy = RetryPolicy(
+            max_attempts=self.NO_LEADER_RETRIES,
+            backoff=Backoff(base=self.NO_LEADER_BACKOFF,
+                            cap=4 * self.NO_LEADER_BACKOFF),
+            retry_on=(RPCError,),
+            should_retry=lambda e: getattr(e, "remote_type", "")
+            == "NotLeaderError")
+        try:
+            return policy.call(one_round)
+        except _TerminalRemoteError as wrapped:
+            raise wrapped.inner
 
     def _absorb_server_info(self, resp: Dict) -> None:
         servers = resp.get("Servers") or []

@@ -38,6 +38,7 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import msgpack
 
+from nomad_tpu.resilience import failpoints
 from nomad_tpu.rpc.wire import recv_frame, send_frame
 
 LOG = logging.getLogger("nomad.gossip")
@@ -262,6 +263,8 @@ class Memberlist:
 
     # ------------------------------------------------------------ transport
     def _send_udp(self, dest: Tuple[str, int], msgs: List[Any]) -> None:
+        if failpoints.fire("gossip.send") == "drop":
+            return  # datagram lost in transit
         f = self.transport_filter
         if f is not None and not f(dest, msgs):
             return
@@ -319,6 +322,8 @@ class Memberlist:
         threading.Thread(target=run, daemon=True).start()
 
     def _ping(self, target: str, dest: Tuple[str, int]) -> bool:
+        if failpoints.fire("gossip.probe") == "drop":
+            return False  # probe lost: caller escalates to indirect pings
         with self._lock:
             self._seq += 1
             seq = self._seq
@@ -334,10 +339,16 @@ class Memberlist:
     # ----------------------------------------------------------- probe loop
     def _probe_loop(self) -> None:
         while not self._shutdown.wait(self.config.probe_interval):
-            self._expire_suspects()
-            member = self._next_probe_target()
-            if member is not None:
-                self._probe(member)
+            try:
+                self._expire_suspects()
+                member = self._next_probe_target()
+                if member is not None:
+                    self._probe(member)
+            except Exception:
+                # The failure detector must outlive any single bad probe
+                # round (injected or real): a dead probe loop would stop
+                # ALL failure detection on this member, silently.
+                LOG.exception("%s: probe round failed", self.name)
 
     def _next_probe_target(self) -> Optional[Member]:
         with self._lock:

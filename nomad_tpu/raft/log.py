@@ -22,6 +22,8 @@ from typing import Any, Dict, List, Optional, Tuple
 
 import msgpack
 
+from nomad_tpu.resilience import failpoints
+
 LOG = logging.getLogger("nomad.raft.log")
 
 # Segment format v2: magic header, then [u32 len][u32 crc32(payload)]
@@ -215,6 +217,10 @@ class FileLogStore(InMemLogStore):
             buf += _FRAME.pack(len(rec)) + _FRAME.pack(zlib.crc32(rec)) + rec
         self._fh.write(bytes(buf))
         self._fh.flush()
+        # error = a failing disk (append raises up through store_entries);
+        # drop = a lying disk: bytes buffered, durability skipped.
+        if failpoints.fire("raft.fsync") == "drop":
+            return
         os.fsync(self._fh.fileno())
 
     def _rewrite_file(self) -> None:

@@ -23,6 +23,8 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import msgpack
 
+from nomad_tpu.resilience import failpoints
+
 from .log import EntryType, LogEntry
 from .transport import TransportError
 
@@ -381,11 +383,14 @@ class RaftNode:
 
         def ask(peer: str):
             try:
+                if failpoints.fire("raft.request_vote") == "drop":
+                    raise TransportError(
+                        f"vote request to {peer} dropped (failpoint)")
                 resp = self.transport.send(peer, "raft.request_vote", {
                     "Term": term, "Candidate": self.id,
                     "LastLogIndex": last_idx, "LastLogTerm": last_term,
                 })
-            except TransportError:
+            except (TransportError, failpoints.FailpointError):
                 return
             with self._lock:
                 if resp["Term"] > self._term:
@@ -457,7 +462,7 @@ class RaftNode:
                     return
             try:
                 self._replicate_once(peer)
-            except TransportError:
+            except (TransportError, failpoints.FailpointError):
                 pass
             except Exception:
                 # A replicator thread must never die permanently; log and
@@ -519,6 +524,9 @@ class RaftNode:
             "Entries": [(e.Index, e.Term, e.Type, e.Data) for e in entries],
             "LeaderCommit": commit,
         }
+        if failpoints.fire("raft.append_entries") == "drop":
+            raise TransportError(
+                f"append_entries to {peer} dropped (failpoint)")
         resp = self.transport.send(peer, "raft.append_entries", payload)
         with self._lock:
             if resp["Term"] > self._term:
@@ -755,6 +763,11 @@ class RaftNode:
                 if index <= self._last_applied:
                     return {"Term": self._term}
                 blob = req["Data"]
+                # Fire BEFORE any state mutation: an injected restore
+                # failure must model a cleanly-rejected install (leader
+                # re-sends later), not a half-applied one.
+                if failpoints.fire("raft.snapshot.restore") == "drop":
+                    raise failpoints.FailpointError("raft.snapshot.restore")
                 self.log.store_snapshot(index, term, blob)
                 self.log.delete_range(self.log.first_index(),
                                       self.log.last_index())

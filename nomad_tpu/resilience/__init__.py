@@ -1,0 +1,23 @@
+"""Resilience subsystem: named fault-injection sites (failpoints), the
+unified retry/backoff policy, and the declarative chaos-schedule harness.
+
+The three pieces compose: production code paths call
+``failpoints.fire("site.name")`` at their failure seams and wrap remote
+calls in one shared :class:`~nomad_tpu.resilience.retry.RetryPolicy`;
+chaos schedules arm failpoints on a timeline and assert the cluster
+invariants afterwards. Everything is a no-op until a failpoint is armed
+(env var, Python API, or the /v1/agent/debug/faults endpoint).
+"""
+
+from .failpoints import (  # noqa: F401
+    FailpointError,
+    arm,
+    arm_from_env,
+    arm_from_spec,
+    disarm,
+    disarm_all,
+    fire,
+    known_sites,
+    snapshot,
+)
+from .retry import Backoff, CircuitBreaker, RetryPolicy  # noqa: F401

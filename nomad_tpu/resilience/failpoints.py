@@ -1,0 +1,293 @@
+"""Failpoints: a thread-safe registry of named fault-injection sites
+(reference technique: freebsd/fail.h style failpoints and the
+testutil/chaos hooks scattered through hashicorp's suites — here one
+first-class subsystem instead of per-test monkeypatching).
+
+A production code path declares a site by calling ``fire("site.name")``
+at its failure seam. Disarmed — the normal state — that is one module
+attribute read and a falsy check; no lock, no dict lookup, no
+allocation. Armed, the site can:
+
+  raise   — raise :class:`FailpointError` (an injected hard failure)
+  delay   — sleep for a configured duration, then proceed
+  drop    — return ``"drop"``; the site discards the operation the way
+            its real network would (a lost datagram, a black-holed RPC)
+
+Each armed spec composes two modifiers: ``probability`` (trigger on a
+coin flip per hit) and ``count`` (disarm automatically after N
+triggers; ``count=1`` is the classic "once" failpoint).
+
+Arming surfaces:
+  * env var   — ``NOMAD_TPU_FAILPOINTS="raft.fsync=error;rpc.pool.call=
+                delay(0.2):p=0.5:count=3"`` (parsed at import)
+  * Python    — :func:`arm` / :func:`disarm` / :func:`disarm_all`
+  * HTTP/CLI  — ``/v1/agent/debug/faults`` + ``nomad-tpu faults``
+                (agent/http.py, cli/commands.py), both speaking the same
+                spec grammar via :func:`arm_from_spec`.
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import re
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+__all__ = [
+    "FailpointError", "fire", "arm", "disarm", "disarm_all",
+    "arm_from_spec", "arm_from_env", "snapshot", "known_sites",
+    "ENV_VAR",
+]
+
+ENV_VAR = "NOMAD_TPU_FAILPOINTS"
+
+# Sites threaded through the codebase, so the faults endpoint can list
+# what is armable even before any site has fired. Keep alphabetical.
+KNOWN_SITES: Dict[str, str] = {
+    "client.alloc_sync": "client: batched alloc status push to servers",
+    "client.heartbeat": "client: node heartbeat to the leader",
+    "client.register": "client: node registration RPC",
+    "driver.docker.exec": "docker driver: container launch/exec calls",
+    "gossip.probe": "gossip: direct ping of the probe target",
+    "gossip.send": "gossip: outbound UDP datagram (drop=lost packet)",
+    "plan.apply.commit": "server: plan applier's consensus commit",
+    "raft.append_entries": "raft: leader->peer AppendEntries send",
+    "raft.fsync": "raft: durable log append fsync",
+    "raft.request_vote": "raft: candidate->peer RequestVote send",
+    "raft.snapshot.restore": "raft/state: FSM restore from snapshot blob",
+    "rpc.pool.call": "rpc: pooled client call over the wire",
+    "rpc.server.handle": "rpc: server-side endpoint dispatch",
+    "worker.dequeue": "server: scheduling worker eval dequeue",
+}
+
+MODES = ("error", "delay", "drop")
+
+
+class FailpointError(Exception):
+    """Raised by an armed ``error``-mode failpoint. Deliberately a plain
+    Exception subclass: sites sit inside code that maps unexpected
+    exceptions to its own failure handling, which is exactly the path
+    under test."""
+
+    def __init__(self, site: str, message: str = ""):
+        super().__init__(message or f"failpoint {site!r} triggered")
+        self.site = site
+
+
+class _Spec:
+    __slots__ = ("mode", "delay", "probability", "remaining", "message",
+                 "hits")
+
+    def __init__(self, mode: str, delay: float = 0.0,
+                 probability: float = 1.0,
+                 count: Optional[int] = None, message: str = ""):
+        if mode not in MODES:
+            raise ValueError(f"unknown failpoint mode {mode!r} "
+                             f"(want one of {MODES})")
+        if not (0.0 < probability <= 1.0):
+            raise ValueError("probability must be in (0, 1]")
+        if count is not None and count <= 0:
+            raise ValueError("count must be positive")
+        if delay < 0:
+            raise ValueError("delay must be non-negative")
+        self.mode = mode
+        self.delay = float(delay)
+        self.probability = float(probability)
+        self.remaining = count
+        self.message = message
+        self.hits = 0
+
+    def describe(self) -> Dict[str, Any]:
+        return {"mode": self.mode, "delay": self.delay,
+                "probability": self.probability,
+                "remaining": self.remaining, "hits": self.hits}
+
+
+_lock = threading.Lock()
+_armed: Dict[str, _Spec] = {}
+# Disarmed fast path: one module attribute read. Maintained strictly
+# under _lock as "any site armed"; readers tolerate the benign race (a
+# site arming mid-call fires on the NEXT hit).
+_active = False
+# Lifetime trigger counts per site, kept across disarm for the faults
+# endpoint ("did my chaos schedule actually hit the seam?").
+_fired: Dict[str, int] = {}
+
+
+def fire(site: str) -> Optional[str]:
+    """Declare + evaluate the failpoint ``site``. Returns ``"drop"`` when
+    the caller should discard the operation, ``None`` otherwise. Raises
+    :class:`FailpointError` in ``error`` mode. The disarmed cost is this
+    one truthiness check."""
+    if not _active:
+        return None
+    return _fire_armed(site)
+
+
+def _fire_armed(site: str) -> Optional[str]:
+    with _lock:
+        spec = _armed.get(site)
+        if spec is None:
+            return None
+        if spec.probability < 1.0 and random.random() >= spec.probability:
+            return None
+        spec.hits += 1
+        _fired[site] = _fired.get(site, 0) + 1
+        if spec.remaining is not None:
+            spec.remaining -= 1
+            if spec.remaining <= 0:
+                del _armed[site]
+                _refresh_active_locked()
+        mode, delay, message = spec.mode, spec.delay, spec.message
+    # Act outside the lock: a delay must not serialize every other site.
+    if mode == "error":
+        raise FailpointError(site, message)
+    if mode == "delay":
+        time.sleep(delay)
+        return None
+    return "drop"
+
+
+def _refresh_active_locked() -> None:
+    global _active
+    _active = bool(_armed)
+
+
+def arm(site: str, mode: str, delay: float = 0.0, probability: float = 1.0,
+        count: Optional[int] = None, message: str = "") -> None:
+    """Arm ``site``. Unknown site names are accepted (tests may declare
+    ad-hoc sites), but a typo'd name simply never fires — ``snapshot()``
+    shows hits=0, which is the debugging signal."""
+    spec = _Spec(mode, delay=delay, probability=probability, count=count,
+                 message=message)
+    with _lock:
+        _armed[site] = spec
+        _refresh_active_locked()
+
+
+def disarm(site: str) -> bool:
+    with _lock:
+        existed = _armed.pop(site, None) is not None
+        _refresh_active_locked()
+    return existed
+
+
+def disarm_all() -> None:
+    with _lock:
+        _armed.clear()
+        _refresh_active_locked()
+
+
+# --------------------------------------------------------------- spec text
+# site=mode[(arg)][:p=<float>][:count=<int>] joined by ";"
+#   modes: error / error(message) / delay(seconds) / drop / off
+_SPEC_RE = re.compile(r"^(?P<mode>error|delay|drop|off)"
+                      r"(?:\((?P<arg>[^)]*)\))?$")
+
+
+def arm_from_spec(text: str) -> List[str]:
+    """Parse + apply the compact spec grammar shared by the env var, the
+    CLI and the HTTP endpoint. Returns the site names touched. ``off``
+    as a mode disarms the site. Raises ValueError on malformed input
+    (the HTTP layer maps that to a 400) — and applies NOTHING in that
+    case: a 400 response must mean no fault was left armed, so every
+    clause is validated before any clause takes effect."""
+    planned: List[tuple] = []  # (site, _Spec-or-None for disarm)
+    for part in text.split(";"):
+        part = part.strip()
+        if not part:
+            continue
+        site, sep, rest = part.partition("=")
+        site = site.strip()
+        if not sep or not site or not rest.strip():
+            raise ValueError(f"bad failpoint spec {part!r} "
+                             "(want site=mode[:p=..][:count=..])")
+        tokens = rest.strip().split(":")
+        m = _SPEC_RE.match(tokens[0].strip())
+        if m is None:
+            raise ValueError(f"bad failpoint mode {tokens[0]!r}")
+        mode, arg = m.group("mode"), m.group("arg")
+        probability, count = 1.0, None
+        for tok in tokens[1:]:
+            key, _, val = tok.strip().partition("=")
+            try:
+                if key == "p":
+                    probability = float(val)
+                elif key == "count":
+                    count = int(val)
+                elif key == "once" and not val:
+                    count = 1
+                else:
+                    raise ValueError
+            except ValueError:
+                raise ValueError(f"bad failpoint modifier {tok!r}")
+        if mode == "off":
+            planned.append((site, None))
+        elif mode == "delay":
+            try:
+                delay = float(arg or "")
+            except ValueError:
+                raise ValueError(
+                    f"delay needs a seconds argument: {part!r}")
+            planned.append((site, _Spec("delay", delay=delay,
+                                        probability=probability,
+                                        count=count)))
+        elif mode == "error":
+            planned.append((site, _Spec("error", probability=probability,
+                                        count=count, message=arg or "")))
+        else:  # drop
+            planned.append((site, _Spec("drop", probability=probability,
+                                        count=count)))
+    with _lock:
+        for site, spec in planned:
+            if spec is None:
+                _armed.pop(site, None)
+            else:
+                _armed[site] = spec
+        _refresh_active_locked()
+    return [site for site, _ in planned]
+
+
+def arm_from_env(environ=os.environ) -> List[str]:
+    text = environ.get(ENV_VAR, "")
+    if not text:
+        return []
+    return arm_from_spec(text)
+
+
+# ------------------------------------------------------------ introspection
+def snapshot() -> Dict[str, Any]:
+    """State for the faults endpoint: every known/armed site with its
+    spec (None when disarmed) and lifetime trigger count."""
+    with _lock:
+        names = set(KNOWN_SITES) | set(_armed) | set(_fired)
+        return {
+            name: {
+                "description": KNOWN_SITES.get(name, ""),
+                "armed": (_armed[name].describe()
+                          if name in _armed else None),
+                "fired": _fired.get(name, 0),
+            }
+            for name in sorted(names)
+        }
+
+
+def known_sites() -> List[str]:
+    with _lock:
+        return sorted(set(KNOWN_SITES) | set(_armed))
+
+
+# Env arming at import: a process started under NOMAD_TPU_FAILPOINTS has
+# its faults armed before any subsystem thread spins up. A malformed
+# spec must not take down every entry point (even `faults --disarm-all`
+# imports this module) — warn loudly and keep whatever parsed; the
+# snapshot's hits=0 on the intended site is the debugging signal.
+try:
+    arm_from_env()
+except ValueError as _exc:
+    import sys as _sys
+
+    print(f"nomad-tpu: ignoring malformed {ENV_VAR}: {_exc}",
+          file=_sys.stderr)

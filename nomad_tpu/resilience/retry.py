@@ -1,0 +1,193 @@
+"""The one retry/backoff implementation (reference: the
+`retryMax`/`resetTimer` loops in nomad's client and rpc layers, unified —
+every subsystem here used to hand-roll its own ``time.sleep`` loop).
+
+Three pieces:
+
+  :class:`Backoff`        — decorrelated-jitter delay sequence. Jitter is
+                            not cosmetic: synchronized retry loops across
+                            a fleet of clients re-converge into thundering
+                            herds on the exact cadence of the outage that
+                            scattered them.
+  :class:`RetryPolicy`    — attempts + deadline + backoff + on-retry hook
+                            around any callable.
+  :class:`CircuitBreaker` — closed/open/half-open quarantine for a
+                            repeatedly-failing target, so a dead server
+                            is probed occasionally instead of re-tried in
+                            rotation on every call.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+from typing import Any, Callable, Optional, Tuple, Type
+
+__all__ = ["Backoff", "RetryPolicy", "CircuitBreaker", "RetryError"]
+
+
+class RetryError(Exception):
+    """Deadline/attempts exhausted without the operation succeeding and
+    without a terminal exception to re-raise (loop-style use)."""
+
+
+class Backoff:
+    """Decorrelated jitter: ``sleep = min(cap, uniform(base, prev * 3))``
+    (the AWS "exponential backoff and jitter" result — better tail
+    behavior than full-jitter-on-exponential under contention). Not
+    thread-safe; each retrying call site owns one."""
+
+    def __init__(self, base: float = 0.05, cap: float = 5.0,
+                 rng: Optional[random.Random] = None):
+        if base <= 0 or cap < base:
+            raise ValueError("need 0 < base <= cap")
+        self.base = base
+        self.cap = cap
+        self._rng = rng or random
+        self._prev = 0.0
+
+    def next(self) -> float:
+        prev = self._prev if self._prev > 0 else self.base
+        delay = min(self.cap, self._rng.uniform(self.base, prev * 3))
+        self._prev = delay
+        return delay
+
+    def reset(self) -> None:
+        self._prev = 0.0
+
+
+class RetryPolicy:
+    """Retry a callable under an attempts bound, a wall-clock deadline,
+    and a backoff sequence.
+
+    ``sleep`` is injectable for two reasons: tests, and shutdown-aware
+    call sites — pass a ``threading.Event.wait`` bound method and a set
+    event aborts the retry loop immediately (the loop treats a truthy
+    sleep return as "stop now")."""
+
+    def __init__(self, max_attempts: Optional[int] = 3,
+                 deadline: Optional[float] = None,
+                 backoff: Optional[Backoff] = None,
+                 retry_on: Tuple[Type[BaseException], ...] = (Exception,),
+                 should_retry: Optional[
+                     Callable[[BaseException], bool]] = None,
+                 on_retry: Optional[
+                     Callable[[BaseException, int, float], None]] = None,
+                 sleep: Callable[[float], Any] = time.sleep,
+                 clock: Callable[[], float] = time.monotonic):
+        if max_attempts is None and deadline is None:
+            raise ValueError("need max_attempts or deadline (or both)")
+        self.max_attempts = max_attempts
+        self.deadline = deadline
+        self.backoff = backoff or Backoff()
+        self.retry_on = retry_on
+        self.should_retry = should_retry
+        self.on_retry = on_retry
+        self.sleep = sleep
+        self.clock = clock
+
+    def call(self, fn: Callable[..., Any], *args: Any, **kwargs: Any) -> Any:
+        """Run ``fn`` until it returns, the retry budget runs out (the
+        last exception re-raises), or an exception outside ``retry_on`` /
+        rejected by ``should_retry`` surfaces immediately."""
+        self.backoff.reset()
+        deadline_at = (self.clock() + self.deadline
+                       if self.deadline is not None else None)
+        attempt = 0
+        while True:
+            attempt += 1
+            try:
+                return fn(*args, **kwargs)
+            except self.retry_on as exc:
+                if self.should_retry is not None \
+                        and not self.should_retry(exc):
+                    raise
+                if self.max_attempts is not None \
+                        and attempt >= self.max_attempts:
+                    raise
+                delay = self.backoff.next()
+                if deadline_at is not None:
+                    remaining = deadline_at - self.clock()
+                    if remaining <= 0:
+                        raise
+                    delay = min(delay, remaining)
+                if self.on_retry is not None:
+                    self.on_retry(exc, attempt, delay)
+                if self.sleep(delay):
+                    raise  # shutdown-aware sleep asked us to stop
+
+
+class CircuitBreaker:
+    """Per-target failure quarantine (reference intent: rpcproxy marking
+    servers failed and rebalancing away — here with an explicit
+    open/half-open probe cycle so a dead server costs one connect timeout
+    per ``reset_timeout``, not one per call).
+
+    closed     — all calls allowed; ``failure_threshold`` consecutive
+                 failures trips to open.
+    open       — calls refused until ``reset_timeout`` elapses.
+    half-open  — one probe call allowed through; success closes the
+                 breaker, failure re-opens it (and restarts the timer).
+
+    Thread-safe; ``allow()`` + ``record_success()/record_failure()`` are
+    the whole surface."""
+
+    CLOSED = "closed"
+    OPEN = "open"
+    HALF_OPEN = "half-open"
+
+    def __init__(self, failure_threshold: int = 3,
+                 reset_timeout: float = 10.0,
+                 clock: Callable[[], float] = time.monotonic):
+        if failure_threshold < 1:
+            raise ValueError("failure_threshold must be >= 1")
+        self.failure_threshold = failure_threshold
+        self.reset_timeout = reset_timeout
+        self.clock = clock
+        self._lock = threading.Lock()
+        self._state = self.CLOSED
+        self._failures = 0
+        self._opened_at = 0.0
+        self._probing = False
+
+    @property
+    def state(self) -> str:
+        with self._lock:
+            self._maybe_half_open_locked()
+            return self._state
+
+    def _maybe_half_open_locked(self) -> None:
+        if (self._state == self.OPEN
+                and self.clock() - self._opened_at >= self.reset_timeout):
+            self._state = self.HALF_OPEN
+            self._probing = False
+
+    def allow(self) -> bool:
+        with self._lock:
+            self._maybe_half_open_locked()
+            if self._state == self.CLOSED:
+                return True
+            if self._state == self.HALF_OPEN and not self._probing:
+                self._probing = True  # exactly one concurrent probe
+                return True
+            return False
+
+    def record_success(self) -> None:
+        with self._lock:
+            self._state = self.CLOSED
+            self._failures = 0
+            self._probing = False
+
+    def record_failure(self) -> None:
+        with self._lock:
+            if self._state == self.HALF_OPEN:
+                # Failed probe: straight back to open, timer restarted.
+                self._state = self.OPEN
+                self._opened_at = self.clock()
+                self._probing = False
+                return
+            self._failures += 1
+            if self._failures >= self.failure_threshold:
+                self._state = self.OPEN
+                self._opened_at = self.clock()

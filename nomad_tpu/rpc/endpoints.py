@@ -17,6 +17,7 @@ import time
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from nomad_tpu.raft.node import NotLeaderError
+from nomad_tpu.resilience import failpoints
 from nomad_tpu.state.watch import Item
 from nomad_tpu.telemetry import metrics
 from nomad_tpu.structs import (
@@ -29,7 +30,7 @@ from nomad_tpu.structs import (
     to_dict,
 )
 
-from .pool import ConnPool, RPCError
+from .pool import ConnPool, DroppedRPCError, RPCError
 
 MAX_BLOCK_TIME = 300.0  # reference: rpc.go:33-47 maxQueryTime
 
@@ -155,6 +156,11 @@ class Endpoints:
         start = time.monotonic()
         metrics.incr_counter(("nomad", "rpc", "request"))
         try:
+            if failpoints.fire("rpc.server.handle") == "drop":
+                # A black-holed request surfaces to the caller as a dead
+                # connection, driving its failover path.
+                raise DroppedRPCError(
+                    f"rpc {method} dropped (failpoint)")
             body = dict(body or {})
             region = body.get("Region") or self.server.config.region
             if region != self.server.config.region:
@@ -255,10 +261,23 @@ class Endpoints:
     # ------------------------------------------------------------------ job
     def job_register(self, body) -> Dict[str, Any]:
         job = from_dict(Job, body["Job"])
+        # Collected BEFORE the register mutates the job: warnings must
+        # reach the submitter even when nothing else is wrong (reference
+        # shape: JobRegisterResponse.Warnings). Best-effort: the schema
+        # metadata lives in the client driver package, and a server-only
+        # host where those modules can't import must still register jobs
+        # — just without the advisory warnings.
+        try:
+            from nomad_tpu.client.driver import job_config_warnings
+
+            warnings = job_config_warnings(job)
+        except ImportError:
+            warnings = []
         enforce = body.get("EnforceIndex")
         eval_id, jmi, index = self.server.job_register(
             job, enforce_index=enforce)
-        return {"EvalID": eval_id, "JobModifyIndex": jmi, "Index": index}
+        return {"EvalID": eval_id, "JobModifyIndex": jmi, "Index": index,
+                "Warnings": warnings}
 
     def job_deregister(self, body) -> Dict[str, Any]:
         eval_id, index = self.server.job_deregister(body["JobID"])

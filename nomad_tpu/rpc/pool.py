@@ -11,6 +11,9 @@ import socket
 import threading
 from typing import Any, Dict, Optional
 
+from nomad_tpu.resilience import failpoints
+from nomad_tpu.resilience.retry import Backoff, RetryPolicy
+
 from .wire import RPC_NOMAD, MessageCodec, recv_frame, send_frame
 
 
@@ -25,6 +28,14 @@ class RPCError(Exception):
 
 class ConnError(Exception):
     pass
+
+
+class DroppedRPCError(ConnError):
+    """A request black-holed by the `rpc.server.handle` drop failpoint.
+    Distinct from plain ConnError so the RPC server kills only injected
+    drops: a REAL ConnError out of a handler (e.g. a dead leader
+    forward) still serializes to the caller as a remote error, exactly
+    as it did before failpoints existed."""
 
 
 class _Conn:
@@ -159,16 +170,23 @@ class ConnPool:
     def call(self, addr: str, method: str, body: Any = None,
              timeout: Optional[float] = None) -> Any:
         """One RPC. Retries once through a fresh connection on transport
-        failure (NOT on remote errors)."""
+        failure (NOT on remote errors) via the shared RetryPolicy."""
         timeout = timeout if timeout is not None else self.call_timeout
-        try:
-            return self._get(addr).call(method, body, timeout)
-        except (ConnError, OSError):
+        if failpoints.fire("rpc.pool.call") == "drop":
+            raise ConnError(f"rpc {method} to {addr} dropped (failpoint)")
+
+        def evict_stale(exc, attempt, delay):
             with self._lock:
                 stale = self._conns.pop(addr, None)
             if stale is not None:
                 stale.close()
-            return self._get(addr).call(method, body, timeout)
+
+        policy = RetryPolicy(max_attempts=2,
+                             backoff=Backoff(base=0.005, cap=0.05),
+                             retry_on=(ConnError, OSError),
+                             on_retry=evict_stale)
+        return policy.call(
+            lambda: self._get(addr).call(method, body, timeout))
 
     def close(self) -> None:
         with self._lock:

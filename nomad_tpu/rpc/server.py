@@ -10,6 +10,7 @@ import socket
 import threading
 from typing import Any, Callable, Dict, Optional
 
+from .pool import DroppedRPCError
 from .wire import (
     RPC_NOMAD,
     RPC_RAFT,
@@ -163,6 +164,18 @@ class RPCServer:
         try:
             result = handler(frame["Method"], frame.get("Body"))
             resp = MessageCodec.response(seq, body=result)
+        except DroppedRPCError:
+            # A black-holed request (rpc.server.handle drop failpoint):
+            # kill the connection instead of answering, so the caller
+            # sees a transport failure and runs its failover path. Only
+            # the injected type — a real ConnError out of a handler
+            # (dead leader forward) serializes as a remote error like
+            # any other handler exception.
+            try:
+                conn.close()
+            except OSError:
+                pass
+            return
         except Exception as exc:  # errors cross the wire as strings
             resp = MessageCodec.response(seq, error=_err_string(exc))
         try:

@@ -123,8 +123,10 @@ def diff_system_allocs(job: Job, nodes: List[Node], tainted: Dict[str, bool],
     req_items = list(required.items())
     result = DiffResult()
     place = result.place
+    emitted: set = set()  # a duplicated Node entry must not double-place
     for node in nodes:
-        if node.ID not in node_allocs:
+        if node.ID not in node_allocs and node.ID not in emitted:
+            emitted.add(node.ID)
             for name, tg in req_items:
                 place.append(AllocTuple(name, tg, Allocation(NodeID=node.ID)))
     for node_id, nallocs in node_allocs.items():

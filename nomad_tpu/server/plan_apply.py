@@ -30,6 +30,7 @@ from typing import Dict, List, Optional, Set, Tuple
 
 import numpy as np
 
+from nomad_tpu.resilience import failpoints
 from nomad_tpu.tensor.node_table import RES_DIMS, alloc_vec
 from nomad_tpu.structs import (
     Allocation,
@@ -486,6 +487,8 @@ class PlanApplier:
                     pending, result = group[0]
                     index = self._apply(pending.plan, result)
                 else:
+                    if failpoints.fire("plan.apply.commit") == "drop":
+                        raise failpoints.FailpointError("plan.apply.commit")
                     index = self.raft.apply(MessageType.AllocUpdate, {
                         "Batch": [{"Job": pending.plan.Job,
                                    "Alloc": _result_allocs(result)}
@@ -515,6 +518,10 @@ class PlanApplier:
     def _apply(self, plan: Plan, result: PlanResult) -> int:
         """Commit the verified subset through consensus
         (reference: plan_apply.go:122-164 applyPlan)."""
+        # No drop semantics at a consensus commit: a triggered failpoint
+        # always surfaces as a failed apply (workers nack + re-evaluate).
+        if failpoints.fire("plan.apply.commit") == "drop":
+            raise failpoints.FailpointError("plan.apply.commit")
         return self.raft.apply(MessageType.AllocUpdate, {
             "Job": plan.Job,
             "Alloc": _result_allocs(result),

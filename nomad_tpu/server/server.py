@@ -754,6 +754,14 @@ class Server:
         node = self.state.node_by_id(node_id)
         if node is None:
             raise KeyError(f"node not found: {node_id}")
+        if node.Status == NodeStatusDown:
+            # The TTL already expired and this node was marked down. A
+            # bare timer reset would leave it down FOREVER: the client
+            # only pushes a ready status during registration. Reject so
+            # the client's heartbeat loop falls back to re-registering
+            # (reference: the client re-registers on a heartbeat error,
+            # client.go registerAndHeartbeat).
+            raise KeyError(f"node {node_id} is down; must re-register")
         return self.heartbeats.reset_heartbeat_timer(node_id)
 
     def node_update_drain(self, node_id: str, drain: bool) -> int:

@@ -24,6 +24,7 @@ import threading
 import time
 from typing import List, Optional, Tuple
 
+from nomad_tpu.resilience import failpoints
 from nomad_tpu.scheduler import new_scheduler
 from nomad_tpu.scheduler.scheduler import SetStatusError
 from nomad_tpu.telemetry import metrics
@@ -45,6 +46,20 @@ BACKOFF_LIMIT = 1.0
 RAFT_SYNC_LIMIT = 10.0  # max wait for state to catch up (worker.go:214)
 DEQUEUE_TIMEOUT = 0.5
 PLAN_WAIT = 30.0
+
+
+class PartialPlanError(Exception):
+    """A chunked plan sweep failed mid-sequence. Carries the results of
+    every chunk whose wait completed BEFORE the failure, so callers can
+    account the committed chunks instead of treating the whole sweep as
+    unknown (the committed allocations are real; only the tail is in
+    doubt)."""
+
+    def __init__(self, results: List[Optional[PlanResult]],
+                 cause: BaseException):
+        super().__init__(f"plan sweep failed after {len(results)} "
+                         f"chunk(s): {cause}")
+        self.results = results
 
 
 class LocalBackend:
@@ -94,7 +109,9 @@ class LocalBackend:
         still in the queue are cancelled so they cannot commit behind the
         retrying scheduler's back (a chunk already picked up by the
         applier may still land — the same single-window race the
-        monolithic path has)."""
+        monolithic path has). The already-collected results ride the
+        raised PartialPlanError so the caller can account committed
+        chunks."""
         out: List[Optional[PlanResult]] = []
         in_flight: List = []
         next_i = 0
@@ -108,10 +125,10 @@ class LocalBackend:
                 self.eval_broker.outstanding_reset(
                     pending.plan.EvalID, pending.plan.EvalToken)
                 out.append(pending.wait(timeout=PLAN_WAIT))
-        except Exception:
+        except Exception as exc:
             for pending in in_flight:
                 pending.cancel()
-            raise
+            raise PartialPlanError(out, exc) from exc
         return out
 
     def eval_update(self, evals: List[Evaluation], token: str,
@@ -316,9 +333,15 @@ class Worker:
     def _dequeue_evaluation(self, timeout: float = DEQUEUE_TIMEOUT
                             ) -> Optional[Tuple[Evaluation, str, int]]:
         try:
+            if failpoints.fire("worker.dequeue") == "drop":
+                # A lost round still consumed its blocking window — an
+                # instant None would busy-spin every worker thread
+                # through the failpoint lock at full CPU.
+                time.sleep(timeout)
+                return None
             ev, token, wait_index = self.backend.dequeue(self.schedulers,
                                                          timeout)
-        except RuntimeError:
+        except (RuntimeError, failpoints.FailpointError):
             time.sleep(BACKOFF_BASELINE)
             return None
         if ev is None:
@@ -406,20 +429,60 @@ class Worker:
     def submit_plans(self, plans: List[Plan]
                      ) -> Tuple[List[Optional[PlanResult]], Optional[object]]:
         """Chunked-plan Planner seam: pipelined queue entry, one refresh
-        wait for the highest RefreshIndex across chunks."""
+        wait for the highest RefreshIndex across chunks.
+
+        A mid-sweep failure degrades instead of erroring — IF a prefix
+        committed: those chunks' results (PartialPlanError.results) are
+        kept, the unknown tail becomes None results, and the refresh
+        wait covers the committed AllocIndexes — so the scheduler's
+        retry snapshot SEES the partial commit and re-plans only the
+        remainder instead of nacking the whole eval. A total failure
+        (zero chunks committed) still raises: there is nothing to
+        account, and retrying against the same stale snapshot would
+        burn the eval's retry budget to a terminal Failed where a nack
+        redelivers it to a healthier worker or the new leader."""
         start = time.monotonic()
         for plan in plans:
             plan.EvalToken = self._token
+        partial = False
         try:
             submit = getattr(self.backend, "submit_plans", None)
             if submit is not None:
-                results = submit(plans)
+                try:
+                    results = submit(plans)
+                except PartialPlanError as exc:
+                    if not exc.results:
+                        raise  # nothing committed: nack + redeliver
+                    logger.warning("worker: %s", exc)
+                    results, partial = list(exc.results), True
             else:
-                results = [self.backend.submit_plan(p) for p in plans]
+                results = []
+                try:
+                    for p in plans:
+                        results.append(self.backend.submit_plan(p))
+                except Exception:
+                    if not results:
+                        raise  # nothing committed: nack + redeliver
+                    # Degrade to a partial sweep, but NEVER silently: the
+                    # cause may be a real bug, not an injected fault.
+                    logger.exception(
+                        "worker: plan sweep failed after %d chunk(s)",
+                        len(results))
+                    partial = True
         finally:
             metrics.measure_since(("nomad", "worker", "submit_plan"), start)
         refresh = max((r.RefreshIndex for r in results if r is not None),
                       default=0)
+        if partial:
+            logger.warning(
+                "worker: plan sweep committed %d/%d chunks before failing;"
+                " accounting the committed prefix",
+                sum(r is not None for r in results), len(plans))
+            results = results + [None] * (len(plans) - len(results))
+            # The retry snapshot must include the committed prefix, or
+            # the re-plan would double-place the chunks that landed.
+            refresh = max([refresh] + [r.AllocIndex for r in results
+                                       if r is not None])
         state = None
         if refresh > 0:
             self._wait_for_index(refresh)

@@ -81,6 +81,10 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers",
         "timing_retry: retry this timing-sensitive test once on failure")
+    config.addinivalue_line(
+        "markers",
+        "slow: multi-second storm/soak runs excluded from the tier-1 "
+        "sweep (`-m 'not slow'`); run with `-m slow` or NOMAD_TPU_SOAK=1")
 
 
 def pytest_runtest_protocol(item, nextitem):

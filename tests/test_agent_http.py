@@ -303,3 +303,86 @@ def test_debug_profile_returns_loadable_pstats(dev_agent, tmp_path):
     # The server's own threads were sampled: some known module shows up.
     files = {f for (f, _, _) in st.stats}
     assert any("nomad_tpu" in f or "threading" in f for f in files), files
+
+
+def test_debug_profile_rejects_malformed_seconds(dev_agent):
+    """Malformed ?seconds must be a client error (400), not an unhandled
+    ValueError surfacing as a 500."""
+    agent, api = dev_agent
+    with pytest.raises(APIError) as ei:
+        api.get("/v1/agent/debug/profile?seconds=banana")
+    assert ei.value.code == 400
+    assert "banana" in str(ei.value)
+
+
+class TestFaultsEndpoint:
+    """/v1/agent/debug/faults: the HTTP arming surface for the failpoint
+    registry (debug-gated like stacks/profile)."""
+
+    @pytest.fixture(autouse=True)
+    def _heal(self):
+        from nomad_tpu.resilience import failpoints
+
+        failpoints.disarm_all()
+        yield
+        failpoints.disarm_all()
+
+    def test_lists_known_sites_when_disarmed(self, dev_agent):
+        agent, api = dev_agent
+        sites = api.agent.faults()["Sites"]
+        assert "raft.fsync" in sites and "rpc.pool.call" in sites
+        assert len(sites) >= 10
+        assert all(info["armed"] is None or info["fired"] >= 0
+                   for info in sites.values())
+
+    def test_arm_inspect_disarm_round_trip(self, dev_agent):
+        agent, api = dev_agent
+        out = api.agent.arm_faults("gossip.send=drop:p=0.5;raft.fsync=off")
+        assert out["Touched"] == ["gossip.send", "raft.fsync"]
+        armed = out["Sites"]["gossip.send"]["armed"]
+        assert armed["mode"] == "drop" and armed["probability"] == 0.5
+        assert api.agent.disarm_faults()["DisarmedAll"] is True
+        assert api.agent.faults()["Sites"]["gossip.send"]["armed"] is None
+
+    def test_malformed_spec_is_a_400(self, dev_agent):
+        agent, api = dev_agent
+        with pytest.raises(APIError) as ei:
+            api.agent.arm_faults("gossip.send=explode")
+        assert ei.value.code == 400
+
+    def test_missing_spec_is_a_400(self, dev_agent):
+        agent, api = dev_agent
+        with pytest.raises(APIError) as ei:
+            api.put("/v1/agent/debug/faults", {})
+        assert ei.value.code == 400
+
+    def test_non_string_spec_is_a_400(self, dev_agent):
+        agent, api = dev_agent
+        with pytest.raises(APIError) as ei:
+            api.put("/v1/agent/debug/faults", {"Spec": 5})
+        assert ei.value.code == 400
+        assert "string" in str(ei.value)
+
+
+def test_register_surfaces_ignored_driver_config_warnings(dev_agent):
+    """Accepted-but-unimplemented docker config keys must come back to
+    the SUBMITTER as registration warnings, not vanish into a
+    once-per-process client log line."""
+    from nomad_tpu import mock
+
+    agent, api = dev_agent
+    job = mock.job()
+    task = job.TaskGroups[0].Tasks[0]
+    task.Driver = "docker"
+    task.Config = {"image": "busybox", "privileged": True,
+                   "dns_servers": ["8.8.8.8"]}
+    try:
+        eval_id, warnings, meta = api.jobs.register_with_warnings(job)
+        assert any("privileged" in w for w in warnings), warnings
+        assert any("dns_servers" in w for w in warnings), warnings
+        # The plain register keeps its 2-tuple shape for callers that
+        # don't care about warnings.
+        eval_id2, meta2 = api.jobs.register(job)
+        assert eval_id2
+    finally:
+        api.jobs.deregister(job.ID)

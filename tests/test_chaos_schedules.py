@@ -1,0 +1,269 @@
+"""Declarative chaos schedules: the one bespoke cluster-chaos test
+generalized into a family. Each test arms failpoints on a timeline
+(ChaosSchedule), applies load, heals, and asserts the SAME invariants
+(terminal evals, no lost/duplicated allocations, no oversubscription,
+index monotonicity, post-heal convergence) via resilience.chaos.
+
+The smoke schedule runs unconditionally at tier-1 speed; the
+multi-second storms are @pytest.mark.slow (run them with
+`pytest -m slow` or as part of a NOMAD_TPU_SOAK sweep)."""
+
+import time
+
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.resilience import failpoints
+from nomad_tpu.resilience.chaos import (
+    ChaosSchedule,
+    IndexProbe,
+    assert_invariants,
+)
+from nomad_tpu.rpc.cluster import ClusterServer
+from nomad_tpu.server.server import Server, ServerConfig
+from nomad_tpu.structs import to_dict
+from nomad_tpu.structs.structs import (
+    EvalStatusCancelled,
+    EvalStatusComplete,
+    EvalStatusFailed,
+    NodeStatusDown,
+    NodeStatusReady,
+)
+
+from helpers import wait_for  # noqa: E402
+from test_cluster_chaos import (  # noqa: E402
+    FAST,
+    PER_JOB,
+    _gaddr,
+    _rpc_retry,
+    boot,
+    leader_of,
+    make_job,
+)
+
+pytestmark = pytest.mark.timing_retry
+
+TERMINAL = (EvalStatusComplete, EvalStatusFailed, EvalStatusCancelled)
+
+
+@pytest.fixture(autouse=True)
+def _heal_failpoints():
+    failpoints.disarm_all()
+    yield
+    failpoints.disarm_all()
+
+
+def _all_terminal(state, eval_ids):
+    return all(
+        (ev := state.eval_by_id(eid)) is not None and ev.Status in TERMINAL
+        for eid in eval_ids)
+
+
+def _boot_single():
+    cs = ClusterServer(ServerConfig(node_id="", num_schedulers=1,
+                                    scheduler_window=8))
+    cs.connect([cs.addr], raft_config=FAST)
+    cs.start()
+    return cs
+
+
+class TestSmokeSchedule:
+    """Tier-1-speed schedule: runs unconditionally on every suite pass so
+    the failpoint seams and the harness itself can't silently rot."""
+
+    def test_dequeue_drop_and_commit_error_burst(self):
+        cs = _boot_single()
+        try:
+            assert wait_for(lambda: cs.server.is_leader(), timeout=15)
+            for _ in range(10):
+                cs.endpoints.handle("Node.Register",
+                                    {"Node": to_dict(mock.node())})
+            jobs = [make_job() for _ in range(6)]
+            eval_ids = []
+            probe = IndexProbe()
+            with ChaosSchedule(name="smoke") \
+                    .arm(0.0, "worker.dequeue=drop:p=0.5") \
+                    .arm(0.0, "plan.apply.commit=error:count=2") \
+                    .heal(0.6, "worker.dequeue") as sched:
+                for job in jobs:
+                    resp = cs.endpoints.handle("Job.Register",
+                                               {"Job": to_dict(job)})
+                    eval_ids.append(resp["EvalID"])
+                    probe.sample(cs.server.state)
+                    time.sleep(0.05)
+                sched.join(5.0)
+            snap = failpoints.snapshot()
+            assert snap["worker.dequeue"]["fired"] \
+                + snap["plan.apply.commit"]["fired"] >= 1, \
+                "schedule never hit a seam — sites renamed?"
+            assert wait_for(
+                lambda: _all_terminal(cs.server.state, eval_ids),
+                timeout=30, interval=0.1,
+                msg="evals terminal after smoke chaos")
+            probe.sample(cs.server.state)
+            assert not probe.violations, probe.violations
+            assert_invariants(cs.server.state, jobs, per_job=PER_JOB,
+                              eval_ids=eval_ids)
+        finally:
+            cs.shutdown()
+
+
+@pytest.mark.slow
+class TestStormSchedules:
+    """Multi-second storms against the networked 3-server cluster —
+    excluded from tier-1 (`-m 'not slow'`); the soak entry point runs
+    them alongside TestExtendedSoak."""
+
+    def _boot_three(self):
+        nodes = [boot("c0")]
+        nodes.append(boot("c1", join=[_gaddr(nodes[0])]))
+        nodes.append(boot("c2", join=[_gaddr(nodes[0])]))
+        assert wait_for(lambda: leader_of(nodes) is not None, timeout=30)
+        return nodes
+
+    def _storm(self, live, n_jobs, pause=0.05):
+        jobs = [make_job() for _ in range(n_jobs)]
+        eval_ids = []
+        for job in jobs:
+            resp = _rpc_retry(live, "Job.Register", {"Job": to_dict(job)})
+            eval_ids.append(resp["EvalID"])
+            time.sleep(pause)
+        return jobs, eval_ids
+
+    def _assert_converged(self, live, jobs, eval_ids, fired_site):
+        assert failpoints.snapshot()[fired_site]["fired"] >= 1, \
+            f"storm never hit {fired_site}"
+        assert wait_for(
+            lambda: (ldr := leader_of(live)) is not None
+            and _all_terminal(ldr.server.state, eval_ids),
+            timeout=120, interval=0.25,
+            msg="evals terminal after storm heal")
+        assert_invariants(leader_of(live).server.state, jobs,
+                          per_job=PER_JOB, eval_ids=eval_ids)
+
+    def test_raft_message_loss_burst(self):
+        """Leader->peer AppendEntries/RequestVote datagrams drop at p=0.6
+        for two seconds mid-storm; replication stalls and elections churn,
+        then the burst heals and every eval must still land exactly
+        once."""
+        nodes = self._boot_three()
+        try:
+            for _ in range(20):
+                _rpc_retry(nodes, "Node.Register",
+                           {"Node": to_dict(mock.node())})
+            with ChaosSchedule(name="raft-loss") \
+                    .arm(0.5, "raft.append_entries=drop:p=0.6") \
+                    .arm(0.5, "raft.request_vote=drop:p=0.3") \
+                    .heal(2.5, "raft.append_entries",
+                          "raft.request_vote") as sched:
+                jobs, eval_ids = self._storm(nodes, 20)
+                sched.join(10.0)
+            self._assert_converged(nodes, jobs, eval_ids,
+                                   "raft.append_entries")
+        finally:
+            for n in nodes:
+                n.shutdown()
+
+    def test_rpc_drop_and_heal(self):
+        """The wire itself goes bad: pooled client calls and server-side
+        dispatch both black-hole a fraction of traffic (lost connections,
+        not clean errors), driving the failover + retry paths, then
+        heal."""
+        nodes = self._boot_three()
+        try:
+            for _ in range(20):
+                _rpc_retry(nodes, "Node.Register",
+                           {"Node": to_dict(mock.node())})
+            with ChaosSchedule(name="rpc-drop") \
+                    .arm(0.3, "rpc.pool.call=drop:p=0.4") \
+                    .arm(0.3, "rpc.server.handle=drop:p=0.3") \
+                    .heal(2.0, "rpc.pool.call",
+                          "rpc.server.handle") as sched:
+                jobs, eval_ids = self._storm(nodes, 20)
+                sched.join(10.0)
+            self._assert_converged(nodes, jobs, eval_ids,
+                                   "rpc.server.handle")
+        finally:
+            for n in nodes:
+                n.shutdown()
+
+
+@pytest.mark.slow
+class TestHeartbeatDelayStorm:
+    """A real client's heartbeats are delayed past the server's TTL: the
+    node must degrade to down (TTL expiry), the client must recover it
+    via re-registration once the storm heals, and scheduling must work
+    afterwards — the full graceful-degradation round trip."""
+
+    def test_node_flaps_down_then_recovers(self, tmp_path):
+        from nomad_tpu.client.client import Client, ClientConfig
+        from nomad_tpu.client.rpc import InProcServerChannel
+
+        srv = Server(ServerConfig(num_schedulers=1,
+                                  min_heartbeat_ttl=0.3,
+                                  heartbeat_grace=0.2))
+        srv.establish_leadership()
+        cfg = ClientConfig(
+            state_dir=str(tmp_path / "state"),
+            alloc_dir=str(tmp_path / "alloc"),
+            options={"driver.raw_exec.enable": "true"})
+        client = Client(cfg, InProcServerChannel(srv))
+        client.start()
+        try:
+            assert wait_for(
+                lambda: (n := srv.state.node_by_id(client.node.ID))
+                is not None and n.Status == NodeStatusReady, timeout=15)
+
+            went_down = []
+            with ChaosSchedule(name="hb-delay") \
+                    .arm(0.2, "client.heartbeat=delay(1.0)") \
+                    .heal(2.4, "client.heartbeat") as sched:
+                # Degradation: a 1s delay against a ~0.5s TTL+grace
+                # budget must knock the node down at least once.
+                assert wait_for(
+                    lambda: srv.state.node_by_id(
+                        client.node.ID).Status == NodeStatusDown,
+                    timeout=10, interval=0.05,
+                    msg="delayed heartbeats never expired the TTL")
+                went_down.append(True)
+                sched.join(10.0)
+            assert failpoints.snapshot()["client.heartbeat"]["fired"] >= 1
+
+            # Recovery: the down-node heartbeat is rejected, the client
+            # re-registers, and the node settles back to ready.
+            assert wait_for(
+                lambda: srv.state.node_by_id(
+                    client.node.ID).Status == NodeStatusReady,
+                timeout=15, interval=0.1,
+                msg="node never re-registered after the storm healed")
+
+            # And the recovered node still schedules work.
+            from nomad_tpu.jobspec import parse_job
+
+            job = parse_job('''
+job "post-storm" {
+  datacenters = ["dc1"]
+  type = "service"
+  group "g" {
+    count = 2
+    task "t" {
+      driver = "raw_exec"
+      config { command = "/bin/sh" args = ["-c", "sleep 3600"] }
+      resources { cpu = 20 memory = 16 disk = 300 }
+    }
+  }
+}
+''')
+            eval_id, _, _ = srv.job_register(job)
+            assert wait_for(
+                lambda: _all_terminal(srv.state, [eval_id]),
+                timeout=30, msg="post-storm eval terminal")
+            assert wait_for(
+                lambda: len([a for a in srv.state.allocs_by_job(job.ID)
+                             if not a.terminal_status()]) == 2,
+                timeout=30, msg="post-storm allocs placed")
+            assert_invariants(srv.state, [job], per_job=2,
+                              eval_ids=[eval_id])
+        finally:
+            client.shutdown()
+            srv.shutdown()

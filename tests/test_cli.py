@@ -21,7 +21,7 @@ def dev_agent():
     a = Agent(AgentConfig(server_enabled=True, client_enabled=True,
                           dev_mode=True, http_port=0, rpc_port=0,
                           serf_port=0, node_name="cli-dev",
-                          num_schedulers=1,
+                          num_schedulers=1, enable_debug=True,
                           options={"driver.raw_exec.enable": "true"}))
     a.start()
     assert wait_for(lambda: a.server.is_leader() and a.server._leader)
@@ -77,6 +77,27 @@ class TestJobLifecycle:
         assert wait_for(lambda: (
             (e := dev_agent.server.state.eval_by_id(eval_id)) is not None
             and e.Status == EvalStatusComplete), timeout=60)
+
+    def test_validate_prints_ignored_driver_key_warnings(self, capsys,
+                                                         tmp_path):
+        """`validate` is offline, so the ignored-config warnings must be
+        computed locally — same contract as the register path."""
+        path = tmp_path / "priv.nomad"
+        path.write_text('''
+job "priv" {
+  datacenters = ["dc1"]
+  group "g" {
+    task "t" {
+      driver = "docker"
+      config { image = "busybox" privileged = true }
+      resources { cpu = 20 memory = 16 disk = 300 }
+    }
+  }
+}
+''')
+        rc, out, err = run_cli(capsys, "validate", str(path))
+        assert rc == 0
+        assert "privileged" in err and "ignored" in err
 
     def test_status_inspect_stop(self, capsys, address, jobfile):
         rc, out, _ = run_cli(capsys, "status", "-address", address)
@@ -163,6 +184,32 @@ class TestClusterCommands:
 
         rc, out, _ = run_cli(capsys, "client-config", "-address", address)
         assert rc == 0
+
+    def test_faults_list_arm_disarm(self, capsys, address):
+        """`nomad-tpu faults` drives the failpoint registry end to end
+        through the debug-gated HTTP endpoint."""
+        from nomad_tpu.resilience import failpoints
+
+        try:
+            rc, out, _ = run_cli(capsys, "faults", "-address", address)
+            assert rc == 0 and "raft.fsync" in out
+
+            rc, out, _ = run_cli(capsys, "faults", "-address", address,
+                                 "gossip.send=drop:count=3")
+            assert rc == 0 and "gossip.send" in out
+
+            rc, out, _ = run_cli(capsys, "faults", "-address", address)
+            assert rc == 0
+            armed_line = next(ln for ln in out.splitlines()
+                              if ln.startswith("gossip.send"))
+            assert "drop" in armed_line
+
+            rc, out, _ = run_cli(capsys, "faults", "-address", address,
+                                 "--disarm-all")
+            assert rc == 0 and "disarmed" in out.lower()
+            assert failpoints.fire("gossip.send") is None
+        finally:
+            failpoints.disarm_all()
 
     def test_unknown_job_errors_cleanly(self, capsys, address):
         rc, out, err = run_cli(capsys, "status", "-address", address,

@@ -1,0 +1,666 @@
+"""Resilience subsystem unit tests: failpoint modes (armed and the
+disarmed fast path), the spec grammar shared by env var/CLI/HTTP, the
+unified retry/backoff policy (jitter bounds, deadline expiry), the
+circuit breaker's closed/open/half-open cycle, the rpcproxy quarantine
+built on it, and the chaos-schedule runner itself."""
+
+import threading
+import time
+import types
+
+import pytest
+
+from nomad_tpu.resilience import failpoints
+from nomad_tpu.resilience.chaos import ChaosSchedule
+from nomad_tpu.resilience.retry import Backoff, CircuitBreaker, RetryPolicy
+
+
+@pytest.fixture(autouse=True)
+def _clean_failpoints():
+    """A leaked armed failpoint would poison every later test in the
+    process; heal unconditionally around each one."""
+    failpoints.disarm_all()
+    yield
+    failpoints.disarm_all()
+
+
+class FakeClock:
+    def __init__(self, t: float = 0.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+# ------------------------------------------------------------- failpoints
+class TestFailpointModes:
+    def test_disarmed_fast_path_returns_none(self):
+        assert failpoints.fire("nonexistent.site") is None
+        # The fast path must not record anything either.
+        assert failpoints.snapshot().get("nonexistent.site") is None
+
+    def test_error_mode_raises_with_site(self):
+        failpoints.arm("t.err", "error", message="boom")
+        with pytest.raises(failpoints.FailpointError) as ei:
+            failpoints.fire("t.err")
+        assert ei.value.site == "t.err"
+        assert "boom" in str(ei.value)
+
+    def test_delay_mode_sleeps_then_proceeds(self):
+        failpoints.arm("t.delay", "delay", delay=0.05)
+        t0 = time.monotonic()
+        assert failpoints.fire("t.delay") is None
+        assert time.monotonic() - t0 >= 0.04
+
+    def test_drop_mode_returns_drop(self):
+        failpoints.arm("t.drop", "drop")
+        assert failpoints.fire("t.drop") == "drop"
+
+    def test_count_auto_disarms(self):
+        failpoints.arm("t.once", "drop", count=2)
+        assert failpoints.fire("t.once") == "drop"
+        assert failpoints.fire("t.once") == "drop"
+        assert failpoints.fire("t.once") is None  # spent
+        assert failpoints.snapshot()["t.once"]["armed"] is None
+        assert failpoints.snapshot()["t.once"]["fired"] == 2
+
+    def test_probability_gates_triggering(self, monkeypatch):
+        rolls = iter([0.9, 0.1, 0.9, 0.1])
+        monkeypatch.setattr(
+            failpoints, "random",
+            types.SimpleNamespace(random=lambda: next(rolls)))
+        failpoints.arm("t.p", "drop", probability=0.5)
+        assert failpoints.fire("t.p") is None      # 0.9 >= 0.5: no trigger
+        assert failpoints.fire("t.p") == "drop"    # 0.1 <  0.5: trigger
+        assert failpoints.fire("t.p") is None
+        assert failpoints.fire("t.p") == "drop"
+        assert failpoints.snapshot()["t.p"]["fired"] == 2
+
+    def test_untriggered_probability_does_not_consume_count(
+            self, monkeypatch):
+        monkeypatch.setattr(failpoints, "random",
+                            types.SimpleNamespace(random=lambda: 0.99))
+        failpoints.arm("t.pc", "drop", probability=0.5, count=1)
+        for _ in range(5):
+            assert failpoints.fire("t.pc") is None
+        assert failpoints.snapshot()["t.pc"]["armed"] is not None
+
+    def test_disarm_and_disarm_all(self):
+        failpoints.arm("t.a", "drop")
+        failpoints.arm("t.b", "drop")
+        assert failpoints.disarm("t.a") is True
+        assert failpoints.disarm("t.a") is False
+        assert failpoints.fire("t.a") is None
+        failpoints.disarm_all()
+        assert failpoints.fire("t.b") is None
+
+    def test_invalid_specs_rejected(self):
+        for bad in ["x.y=explode", "x.y=delay", "x.y=error:p=abc",
+                    "x.y=drop:count=0", "x.y=drop:wat=1", "=error", "x.y="]:
+            with pytest.raises(ValueError):
+                failpoints.arm_from_spec(bad)
+        with pytest.raises(ValueError):
+            failpoints.arm("x.y", "drop", probability=1.5)
+
+    def test_spec_grammar_round_trip(self):
+        touched = failpoints.arm_from_spec(
+            "a.b=error(boom):count=2; c.d=delay(0.25):p=0.5 ;e.f=drop:once")
+        assert touched == ["a.b", "c.d", "e.f"]
+        snap = failpoints.snapshot()
+        assert snap["a.b"]["armed"]["mode"] == "error"
+        assert snap["a.b"]["armed"]["remaining"] == 2
+        assert snap["c.d"]["armed"] == {"mode": "delay", "delay": 0.25,
+                                        "probability": 0.5,
+                                        "remaining": None, "hits": 0}
+        assert snap["e.f"]["armed"]["remaining"] == 1
+        failpoints.arm_from_spec("a.b=off;c.d=off;e.f=off")
+        # Never-fired ad-hoc sites drop out of the snapshot entirely once
+        # disarmed; either way nothing fires.
+        assert all(
+            failpoints.snapshot().get(s, {"armed": None})["armed"] is None
+            for s in ("a.b", "c.d", "e.f"))
+
+    def test_malformed_clause_arms_nothing(self):
+        """A rejected spec (HTTP 400) must leave NO clause armed — an
+        operator who sees the request fail must not discover later that
+        the first half of it took effect."""
+        with pytest.raises(ValueError):
+            failpoints.arm_from_spec(
+                "atomic.ok=error;atomic.bad=explode")
+        assert failpoints.snapshot().get(
+            "atomic.ok", {"armed": None})["armed"] is None
+        assert failpoints.fire("atomic.ok") is None
+
+    def test_env_arming(self):
+        sites = failpoints.arm_from_env(
+            {failpoints.ENV_VAR: "env.site=drop:count=1"})
+        assert sites == ["env.site"]
+        assert failpoints.fire("env.site") == "drop"
+        assert failpoints.arm_from_env({}) == []
+
+    def test_malformed_env_spec_does_not_crash_import(self):
+        """Every entry point imports this module transitively; a typo'd
+        NOMAD_TPU_FAILPOINTS must warn on stderr, not raise at import
+        (which would even kill `faults --disarm-all`)."""
+        import os
+        import subprocess
+        import sys
+
+        proc = subprocess.run(
+            [sys.executable, "-c",
+             "from nomad_tpu.resilience import failpoints; "
+             "print('alive')"],
+            env={**os.environ, failpoints.ENV_VAR: "raft.fsync=explode"},
+            capture_output=True, text=True, timeout=60)
+        assert proc.returncode == 0, proc.stderr
+        assert "alive" in proc.stdout
+        assert "ignoring malformed" in proc.stderr
+
+    def test_known_sites_cover_five_subsystems(self):
+        """The acceptance floor: >= 10 sites spanning rpc, raft, gossip,
+        server-side scheduling, and the client/driver layer."""
+        sites = failpoints.known_sites()
+        assert len(sites) >= 10
+        prefixes = {s.split(".")[0] for s in sites}
+        assert {"rpc", "raft", "gossip", "client", "driver",
+                "plan", "worker"} <= prefixes
+
+
+class TestFailpointSitesFire:
+    """Each production seam actually consults its failpoint (grep-proof:
+    arming the documented name changes behavior at that layer)."""
+
+    def test_rpc_pool_call_drop(self):
+        from nomad_tpu.rpc.pool import ConnError, ConnPool
+
+        failpoints.arm("rpc.pool.call", "drop")
+        with pytest.raises(ConnError):
+            ConnPool().call("127.0.0.1:1", "Any.Method", {})
+
+    def test_rpc_server_handle_drop(self):
+        from nomad_tpu.rpc.cluster import ClusterServer
+        from nomad_tpu.rpc.pool import ConnError
+        from nomad_tpu.server.server import ServerConfig
+
+        cs = ClusterServer(ServerConfig(bootstrap_expect=1,
+                                        num_schedulers=0))
+        cs.connect([])
+        cs.start()
+        try:
+            failpoints.arm("rpc.server.handle", "drop", count=1)
+            with pytest.raises(ConnError):
+                cs.endpoints.handle("Status.Ping", {})
+            cs.endpoints.handle("Status.Ping", {})  # healed after count
+        finally:
+            cs.shutdown()
+
+    def test_drop_kills_connection_but_real_conn_error_serializes(self):
+        """Only the INJECTED DroppedRPCError may kill the client
+        connection; a real ConnError escaping a handler (a dead leader
+        forward) must serialize as a remote error exactly as it did
+        before failpoints existed — otherwise every stale-leader-hint
+        forward failure would masquerade as a dead follower and feed the
+        client's breakers."""
+        from nomad_tpu.rpc.cluster import ClusterServer
+        from nomad_tpu.rpc.pool import (
+            ConnError,
+            ConnPool,
+            DroppedRPCError,
+            RPCError,
+        )
+        from nomad_tpu.server.server import ServerConfig
+
+        cs = ClusterServer(ServerConfig(bootstrap_expect=1,
+                                        num_schedulers=0))
+        cs.connect([])
+        cs.start()
+        pool = ConnPool()
+        try:
+            def dead_forward(body):
+                raise ConnError("connection refused (dead leader)")
+
+            cs.endpoints._methods["Status.Ping"] = dead_forward
+            with pytest.raises(RPCError):
+                pool.call(cs.addr, "Status.Ping", {}, timeout=10)
+
+            def injected(body):
+                raise DroppedRPCError("blackholed")
+
+            cs.endpoints._methods["Status.Ping"] = injected
+            with pytest.raises(ConnError):
+                pool.call(cs.addr, "Status.Ping", {}, timeout=10)
+        finally:
+            pool.close()
+            cs.shutdown()
+
+    def test_raft_fsync_error_and_drop(self, tmp_path):
+        from nomad_tpu.raft.log import FileLogStore, LogEntry
+
+        store = FileLogStore(str(tmp_path))
+        store.store_entries([LogEntry(Index=1, Term=1, Type=0, Data=b"a")])
+        failpoints.arm("raft.fsync", "error")
+        with pytest.raises(failpoints.FailpointError):
+            store.store_entries(
+                [LogEntry(Index=2, Term=1, Type=0, Data=b"b")])
+        failpoints.arm_from_spec("raft.fsync=drop")
+        # Lying-disk mode: append succeeds, fsync silently skipped.
+        store.store_entries([LogEntry(Index=3, Term=1, Type=0, Data=b"c")])
+        assert store.last_index() == 3
+
+    def test_gossip_send_drop_loses_datagram(self):
+        from nomad_tpu.gossip.memberlist import GossipConfig, Memberlist
+
+        ml = Memberlist("fp-test", port=0, config=GossipConfig.fast())
+        try:
+            failpoints.arm("gossip.send", "drop")
+            # Must swallow the send entirely — no socket error, no traffic.
+            ml._send_udp(("127.0.0.1", 9), [{"t": "ping"}])
+            assert failpoints.snapshot()["gossip.send"]["fired"] == 1
+        finally:
+            ml.shutdown()
+
+
+# ---------------------------------------------------------------- backoff
+class TestBackoff:
+    def test_jitter_stays_within_bounds(self):
+        import random as _random
+
+        bo = Backoff(base=0.1, cap=2.0, rng=_random.Random(42))
+        prev = bo.base
+        for _ in range(200):
+            d = bo.next()
+            assert 0.1 <= d <= 2.0
+            assert d <= min(2.0, prev * 3) + 1e-9
+            prev = d
+
+    def test_reset_restarts_sequence(self):
+        bo = Backoff(base=1.0, cap=100.0)
+        first = bo.next()
+        assert first <= 3.0  # uniform(base, 3*base) on the first draw
+        for _ in range(10):
+            bo.next()
+        bo.reset()
+        assert bo.next() <= 3.0
+
+    def test_validation(self):
+        with pytest.raises(ValueError):
+            Backoff(base=0.0)
+        with pytest.raises(ValueError):
+            Backoff(base=1.0, cap=0.5)
+
+
+# ----------------------------------------------------------- retry policy
+class TestRetryPolicy:
+    def test_succeeds_after_transient_failures(self):
+        sleeps = []
+        calls = {"n": 0}
+
+        def flaky():
+            calls["n"] += 1
+            if calls["n"] < 3:
+                raise ConnectionError("transient")
+            return "ok"
+
+        policy = RetryPolicy(max_attempts=5, sleep=sleeps.append)
+        assert policy.call(flaky) == "ok"
+        assert calls["n"] == 3
+        assert len(sleeps) == 2
+
+    def test_attempts_exhausted_reraises_last(self):
+        calls = {"n": 0}
+
+        def always():
+            calls["n"] += 1
+            raise ValueError(f"attempt {calls['n']}")
+
+        policy = RetryPolicy(max_attempts=3, sleep=lambda d: None)
+        with pytest.raises(ValueError, match="attempt 3"):
+            policy.call(always)
+        assert calls["n"] == 3
+
+    def test_deadline_expiry(self):
+        clock = FakeClock()
+
+        def ticking_sleep(d):
+            clock.advance(d)
+
+        policy = RetryPolicy(max_attempts=None, deadline=1.0,
+                             backoff=Backoff(base=0.4, cap=0.4),
+                             sleep=ticking_sleep, clock=clock)
+        calls = {"n": 0}
+
+        def always():
+            calls["n"] += 1
+            raise ConnectionError("down")
+
+        with pytest.raises(ConnectionError):
+            policy.call(always)
+        # ~0.4s per retry against a 1.0s budget: a handful of attempts,
+        # not an unbounded loop, and never a sleep past the deadline.
+        assert 2 <= calls["n"] <= 5
+        assert clock.t <= 1.0 + 0.4
+
+    def test_non_retryable_exception_surfaces_immediately(self):
+        calls = {"n": 0}
+
+        def bad():
+            calls["n"] += 1
+            raise TypeError("never retry me")
+
+        policy = RetryPolicy(max_attempts=5, retry_on=(ValueError,),
+                             sleep=lambda d: None)
+        with pytest.raises(TypeError):
+            policy.call(bad)
+        assert calls["n"] == 1
+
+    def test_should_retry_filter(self):
+        policy = RetryPolicy(
+            max_attempts=5, sleep=lambda d: None,
+            should_retry=lambda e: "retryable" in str(e))
+        calls = {"n": 0}
+
+        def terminal():
+            calls["n"] += 1
+            raise RuntimeError("terminal")
+
+        with pytest.raises(RuntimeError):
+            policy.call(terminal)
+        assert calls["n"] == 1
+
+    def test_on_retry_hook_observes_each_retry(self):
+        seen = []
+        policy = RetryPolicy(
+            max_attempts=3, sleep=lambda d: None,
+            on_retry=lambda exc, attempt, delay: seen.append(
+                (type(exc).__name__, attempt, delay)))
+        with pytest.raises(OSError):
+            policy.call(self._always_oserror)
+        assert [(n, a) for n, a, _ in seen] == [("OSError", 1),
+                                               ("OSError", 2)]
+        assert all(d > 0 for _, _, d in seen)
+
+    @staticmethod
+    def _always_oserror():
+        raise OSError("io")
+
+    def test_shutdown_aware_sleep_aborts(self):
+        """A set Event passed as `sleep` stops the loop mid-budget — the
+        pattern client loops use so shutdown isn't stuck in a backoff."""
+        ev = threading.Event()
+        ev.set()
+        policy = RetryPolicy(max_attempts=100, sleep=ev.wait)
+        calls = {"n": 0}
+
+        def always():
+            calls["n"] += 1
+            raise ConnectionError("down")
+
+        with pytest.raises(ConnectionError):
+            policy.call(always)
+        assert calls["n"] == 1
+
+    def test_needs_some_bound(self):
+        with pytest.raises(ValueError):
+            RetryPolicy(max_attempts=None, deadline=None)
+
+
+# -------------------------------------------------------- circuit breaker
+class TestCircuitBreaker:
+    def test_closed_until_threshold(self):
+        clock = FakeClock()
+        cb = CircuitBreaker(failure_threshold=3, reset_timeout=10.0,
+                            clock=clock)
+        assert cb.state == CircuitBreaker.CLOSED
+        cb.record_failure()
+        cb.record_failure()
+        assert cb.allow()
+        cb.record_failure()
+        assert cb.state == CircuitBreaker.OPEN
+        assert not cb.allow()
+
+    def test_half_open_allows_single_probe(self):
+        clock = FakeClock()
+        cb = CircuitBreaker(failure_threshold=1, reset_timeout=10.0,
+                            clock=clock)
+        cb.record_failure()
+        assert not cb.allow()
+        clock.advance(10.0)
+        assert cb.state == CircuitBreaker.HALF_OPEN
+        assert cb.allow()       # the one probe
+        assert not cb.allow()   # concurrent callers held out
+
+    def test_probe_failure_reopens_and_restarts_timer(self):
+        clock = FakeClock()
+        cb = CircuitBreaker(failure_threshold=1, reset_timeout=10.0,
+                            clock=clock)
+        cb.record_failure()
+        clock.advance(10.0)
+        assert cb.allow()
+        cb.record_failure()
+        assert cb.state == CircuitBreaker.OPEN
+        clock.advance(5.0)  # old timer would have expired; new one didn't
+        assert not cb.allow()
+        clock.advance(5.0)
+        assert cb.allow()
+
+    def test_probe_success_closes(self):
+        clock = FakeClock()
+        cb = CircuitBreaker(failure_threshold=2, reset_timeout=10.0,
+                            clock=clock)
+        cb.record_failure()
+        cb.record_failure()
+        clock.advance(10.0)
+        assert cb.allow()
+        cb.record_success()
+        assert cb.state == CircuitBreaker.CLOSED
+        assert cb.allow() and cb.allow()  # fully closed, not probing
+
+    def test_success_resets_failure_streak(self):
+        cb = CircuitBreaker(failure_threshold=2, reset_timeout=10.0,
+                            clock=FakeClock())
+        cb.record_failure()
+        cb.record_success()
+        cb.record_failure()
+        assert cb.state == CircuitBreaker.CLOSED
+
+
+class TestRpcProxyQuarantine:
+    def test_dead_server_skipped_then_degrades_gracefully(self):
+        from nomad_tpu.client.rpc import RpcProxy
+
+        proxy = RpcProxy(["a:1", "b:1"])
+        for _ in range(RpcProxy.BREAKER_FAILURES):
+            proxy.notify_failed("a:1")
+        assert proxy.quarantined() == ["a:1"]
+        assert proxy.find_server() == "b:1"
+        # Now the whole fleet looks dead: serve the head anyway instead
+        # of turning a transient total outage into a permanent one.
+        for _ in range(RpcProxy.BREAKER_FAILURES):
+            proxy.notify_failed("b:1")
+        assert proxy.find_server() is not None
+        # A success (e.g. the outage ends) lifts the quarantine.
+        proxy.notify_success("b:1")
+        assert proxy.find_server() == "b:1"
+        assert "b:1" not in proxy.quarantined()
+
+    def test_update_prunes_breakers_for_removed_servers(self):
+        from nomad_tpu.client.rpc import RpcProxy
+
+        proxy = RpcProxy(["a:1", "b:1"])
+        for _ in range(RpcProxy.BREAKER_FAILURES):
+            proxy.notify_failed("a:1")
+        proxy.update(["b:1", "c:1"])
+        # "a:1" left the fleet: re-adding it starts with a clean breaker.
+        proxy.update(["a:1", "b:1", "c:1"])
+        assert proxy.quarantined() == []
+
+    def test_rebalance_feeds_breakers(self):
+        """A successful rebalance ping is a health probe: it must close
+        the target's breaker (a quarantined-but-recovered server becomes
+        routable immediately, not after the reset window), and a failed
+        ping must count as breaker evidence."""
+        from nomad_tpu.client.rpc import RpcProxy
+
+        proxy = RpcProxy(["a:1", "b:1"])
+        for _ in range(RpcProxy.BREAKER_FAILURES):
+            proxy.notify_failed("a:1")
+        assert proxy.quarantined() == ["a:1"]
+        assert proxy.rebalance(lambda addr: addr == "a:1") == "a:1"
+        assert proxy.quarantined() == []
+        assert proxy.find_server() == "a:1"
+        # And a failed ping is breaker evidence: an all-dead sweep pings
+        # every server, so BREAKER_FAILURES sweeps quarantine them all.
+        for _ in range(RpcProxy.BREAKER_FAILURES):
+            assert proxy.rebalance(lambda addr: False) is None
+        assert proxy.quarantined() == ["a:1", "b:1"]
+
+
+# --------------------------------------------------------- chaos schedule
+class TestChaosSchedule:
+    def test_events_fire_in_order_and_heal_on_exit(self):
+        with ChaosSchedule(name="t") \
+                .arm(0.0, "sched.x=drop", name="arm-x") \
+                .heal(0.05, "sched.x") \
+                .arm(0.1, "sched.y=drop", name="arm-y") as sched:
+            sched.join(5.0)
+        assert sched.fired == ["arm-x", "heal sched.x", "arm-y"]
+        # Context exit healed sched.y even though no heal event did.
+        assert failpoints.fire("sched.y") is None
+
+    def test_heals_even_when_body_throws(self):
+        with pytest.raises(RuntimeError):
+            with ChaosSchedule().arm(0.0, "sched.z=drop") as sched:
+                sched.join(5.0)
+                raise RuntimeError("test body exploded")
+        assert failpoints.fire("sched.z") is None
+
+    def test_stop_cancels_pending_events(self):
+        sched = ChaosSchedule().arm(30.0, "sched.never=drop").start()
+        sched.stop()
+        assert sched.fired == []
+        assert failpoints.fire("sched.never") is None
+
+    def test_custom_actions_run_on_schedule(self):
+        hits = []
+        with ChaosSchedule().call(0.0, lambda: hits.append("a")) \
+                .call(0.02, lambda: hits.append("b")) as sched:
+            sched.join(5.0)
+        assert hits == ["a", "b"]
+
+
+# ----------------------------------------------- partial-commit accounting
+class TestPartialPlanAccounting:
+    def test_submit_plans_accounts_committed_prefix(self):
+        """A mid-sweep failure must keep the committed chunks' results
+        (they ARE in raft) and extend the refresh wait over them, so the
+        retrying scheduler sees the partial commit instead of
+        double-placing it (the ADVICE.md partial-commit leftover)."""
+        from nomad_tpu.server.worker import PartialPlanError, Worker
+        from nomad_tpu.structs.structs import Plan, PlanResult
+
+        committed = PlanResult(RefreshIndex=7)
+        committed.AllocIndex = 9
+
+        class Backend:
+            def submit_plans(self, plans):
+                raise PartialPlanError([committed],
+                                       RuntimeError("applier died"))
+
+        waited = []
+        w = Worker.__new__(Worker)
+        w.backend = Backend()
+        w._token = "tok"
+        w.raft = types.SimpleNamespace(
+            fsm=types.SimpleNamespace(
+                state=types.SimpleNamespace(snapshot=lambda: "SNAP")))
+        w._wait_for_index = waited.append
+
+        results, state = w.submit_plans([Plan(), Plan(), Plan()])
+        assert results == [committed, None, None]
+        assert waited == [9]  # covers the committed AllocIndex, not just 7
+        assert state == "SNAP"
+
+    def test_total_failure_still_raises(self):
+        """Zero chunks committed = nothing to account: the sweep must
+        raise so the worker nacks and the broker redelivers, instead of
+        burning the eval's retry budget against the same stale
+        snapshot."""
+        from nomad_tpu.server.worker import PartialPlanError, Worker
+        from nomad_tpu.structs.structs import Plan
+
+        class Backend:
+            def submit_plans(self, plans):
+                raise PartialPlanError([], RuntimeError("applier down"))
+
+        w = Worker.__new__(Worker)
+        w.backend = Backend()
+        w._token = "tok"
+        with pytest.raises(PartialPlanError):
+            w.submit_plans([Plan(), Plan()])
+
+        class SeqBackend:
+            def submit_plan(self, plan):
+                raise RuntimeError("applier down")
+
+        w.backend = SeqBackend()
+        with pytest.raises(RuntimeError):
+            w.submit_plans([Plan(), Plan()])
+
+    def test_local_backend_attaches_partial_results(self):
+        """LocalBackend.submit_plans must not drop already-committed chunk
+        results when a later wait raises."""
+        from nomad_tpu.server.worker import LocalBackend, PartialPlanError
+
+        class PendingOK:
+            plan = types.SimpleNamespace(EvalID="e", EvalToken="t")
+
+            def wait(self, timeout=None):
+                return "r0"
+
+            def cancel(self):
+                pass
+
+        class PendingBoom(PendingOK):
+            def wait(self, timeout=None):
+                raise RuntimeError("apply failed")
+
+            def __init__(self):
+                self.cancelled = False
+
+            def cancel(self):
+                self.cancelled = True
+
+        class PendingTail(PendingOK):
+            def __init__(self):
+                self.cancelled = False
+
+            def cancel(self):
+                self.cancelled = True
+
+        boom, tail = PendingBoom(), PendingTail()
+
+        class Queue:
+            def __init__(self):
+                self._q = [PendingOK(), boom, tail]
+
+            def enqueue(self, plan):
+                return self._q.pop(0)
+
+        class Broker:
+            def outstanding_reset(self, eval_id, token):
+                pass
+
+        backend = LocalBackend.__new__(LocalBackend)
+        backend.plan_queue = Queue()
+        backend.eval_broker = Broker()
+
+        plans = [types.SimpleNamespace(EvalID="e", EvalToken="t")
+                 for _ in range(3)]
+        with pytest.raises(PartialPlanError) as ei:
+            backend.submit_plans(plans)
+        assert ei.value.results == ["r0"]
+        assert boom.cancelled is False  # it already left the queue
+        assert tail.cancelled is True   # still queued: must not commit

@@ -116,6 +116,16 @@ class TestDiffSystemAllocs:
         assert len(diff.place) == 1
         assert diff.place[0].Alloc.NodeID == foo.ID
 
+    def test_duplicate_node_entries_place_once(self):
+        """A node list with duplicate entries (double-registered, merged
+        from two sources) must not double-place the system task group on
+        that node."""
+        job = mock.system_job()
+        node = mock.node()
+        diff = diff_system_allocs(job, [node, node], {}, [])
+        assert len(diff.place) == 1
+        assert diff.place[0].Alloc.NodeID == node.ID
+
 
 class TestReadyAndTainted:
     def _store(self):

@@ -63,6 +63,25 @@ C5_PLACEMENTS = int(os.environ.get("BENCH_C5_PLACEMENTS", 20_000))
 RUN_C5 = os.environ.get("BENCH_C5", "1") != "0"
 RUN_C2 = os.environ.get("BENCH_C2", "1") != "0"
 RUN_C4 = os.environ.get("BENCH_C4", "1") != "0"
+# Placement-parity gate shape (bench_placement_parity).
+PARITY_NODES = 1000
+PARITY_EVALS = 40
+
+
+def _apply_smoke():
+    """--smoke: tiny CPU-safe shapes, <60s end to end. Same code path as
+    the full bench — live server, pipelined worker, plan applier, and the
+    placement-parity quality gate — so perf-path breakage is caught
+    in-tree (tests/test_bench_smoke.py) without a TPU bench run. Numbers
+    from a smoke run are NOT comparable to the headline shapes."""
+    global N_NODES, N_PLACEMENTS, N_REPS, CPU_REF_EVALS
+    global RUN_C2, RUN_C4, RUN_C5, PARITY_NODES, PARITY_EVALS
+    N_NODES = min(N_NODES, 512)
+    N_PLACEMENTS = min(N_PLACEMENTS, 2000)   # 40 evals @ PER_EVAL=50
+    N_REPS = min(N_REPS, 3)
+    CPU_REF_EVALS = min(CPU_REF_EVALS, 6)
+    RUN_C2 = RUN_C4 = RUN_C5 = False
+    PARITY_NODES, PARITY_EVALS = 200, 10
 
 
 def _freeze_heap():
@@ -218,11 +237,13 @@ def bench_server_e2e(nodes, n_evals):
         # Attribute phase timers to the timed reps only, not warmup compiles.
         # Quiesce first: evals complete (visibly) at the EvalUpdate apply,
         # before the build stage's final stats writes for the window.
+        # reset_stats() zeroes the DECLARED schema in place, so this loop
+        # cannot drift from the keys the worker actually maintains.
         for w in srv.workers:
             if hasattr(w, "quiesce"):
                 w.quiesce(30.0)
-            for k, v in list(w.stats.items()):
-                w.stats[k] = 0.0 if isinstance(v, float) else 0
+            if hasattr(w, "reset_stats"):
+                w.reset_stats()
 
         # Median of N_REPS timed reps: the remote-attached TPU's round-trip
         # latency wanders between runs, and a single sample can be off 2x
@@ -455,7 +476,7 @@ def bench_cpu_served(nodes, n_evals, reps=3):
         srv.shutdown()
 
 
-def bench_placement_parity(n_evals=40):
+def bench_placement_parity(n_evals=None, n_nodes=None):
     """BASELINE's ratio is defined \"at identical placement quality\": the
     same storm (identical node fleet, identical jobs) runs served through
     the TPU engine and the reference CPU chain, and the committed
@@ -465,9 +486,13 @@ def bench_placement_parity(n_evals=40):
     quality for throughput, and the bench fails loudly."""
     from nomad_tpu.server import Server, ServerConfig
 
+    if n_evals is None:
+        n_evals = PARITY_EVALS
+    if n_nodes is None:
+        n_nodes = PARITY_NODES
     out = {}
     for impl in ("tpu", "cpu-reference"):
-        nodes = build_nodes(1000)  # same seed => identical fleets
+        nodes = build_nodes(n_nodes)  # same seed => identical fleets
         srv = Server(ServerConfig(num_schedulers=1,
                                   pipelined_scheduling=impl == "tpu",
                                   scheduler_impl=impl,
@@ -510,7 +535,17 @@ def bench_placement_parity(n_evals=40):
             "ok": bool(ok)}
 
 
-def main():
+def main(argv=None):
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        description="nomad-tpu end-to-end served-path benchmark")
+    ap.add_argument("--smoke", action="store_true",
+                    help="tiny CPU-safe shapes (<60s) with the parity "
+                         "gate; for in-tree perf-path regression checks")
+    args = ap.parse_args(argv)
+    if args.smoke:
+        _apply_smoke()
     nodes = build_nodes(N_NODES)
     n_evals = max(1, N_PLACEMENTS // PER_EVAL)
 

@@ -720,6 +720,37 @@ def route(agent, method: str, path: str, query, get_body):
             raise CodedError(400, str(e))
         return {"Touched": touched, "Sites": failpoints.snapshot()}, None
 
+    if path == "/v1/agent/debug/sched-stats":
+        # Scheduling-pipeline observability: the same per-worker stage
+        # timers and flow counters bench.py prints (PipelinedWorker.stats,
+        # one declared schema — see README "Serving pipeline
+        # observability"). Debug-gated like stacks/profile: stage timings
+        # leak workload shape, so the agent must opt in.
+        if not getattr(agent.config, "enable_debug", False):
+            raise CodedError(404, "debug endpoints disabled "
+                                  "(set enable_debug)")
+        srv = need_server()
+        workers = []
+        totals: Dict[str, Any] = {}
+        for i, w in enumerate(getattr(srv, "workers", [])):
+            stats = getattr(w, "stats", None)
+            # ONE snapshot feeds both the worker entry and the totals:
+            # the worker threads mutate the live dict, and two reads
+            # could make Totals disagree with Workers[].Stats in the
+            # same response.
+            snap = dict(stats) if stats is not None else None
+            workers.append({
+                "Index": i,
+                "Type": type(w).__name__,
+                "Window": getattr(w, "window", None),
+                "Stats": snap,
+            })
+            if snap is not None:
+                for k, v in snap.items():
+                    if isinstance(v, (int, float)):
+                        totals[k] = totals.get(k, 0) + v
+        return {"Workers": workers, "Totals": totals}, None
+
     if path == "/v1/agent/metrics":
         # In-memory telemetry snapshot (reference shape: go-metrics
         # DisplayMetrics behind the agent metrics endpoint).

@@ -326,6 +326,10 @@ class Agent:
     def disarm_faults(self):
         return self.c.delete("/v1/agent/debug/faults")[0]
 
+    def sched_stats(self):
+        """Scheduling-pipeline stage timers/counters (debug-gated)."""
+        return self.c.get("/v1/agent/debug/sched-stats")[0]
+
 
 class Services:
     """Service registry queries (/v1/services, /v1/service/<name>)."""

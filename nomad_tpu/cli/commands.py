@@ -155,6 +155,13 @@ def main(argv) -> int:
                    help="heal every armed failpoint")
     _add_meta(p)
 
+    p = sub.add_parser("sched-stats",
+                       help="scheduling-pipeline stage timers/counters "
+                            "(needs enable_debug on the agent)")
+    p.add_argument("-json", action="store_true",
+                   help="print the raw JSON payload")
+    _add_meta(p)
+
     p = sub.add_parser("system-gc", help="force garbage collection")
     _add_meta(p)
 
@@ -759,6 +766,36 @@ def cmd_faults(args) -> int:
             desc = "-"
         print(f"{name:<26} {desc:<28} {info.get('fired', 0):>6}  "
               f"{info.get('description', '')}")
+    return 0
+
+
+def cmd_sched_stats(args) -> int:
+    """Operator view of the served scheduling pipeline: the same stage
+    timers and flow counters bench.py prints, live from the leader's
+    workers (see the README's stats-key table for what each means)."""
+    client = _client(args)
+    out = client.agent.sched_stats()
+    if args.json:
+        print(json.dumps(out, indent=2))
+        return 0
+    workers = out.get("Workers") or []
+    if not workers:
+        print("No scheduling workers running (agent is not the leader?)")
+        return 0
+    for w in workers:
+        window = f", window {w['Window']}" if w.get("Window") else ""
+        print(f"Worker {w['Index']} ({w['Type']}{window})")
+        stats = w.get("Stats")
+        if not stats:
+            print("  (no stats exported)")
+            continue
+        counters = {k: v for k, v in stats.items()
+                    if not k.startswith("t_")}
+        print("  " + "  ".join(f"{k}={v}" for k, v in
+                               sorted(counters.items())))
+        print(f"  {'stage':<20} {'total ms':>12}")
+        for k in sorted(k for k in stats if k.startswith("t_")):
+            print(f"  {k:<20} {stats[k]:>12.1f}")
     return 0
 
 

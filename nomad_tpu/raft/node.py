@@ -862,7 +862,22 @@ class RaftNode:
         with self._lock:
             if index <= self._snap_index:
                 return
-            self.log.store_snapshot(index, term, blob)
+            try:
+                # Failure seam: the durable write of the snapshot blob
+                # (disk full, torn store, injected fault). Drop = the
+                # write never happened.
+                if failpoints.fire("raft.snapshot.persist") == "drop":
+                    raise failpoints.FailpointError("raft.snapshot.persist")
+                self.log.store_snapshot(index, term, blob)
+            except Exception:
+                # Graceful degradation: the FSM is intact and the log was
+                # NOT truncated, so nothing is lost — re-arm the counter
+                # and retry at the next apply instead of taking down the
+                # apply loop that called us.
+                self._applied_since_snap = self.config.snapshot_threshold
+                LOG.exception("snapshot persist failed at index %d; "
+                              "keeping the full log and retrying", index)
+                return
             self._snap_index, self._snap_term = index, term
             keep_from = max(self.log.first_index(),
                             index - self.config.trailing_logs + 1)

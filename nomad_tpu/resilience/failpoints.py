@@ -56,7 +56,10 @@ KNOWN_SITES: Dict[str, str] = {
     "raft.append_entries": "raft: leader->peer AppendEntries send",
     "raft.fsync": "raft: durable log append fsync",
     "raft.request_vote": "raft: candidate->peer RequestVote send",
+    "raft.snapshot.persist": "raft: state snapshot persist to the log store",
     "raft.snapshot.restore": "raft/state: FSM restore from snapshot blob",
+    "server.blocked.unblock": "server: blocked-evals capacity wakeup "
+                              "(drop=lost wakeup event)",
     "rpc.pool.call": "rpc: pooled client call over the wire",
     "rpc.server.handle": "rpc: server-side endpoint dispatch",
     "worker.dequeue": "server: scheduling worker eval dequeue",

@@ -176,6 +176,53 @@ def place_batch_multi(
     return PlacementResult(packed, usage)
 
 
+class CompactResult(NamedTuple):
+    """Host-side per-eval view of a compacted window result: exactly the
+    arrays the plan build consumes, in the dtypes it consumes them
+    (packed's f32 triple forces a cast + tolist per column per eval on
+    the host otherwise)."""
+
+    chosen: np.ndarray   # [P_pad] int32 chosen row per placement (-1 = none)
+    scores: np.ndarray   # [P_pad] f32 winning score per placement
+    nf_last: int         # n_feasible of the eval's LAST valid placement
+    ok: bool             # every valid placement found a row
+
+
+@jax.jit
+def compact_window(packed3, valid, last_idx):
+    """On-device reduction of a window's packed kernel outputs to the
+    minimal arrays the host build actually needs, BEFORE the device->host
+    copy: chosen rows as int32, winner scores, the per-eval n_feasible of
+    the final valid placement (the only one metrics keep — earlier fills
+    are overwritten before anything snapshots them), and a per-eval
+    success mask so the host can branch straight into the vectorized
+    all-placed build without scanning. Cuts the transfer by ~1/3 against
+    the raw [*, 3] f32 layout and moves every cast off the host.
+
+    packed3 [E, P, 3]; valid [E, P] bool; last_idx [E] int32 (index of
+    each eval's last valid placement). Returns (chosen [E, P] int32,
+    scores [E, P] f32, nf_last [E] int32, ok [E] bool)."""
+    chosen = packed3[..., 0].astype(jnp.int32)
+    scores = packed3[..., 1]
+    nf_last = jnp.take_along_axis(
+        packed3[..., 2], last_idx[:, None].astype(jnp.int32), axis=1
+    )[:, 0].astype(jnp.int32)
+    ok = jnp.all((chosen >= 0) | ~valid, axis=1)
+    return chosen, scores, nf_last, ok
+
+
+def compact_host(packed: np.ndarray, n_valid: int) -> CompactResult:
+    """Numpy mirror of compact_window for one already-host-side result
+    (host-placed evals and non-jax test arrays skip the device entirely)."""
+    packed = np.asarray(packed)
+    chosen = packed[:, 0].astype(np.int32)
+    return CompactResult(
+        chosen=chosen,
+        scores=packed[:, 1].astype(np.float32, copy=False),
+        nf_last=int(packed[n_valid - 1, 2]),
+        ok=bool((chosen[:n_valid] >= 0).all()))
+
+
 _LOG2_10_F32 = np.float32(_LOG2_10)
 
 

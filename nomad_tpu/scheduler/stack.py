@@ -91,6 +91,51 @@ class _DeviceInputCache:
 
 _dev_cache = _DeviceInputCache()
 
+
+def device_input(arr: np.ndarray, sharding=None):
+    """Public handle on the content-addressed transfer cache for windowed
+    callers outside the stack (the pipelined drain's compaction inputs are
+    byte-identical across a storm's windows, so they upload once)."""
+    return _dev_cache.get(arr, sharding)
+
+
+class WindowAccumulator:
+    """Deferred window-usage accumulator shared by every eval of a window.
+
+    The chain-replay usage exists ONLY for exhaustion diagnostics
+    (_note_exhaustion diffs against the usage the kernel actually saw), so
+    an all-placed storm window must not pay a scatter per eval for an
+    array nothing reads. Placements queue as (rows, demand-vec) batches;
+    the first exhaustion materializes everything queued so far with ONE
+    np.add.at — the same values the per-eval eager scatters produced,
+    since adds commute and recs are processed in chain order."""
+
+    __slots__ = ("n_rows", "_rows", "_vecs", "_usage")
+
+    def __init__(self, n_rows: int):
+        self.n_rows = n_rows
+        self._rows: List[np.ndarray] = []
+        self._vecs: List[np.ndarray] = []
+        self._usage: Optional[np.ndarray] = None
+
+    def add(self, rows: np.ndarray, vecs: np.ndarray) -> None:
+        if self._usage is not None:
+            np.add.at(self._usage, rows, vecs)
+        else:
+            self._rows.append(rows)
+            self._vecs.append(vecs)
+
+    def usage(self) -> np.ndarray:
+        if self._usage is None:
+            self._usage = np.zeros((self.n_rows, RES_DIMS), dtype=np.float32)
+        if self._rows:
+            np.add.at(self._usage,
+                      np.concatenate(self._rows),
+                      np.concatenate(self._vecs))
+            self._rows.clear()
+            self._vecs.clear()
+        return self._usage
+
 # Row-steps (node rows x padded placements) under which an eval places via
 # the numpy mirror (kernels.place_batch_host) instead of a device dispatch.
 # A device readback costs a fixed ~100ms sync on remote-attached TPUs; the
@@ -142,11 +187,22 @@ class PreparedBatch:
     # kernel's candidate sets, and an understated value would silently
     # trim true winners out of the candidate table.
     n_valid: int
+    # True when any task of any placed group asks for network resources:
+    # those evals keep the exact per-placement build (ports are sequential
+    # host state); everything else takes the vectorized window build.
+    has_network_asks: bool = False
     # Memo of the resolved device-side inputs for the unmodified first
     # dispatch (no bans/placed overlays): a (kernel-kind, tuple) pair so a
     # window re-dispatching an identical prep skips the content-hash
     # lookups entirely.
     dev_inputs: Optional[tuple] = None
+    # Lazily built per-unique-TG (task_resources, resource-vec) templates
+    # for the vectorized build: every alloc of a TG carries value-identical
+    # task resources, so the window shares ONE frozen dict + Resources set
+    # per TG instead of copying per alloc (same value-frozen contract as
+    # alloc._resvec_cache — anything that changes resources replaces the
+    # objects).
+    tr_templates: Optional[dict] = None
 
 
 def _pad_pow2(n: int, floor: int = 8) -> int:
@@ -391,7 +447,10 @@ class GenericStack:
             evict_vecs=evict_vecs, job_counts=job_counts, distinct=distinct,
             penalty=penalty, noise_vec=noise_vec,
             tg_mask_sums=tg_masks.sum(axis=1),
-            cand_sum=int(self._cand_mask.sum()), n_valid=len(tgs))
+            cand_sum=int(self._cand_mask.sum()), n_valid=len(tgs),
+            has_network_asks=any(
+                t.Resources is not None and t.Resources.Networks
+                for tg in unique_tgs for t in tg.Tasks))
 
     def _device_kind(self, prep: PreparedBatch, n_valid: int) -> str:
         """Pick the device kernel: the keyed-candidate kernel whenever its
@@ -686,22 +745,108 @@ class GenericStack:
         flush_placed()
         return failed_rows, next_remaining
 
-    def collect_build(self, prep: PreparedBatch, packed: np.ndarray,
+    def _tg_template(self, prep: PreparedBatch, ti: int) -> tuple:
+        """(task_resources, resource-vec) for one unique TG, built once per
+        PreparedBatch and shared by every alloc the window places for it.
+        Only legal with no network asks anywhere in the group — ports are
+        per-alloc offers. The shared dict/Resources are value-frozen by the
+        same contract as alloc._resvec_cache (every consumer reads; a
+        change replaces the objects)."""
+        templates = prep.tr_templates
+        if templates is None:
+            templates = prep.tr_templates = {}
+        ent = templates.get(ti)
+        if ent is None:
+            # tg_index maps name -> ti; the TG object is the first
+            # placement of this ti (prep.tgs is in placement order).
+            tg = next(t for t in prep.tgs if prep.tg_index[t.Name] == ti)
+            tr = {}
+            vec = np.zeros(RES_DIMS, dtype=np.float32)
+            for task in tg.Tasks:
+                r = (task.Resources.copy() if task.Resources is not None
+                     else Resources())
+                tr[task.Name] = r
+                vec += resources_vec(r)
+            ent = templates[ti] = (tr, vec)
+        return ent
+
+    def _collect_build_all_placed(self, prep: PreparedBatch, cr,
+                                  eval_id: str, job: Job, place, plan,
+                                  acc: "WindowAccumulator") -> bool:
+        """Vectorized build for the storm case: every placement found a
+        row and no group asks for networks. One fancy-index gather maps
+        chosen rows to node IDs, scores land in the metrics dict via one
+        zip pass, the window-usage contribution queues as one batch, and
+        allocs share per-TG frozen task-resource templates instead of
+        copying Resources per task per alloc."""
+        nt = self.tindex.nt
+        n = len(place)
+        rows = cr.chosen[:n]
+        ids = nt.node_id_array()[rows]
+        nodes_by_id = self._nodes_by_id
+        ids_list = ids.tolist()
+        for nid in set(ids_list):
+            # Node vanished mid-window (row freed/reused): exact path owns
+            # it — identical outcome to the per-placement lookup failing.
+            if nid is None or nid not in nodes_by_id:
+                return False
+
+        metrics_ = self.ctx.metrics
+        scores_list = cr.scores[:n].tolist()
+        Scores = metrics_.Scores
+        for nid, s in zip(ids_list, scores_list):
+            Scores[f"{nid}.binpack"] = s
+        tg_index = prep.tg_index
+        tgs = prep.tgs
+        self._fill_metrics(prep, tg_index[tgs[n - 1].Name], cr.nf_last)
+        acc.add(rows.astype(np.int64, copy=False), prep.demands[:n])
+
+        # Scoring is final now: one immutable metric snapshot shared by
+        # every placed alloc (reference: alloc.Metrics).
+        shared_metric = metrics_.copy()
+        append_alloc = plan.append_alloc
+        template = self._tg_template
+        for p, tup in enumerate(place):
+            tg = tgs[p]
+            tr, vec = template(prep, tg_index[tg.Name])
+            alloc = Allocation(
+                ID=generate_uuid(),
+                EvalID=eval_id,
+                Name=tup.Name,
+                JobID=job.ID,
+                TaskGroup=tg.Name,
+                NodeID=ids_list[p],
+                TaskResources=tr,
+                Metrics=shared_metric,
+                DesiredStatus=AllocDesiredStatusRun,
+                ClientStatus=AllocClientStatusPending,
+            )
+            alloc._resvec_cache = vec
+            append_alloc(alloc)
+        return True
+
+    def collect_build(self, prep: PreparedBatch, cr,
                       eval_id: str, job: Job, place,
                       plan, failed_tg_allocs,
-                      window_usage: np.ndarray) -> bool:
+                      acc: "WindowAccumulator") -> bool:
         """Fused collect + build_placement_allocs for the pipelined fast
-        path: ONE pass from packed kernel output to plan allocations,
+        path: ONE pass from the compacted kernel output (CompactResult —
+        chosen rows, scores, per-eval success) to plan allocations,
         skipping the SelectedOption list and the placed_counts/hosts
         accumulators the windowed caller never reads (they exist for the
-        sync path's banned-row retry loop). Returns False when a winner
-        fails host-side network assignment or its node vanished — the
-        caller falls back to the exact per-eval path, same as a non-empty
-        failed_rows from collect()."""
+        sync path's banned-row retry loop). The all-placed no-network case
+        — the storm window — takes the vectorized build above; failures
+        and network asks keep the exact per-placement loop. Returns False
+        when a winner fails host-side network assignment or its node
+        vanished — the caller falls back to the exact per-eval path, same
+        as a non-empty failed_rows from collect()."""
+        if cr.ok and not prep.has_network_asks:
+            return self._collect_build_all_placed(prep, cr, eval_id, job,
+                                                  place, plan, acc)
+
         nt = self.tindex.nt
-        chosen_list = packed[:, 0].astype(np.int32).tolist()
-        scores_list = packed[:, 1].tolist()
-        n_feasible = packed[:, 2]
+        chosen_list = cr.chosen.tolist()
+        scores_list = cr.scores.tolist()
 
         node_of = nt.node_of
         nodes_by_id = self._nodes_by_id
@@ -719,15 +864,14 @@ class GenericStack:
         # a resources_vec walk per alloc downstream (plan verify, usage
         # listener, optimistic overlay).
         shared_vecs: Dict[int, np.ndarray] = {}
-        last_fill = None
+        last_ti = None
 
         def flush_placed():
-            # Exhaustion diagnostics read window_usage, so the batched
-            # accumulation must land before any _note_exhaustion.
+            # Exhaustion diagnostics read the window accumulator, so the
+            # batched accumulation must land before any _note_exhaustion.
             if placed_rows:
-                np.add.at(window_usage,
-                          np.asarray(placed_rows, dtype=np.int64),
-                          prep.demands[placed_ps])
+                acc.add(np.asarray(placed_rows, dtype=np.int64),
+                        prep.demands[placed_ps])
                 placed_rows.clear()
                 placed_ps.clear()
 
@@ -735,13 +879,17 @@ class GenericStack:
             row = chosen_list[p]
             tg = tgs[p]
             ti = tg_index[tg.Name]
-            last_fill = (ti, int(n_feasible[p]))
+            last_ti = ti
             if row < 0:
-                self._fill_metrics(prep, ti, int(n_feasible[p]))
+                # No per-placement _fill_metrics here: intermediate fills
+                # are dead stores — nothing snapshots the metrics until
+                # after the final fill below, which uses the compacted
+                # nf_last (the LAST placement's n_feasible, the only one
+                # the reference loop's end state keeps).
                 flush_placed()
                 self._note_exhaustion(tg, prep.tg_masks[ti],
                                       prep.tg_demands[ti], prep,
-                                      window_usage)
+                                      acc.usage())
                 # Snapshots are deferred to after the final _fill_metrics
                 # so FailedTGAllocs carries the same end-state metrics the
                 # sync path's build_placement_allocs records.
@@ -773,8 +921,8 @@ class GenericStack:
             else:
                 alloc._resvec_cache = vec
             allocs.append(alloc)
-        if last_fill is not None:
-            self._fill_metrics(prep, *last_fill)
+        if last_ti is not None:
+            self._fill_metrics(prep, last_ti, cr.nf_last)
         flush_placed()
         for name, count in failed_counts.items():
             metric = failed_tg_allocs.get(name)

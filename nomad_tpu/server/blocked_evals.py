@@ -16,6 +16,7 @@ import time
 from dataclasses import dataclass
 from typing import Dict, List, Optional, Tuple
 
+from nomad_tpu.resilience import failpoints
 from nomad_tpu.structs import Evaluation
 from nomad_tpu.structs.structs import EvalTriggerMaxPlans
 
@@ -131,6 +132,19 @@ class BlockedEvals:
             if not self._enabled:
                 return
             self._unblock_indexes[computed_class] = index
+        # Failure seam: the wakeup EVENT can be lost (a crashed watcher, a
+        # full channel, an injected fault) — the classic missed wakeup.
+        # The unblock index above is already recorded, which is exactly
+        # the recovery net: evals blocked AFTER the loss re-enqueue via
+        # _missed_unblock, and already-parked ones wake on the next real
+        # capacity change. Raising here would take down the raft apply
+        # thread that runs the FSM hooks, so every armed mode degrades to
+        # a dropped event.
+        try:
+            if failpoints.fire("server.blocked.unblock") == "drop":
+                return
+        except failpoints.FailpointError:
+            return
         self._capacity_ch.put((computed_class, index))
 
     def _watch_capacity(self) -> None:

@@ -59,7 +59,13 @@ from nomad_tpu.scheduler.generic_sched import (
     filter_complete_allocs,
     has_escaped,
 )
-from nomad_tpu.scheduler.stack import GenericStack, PreparedBatch
+from nomad_tpu.scheduler import kernels
+from nomad_tpu.scheduler.stack import (
+    GenericStack,
+    PreparedBatch,
+    WindowAccumulator,
+    device_input,
+)
 from nomad_tpu.scheduler.util import (
     BLOCKED_EVAL_FAILED_PLACEMENTS,
     diff_allocs,
@@ -68,7 +74,6 @@ from nomad_tpu.scheduler.util import (
     tainted_nodes,
 )
 from nomad_tpu.structs import AllocMetric, Evaluation, Plan
-from nomad_tpu.tensor.node_table import RES_DIMS
 from nomad_tpu.structs.structs import (
     EvalStatusBlocked,
     EvalStatusComplete,
@@ -85,6 +90,45 @@ logger = logging.getLogger("nomad.worker.pipelined")
 # How long to wait for additional evals once one is in hand. Near-zero: the
 # window exists to drain bursts, not to add latency to a lone eval.
 FILL_TIMEOUT = 0.002
+
+# THE declared stats schema: every counter and stage timer the worker
+# maintains, pre-seeded at construction so the debug endpoint
+# (/v1/agent/debug/sched-stats), bench.py's reset/aggregate loops, and
+# tests can rely on key presence instead of .get() defaults that drift.
+# README's "Serving pipeline observability" section documents each key.
+STATS_COUNTERS = (
+    "fast",       # evals committed via the device-chained fast path
+    "slow",       # evals routed to the per-eval GenericScheduler
+    "fallback",   # fast dispatches re-run slow (partial commit/ports)
+    "stale",      # evals redelivered mid-window and abandoned
+    "host",       # fast evals placed host-side (shallow windows)
+    "multi",      # fused place_batch_multi launches
+    "windows",    # dispatched windows
+    "rebases",    # chain rebases onto committed usage
+)
+STATS_TIMERS_MS = (
+    "t_refresh_ms",      # node-table device refresh at dispatch
+    "t_diff_ms",         # job diff/alloc filtering per eval
+    "t_prep_ms",         # PreparedBatch assembly (device inputs)
+    "t_launch_ms",       # kernel launches (host or device, async)
+    "t_drain_stack_ms",  # drain-plan build: stack + compaction dispatch
+    #                      (runs in the DISPATCH stage since round 6)
+    "t_dispatch_ms",     # whole dispatch stage (includes the five above)
+    "t_drain_ms",        # whole drain stage
+    "t_drain_fetch_ms",  # blocking device->host readback
+    "t_collect_ms",      # packed output -> plan allocations
+    "t_build_ms",        # whole plan build/submit pass
+    "t_planwait_ms",     # waiting on the plan applier
+    "t_evalupd_ms",      # consensus EvalUpdate batch
+    "t_slow_ms",         # slow-path evals of the window
+)
+
+
+def new_stats() -> dict:
+    """A fresh zeroed stats dict with every schema key present."""
+    stats: dict = {k: 0 for k in STATS_COUNTERS}
+    stats.update({k: 0.0 for k in STATS_TIMERS_MS})
+    return stats
 
 
 @dataclass(eq=False)  # identity semantics: recs are tracked by object
@@ -126,12 +170,26 @@ class _MultiSlice:
 
 
 @dataclass
+class _DrainPlan:
+    """Dispatch-time plan of a window's device->host drain: the compaction
+    programs are dispatched (async) and their outputs' host copies started
+    while the window is still in the dispatch stage, so the bytes ride the
+    tunnel under the PREVIOUS window's build instead of serializing behind
+    the drain stage's blocking fetch (double-buffered readback)."""
+
+    fetches: dict                  # key -> (chosen, scores, nf_last, ok)
+    layout: list                   # per-rec ("host", CompactResult) |
+    #                                ("dev", key, row-in-fetched-arrays)
+
+
+@dataclass
 class _WindowWork:
     """One dispatched window flowing through the drain -> build stages."""
 
     fast: List[_FastEval]
     slow: List[Tuple[Evaluation, str]]
-    packed: Optional[List[np.ndarray]] = None  # set by the drain stage
+    drain: Optional[_DrainPlan] = None         # set by the dispatch stage
+    packed: Optional[list] = None              # CompactResults, set by drain
     failed: bool = False                       # drain blew up: nack window
     chained: bool = False       # dispatched on a previous window's tail
     taint_seq: int = 0          # _taint_seq observed at chain-read time
@@ -184,13 +242,10 @@ class PipelinedWorker(Worker):
         # Observability: how evals flowed (fast = device-chained window,
         # slow = per-eval GenericScheduler, fallback = fast dispatch that
         # re-ran slow after partial commit / port collision) and where the
-        # wall-clock went (t_*_ms phase totals across both threads).
-        self.stats = {"fast": 0, "slow": 0, "fallback": 0, "host": 0,
-                      "windows": 0,
-                      "rebases": 0, "t_refresh_ms": 0.0, "t_dispatch_ms": 0.0,
-                      "t_drain_ms": 0.0, "t_build_ms": 0.0,
-                      "t_planwait_ms": 0.0, "t_evalupd_ms": 0.0,
-                      "t_slow_ms": 0.0}
+        # wall-clock went (t_*_ms phase totals across both threads). One
+        # declared schema (STATS_COUNTERS/STATS_TIMERS_MS) — every key is
+        # pre-seeded and mutated with +=, never lazily .get()-defaulted.
+        self.stats = new_stats()
         # Cross-window device usage chain (usage_after of the last dispatched
         # fast eval). None = next window reads committed usage from the table.
         self._chain = None
@@ -283,10 +338,9 @@ class PipelinedWorker(Worker):
                 return
             self._reset_window_deadlines(work)
             try:
-                if work.fast:
+                if work.fast and not work.failed:
                     t0 = time.perf_counter()
-                    work.packed = self._drain_window(
-                        [rec.res for rec in work.fast])
+                    work.packed = self._drain_window(work)
                     self.stats["t_drain_ms"] += \
                         (time.perf_counter() - t0) * 1e3
             except Exception:
@@ -503,7 +557,7 @@ class PipelinedWorker(Worker):
                     for k, r in enumerate(run):
                         r.res = _MultiSlice(res, k, rec.prep.p_pad)
                     usage_chain = res.usage_after
-                    self.stats["multi"] = self.stats.get("multi", 0) + 1
+                    self.stats["multi"] += 1
                 else:
                     rec.res = rec.stack.dispatch(
                         rec.prep, usage_override=usage_chain, tables=tables)
@@ -525,26 +579,7 @@ class PipelinedWorker(Worker):
         pend_ids = {id(r) for r in pend}
         launched = [r for r in fast if id(r) not in pend_ids]
         fast = launched + [r for r in pend if not r.fallback]
-        # Start the device->host copies NOW (async): the drain stage's
-        # blocking fetch otherwise pays kernel time PLUS a full tunnel
-        # round trip per window, serialized. With the copy enqueued behind
-        # the window's kernels at dispatch time, the RTT overlaps the next
-        # window's compute and the drain finds the bytes already en route.
-        # Only fused parents benefit: the drain fetches them directly,
-        # while singleton device results get stacked into a fresh array
-        # first — pre-copying those would be dead tunnel traffic.
-        seen_packed = set()
-        for r in fast:
-            parent = getattr(r.res, "parent", None)
-            if parent is None or id(parent.packed) in seen_packed:
-                continue
-            seen_packed.add(id(parent.packed))
-            try:
-                parent.packed.copy_to_host_async()
-            except Exception:
-                pass  # fetch still works without the head start
-        self.stats["t_launch_ms"] = self.stats.get("t_launch_ms", 0.0) \
-            + (time.perf_counter() - tl0) * 1e3
+        self.stats["t_launch_ms"] += (time.perf_counter() - tl0) * 1e3
 
         if fast:
             # Next window chains on this one's device-side usage tail even
@@ -557,8 +592,25 @@ class PipelinedWorker(Worker):
             self._chained_windows += 1
         self.stats["windows"] += 1
         self.stats["slow"] += len(slow)
-        self.stats["t_dispatch_ms"] += (time.perf_counter() - t0) * 1e3
         work = _WindowWork(fast=fast, slow=slow)
+        # Build the drain plan NOW: the compaction kernels dispatch async
+        # behind the window's placement kernels and their (much smaller)
+        # outputs start copying to the host immediately, so the drain
+        # stage's blocking fetch finds the bytes en route — window k+1's
+        # transfer overlaps window k's build instead of serializing.
+        # A runtime failure here (device OOM, tunnel drop mid-dispatch)
+        # must flow through the NORMAL window-failure path: the chain tail
+        # above is already published, so the build stage's failure handler
+        # — which raises the phantom-usage taint and nacks — owns it, not
+        # the dispatch handler (which would nack WITHOUT tainting and
+        # leave later windows chained on usage that never commits).
+        try:
+            work.drain = self._plan_drain(fast)
+        except Exception:
+            work.failed = True
+            if not (self._stop.is_set() or not self.eval_broker.enabled()):
+                logger.exception("pipelined worker: drain plan failed")
+        self.stats["t_dispatch_ms"] += (time.perf_counter() - t0) * 1e3
         # Taint bookkeeping: a window dispatched on a previous window's
         # tail inherits any phantom usage that tail turns out to carry;
         # record the taint sequence seen NOW so _finish_fast can detect a
@@ -566,6 +618,12 @@ class PipelinedWorker(Worker):
         work.chained = chained_at_dispatch
         work.taint_seq = taint_seq_at_dispatch
         return work
+
+    def reset_stats(self) -> None:
+        """Zero every schema key IN PLACE (readers like the debug endpoint
+        and bench.py hold a reference to the dict, not a copy). Call
+        quiesce() first when the zeros must not race in-flight windows."""
+        self.stats.update(new_stats())
 
     def quiesce(self, timeout: float = 30.0) -> bool:
         """Wait until every dispatched window has fully finished (drained,
@@ -645,8 +703,7 @@ class PipelinedWorker(Worker):
         if diff.update or diff.migrate or diff.stop or not diff.place:
             return None
         td1 = time.perf_counter()
-        self.stats["t_diff_ms"] = self.stats.get("t_diff_ms", 0.0) \
-            + (td1 - td0) * 1e3
+        self.stats["t_diff_ms"] += (td1 - td0) * 1e3
 
         # Alias the snapshot's job into the plan (no deep copy): committed
         # jobs are value-frozen in the state store and the plan only reads.
@@ -688,8 +745,7 @@ class PipelinedWorker(Worker):
             if sig is not None:
                 prep_cache[sig] = prep
         td3 = time.perf_counter()
-        self.stats["t_prep_ms"] = self.stats.get("t_prep_ms", 0.0) \
-            + (td3 - td2) * 1e3
+        self.stats["t_prep_ms"] += (td3 - td2) * 1e3
         # A huge eval blows the host budget even alone; it goes to the
         # device instead. Its launch is deferred like any device rec, so
         # within a host-mode window it chains AFTER the host-placed evals
@@ -701,14 +757,13 @@ class PipelinedWorker(Worker):
                 and host_cost <= self._host_rows_left:
             self._host_rows_left -= host_cost
             res = stack.dispatch_host(prep, usage_override=usage_chain)
-            self.stats["host"] = self.stats.get("host", 0) + 1
+            self.stats["host"] += 1
         else:
             # Device launch is DEFERRED: the window loop groups
             # consecutive shared-prep recs into one place_batch_multi
             # dispatch (a storm window = one kernel, not one per eval).
             res = None
-        self.stats["t_launch_ms"] = self.stats.get("t_launch_ms", 0.0) \
-            + (time.perf_counter() - td3) * 1e3
+        self.stats["t_launch_ms"] += (time.perf_counter() - td3) * 1e3
         # shareable: prep came from (or went into) the window prep cache,
         # which only holds value-identical jobs with NO prior allocs —
         # exactly the precondition for the multi kernel's per-eval resets.
@@ -725,18 +780,21 @@ class PipelinedWorker(Worker):
         # Build and enqueue plans back-to-back: the applier verifies plan i
         # while we materialize plan i+1's ports host-side.
         nt = self.tindex.nt
-        # The kernels ran chained: eval k saw evals 1..k-1's placements. The
-        # shared accumulator reproduces that chain host-side so exhaustion
-        # diagnostics diff against the usage the kernel actually saw.
-        window_usage = np.zeros((nt.n_rows, RES_DIMS), dtype=np.float32)
-        for rec, pk in zip(fast, packed):
+        # The kernels ran chained: eval k saw evals 1..k-1's placements.
+        # The shared accumulator can reproduce that chain host-side so
+        # exhaustion diagnostics diff against the usage the kernel actually
+        # saw — but it stays DEFERRED (queued batches, no scatter) until an
+        # exhaustion actually reads it, which an all-placed storm window
+        # never does.
+        acc = WindowAccumulator(nt.n_rows)
+        for rec, cr in zip(fast, packed):
             if rec.stale:
                 continue  # redelivered between stages: abandoned
             tc0 = time.perf_counter()
             try:
                 ok = rec.stack.collect_build(
-                    rec.prep, pk, rec.ev.ID, rec.plan.Job, rec.place,
-                    rec.plan, rec.failed_tg_allocs, window_usage)
+                    rec.prep, cr, rec.ev.ID, rec.plan.Job, rec.place,
+                    rec.plan, rec.failed_tg_allocs, acc)
             except Exception:
                 logger.exception("collect failed for eval %s", rec.ev.ID)
                 rec.fallback = True
@@ -747,8 +805,7 @@ class PipelinedWorker(Worker):
                 # retry loop owns it.
                 rec.fallback = True
                 continue
-            self.stats["t_collect_ms"] = self.stats.get("t_collect_ms", 0.0) \
-                + (time.perf_counter() - tc0) * 1e3
+            self.stats["t_collect_ms"] += (time.perf_counter() - tc0) * 1e3
             if rec.plan.is_no_op() and not rec.failed_tg_allocs:
                 rec.fallback = True  # nothing placeable; let sync path decide
                 continue
@@ -836,7 +893,7 @@ class PipelinedWorker(Worker):
                 self.stats["fallback"] += 1
                 self._process_slow(rec.ev, rec.token)
             elif rec.stale:
-                self.stats["stale"] = self.stats.get("stale", 0) + 1
+                self.stats["stale"] += 1
 
     def _status_evals(self, rec: _FastEval) -> List[Evaluation]:
         """Terminal status (+ blocked follow-up) for one fast eval, matching
@@ -870,82 +927,122 @@ class PipelinedWorker(Worker):
         out.append(new_eval)
         return out
 
-    def _drain_window(self, results: List[object]) -> List[np.ndarray]:
-        """ONE device->host transfer per packed shape for the whole window:
-        the per-eval results are stacked ON DEVICE and come home in a single
-        RTT (remote-attached TPUs pay a fixed round trip per transfer). The
-        stack arity is padded to the configured window size (repeating the
-        last element) so XLA compiles ONE stack program per packed shape,
-        never one per distinct window fill level."""
-        # Host-placed results are already numpy — no readback, no RTT.
-        out: List[Optional[np.ndarray]] = [None] * len(results)
-        dev_idx: List[int] = []
-        multi: Dict[int, List[int]] = {}
-        parents: Dict[int, object] = {}
-        for i, res in enumerate(results):
+    def _plan_drain(self, fast: List[_FastEval]) -> _DrainPlan:
+        """Dispatch-time drain assembly: reduce every device-side result to
+        the minimal host arrays (kernels.compact_window — int32 chosen
+        rows, winner scores, per-eval nf_last + success mask) and START the
+        device->host copies, all async. The drain stage then only waits on
+        transfers already in flight. Host-placed results compact inline
+        (numpy, no device round trip). Singleton device results still
+        stack on device first — arity padded to the configured window size
+        so XLA compiles ONE program per packed shape, never one per
+        distinct window fill level."""
+        t0 = time.perf_counter()
+        layout: list = [None] * len(fast)
+        fetches: dict = {}
+        # parent id -> (parent, [(pos-in-fast, slice-index)], prep)
+        multi: Dict[int, tuple] = {}
+        singles: Dict[int, list] = {}  # p_pad -> [(pos-in-fast, rec)]
+        for i, rec in enumerate(fast):
+            res = rec.res
             if isinstance(res, _MultiSlice):
-                multi.setdefault(id(res.parent), []).append(i)
-                parents[id(res.parent)] = res.parent
+                multi.setdefault(id(res.parent),
+                                 (res.parent, [], rec.prep))[1].append(
+                    (i, res.index))
             elif isinstance(res.packed, np.ndarray):
-                out[i] = res.packed
+                layout[i] = ("host",
+                             kernels.compact_host(res.packed,
+                                                  rec.prep.n_valid))
             else:
-                dev_idx.append(i)
-        if not dev_idx and not multi:
-            return out
+                singles.setdefault(rec.prep.p_pad, []).append((i, rec))
+        if not multi and not singles:
+            return _DrainPlan(fetches=fetches, layout=layout)
         try:
-            import jax
             import jax.numpy as jnp
 
-            # ONE blocking device->host call for the whole window, however
-            # it mixes multi-kernel parents and per-eval results: stacks
-            # are dispatched async and everything comes home in a single
-            # jax.device_get. Every separate host sync costs a ~95ms round
-            # trip on the axon tunnel, so the drain must never pay more
-            # than one.
-            t2 = time.perf_counter()
-            fetches: Dict[object, object] = {}
-            for pid in multi:
-                fetches[("multi", pid)] = parents[pid].packed
-            by_shape: Dict[tuple, List[int]] = {}
-            for i in dev_idx:
-                by_shape.setdefault(tuple(results[i].packed.shape),
-                                    []).append(i)
-            for shape, idxs in by_shape.items():
-                group = [results[i].packed for i in idxs]
-                if len(group) < self.window:
-                    group = group + [group[-1]] * (self.window - len(group))
-                fetches[("stack", shape)] = jnp.stack(group)
-            t3 = time.perf_counter()
-            fetched = jax.device_get(fetches)
-            t4 = time.perf_counter()
-            self.stats["t_drain_stack_ms"] = self.stats.get(
-                "t_drain_stack_ms", 0.0) + (t3 - t2) * 1e3
-            self.stats["t_drain_fetch_ms"] = self.stats.get(
-                "t_drain_fetch_ms", 0.0) + (t4 - t3) * 1e3
-            for pid, idxs in multi.items():
-                arr = fetched[("multi", pid)]
-                for i in idxs:
-                    sl = results[i]
-                    out[i] = arr[sl.index * sl.p_pad:
-                                 (sl.index + 1) * sl.p_pad]
-            for shape, idxs in by_shape.items():
-                stacked = fetched[("stack", shape)]
-                for i, arr in zip(idxs, stacked):
-                    out[i] = arr
-            return out
+            for pid, (parent, slices, prep) in multi.items():
+                p = prep.p_pad
+                e_pad = parent.packed.shape[0] // p
+                valid = np.zeros((e_pad, p), dtype=bool)
+                for _, sl_idx in slices:
+                    valid[sl_idx] = prep.valid
+                last = np.full(e_pad, prep.n_valid - 1, dtype=np.int32)
+                key = ("multi", pid)
+                # valid/last are byte-identical across a storm's windows:
+                # the content-addressed cache uploads them once.
+                fetches[key] = kernels.compact_window(
+                    parent.packed.reshape(e_pad, p, 3),
+                    device_input(valid), device_input(last))
+                for i, sl_idx in slices:
+                    layout[i] = ("dev", key, sl_idx)
+            for p_pad, group in singles.items():
+                arrs = [rec.res.packed for _, rec in group]
+                if len(arrs) < self.window:
+                    arrs = arrs + [arrs[-1]] * (self.window - len(arrs))
+                valid = np.zeros((len(arrs), p_pad), dtype=bool)
+                last = np.zeros(len(arrs), dtype=np.int32)
+                for k, (_, rec) in enumerate(group):
+                    valid[k] = rec.prep.valid
+                    last[k] = rec.prep.n_valid - 1
+                key = ("stack", p_pad)
+                fetches[key] = kernels.compact_window(
+                    jnp.stack(arrs), device_input(valid),
+                    device_input(last))
+                for k, (i, _) in enumerate(group):
+                    layout[i] = ("dev", key, k)
+            # Start the host copies NOW: the bytes ride the tunnel under
+            # the next window's dispatch / the previous window's build.
+            for out in fetches.values():
+                for arr in out:
+                    try:
+                        arr.copy_to_host_async()
+                    except Exception:
+                        pass  # fetch still works without the head start
         except (ImportError, TypeError, AttributeError):
-            # Non-jax packed arrays (already host-side, e.g. tests). Keep
-            # the already-resolved host entries; _MultiSlice parents are
-            # sliced per eval (the parent's packed holds the WHOLE run).
-            for pid, idxs in multi.items():
-                arr = np.asarray(parents[pid].packed)
-                for i in idxs:
-                    sl = results[i]
-                    out[i] = arr[sl.index * sl.p_pad:
-                                 (sl.index + 1) * sl.p_pad]
-            return [out[i] if out[i] is not None
-                    else np.asarray(results[i].packed)
-                    for i in range(len(results))]
+            # Non-jax device results (host-side arrays in tests): resolve
+            # everything inline, no fetch needed.
+            fetches = {}
+            for pid, (parent, slices, prep) in multi.items():
+                arr = np.asarray(parent.packed)
+                p = prep.p_pad
+                for i, sl_idx in slices:
+                    layout[i] = ("host", kernels.compact_host(
+                        arr[sl_idx * p:(sl_idx + 1) * p], prep.n_valid))
+            for p_pad, group in singles.items():
+                for i, rec in group:
+                    layout[i] = ("host", kernels.compact_host(
+                        np.asarray(rec.res.packed), rec.prep.n_valid))
+        self.stats["t_drain_stack_ms"] += (time.perf_counter() - t0) * 1e3
+        return _DrainPlan(fetches=fetches, layout=layout)
+
+    def _drain_window(self, work: _WindowWork) -> list:
+        """ONE blocking device->host call for the whole window, however it
+        mixes fused parents and stacked per-eval results: the compaction
+        outputs were dispatched (and their copies started) at dispatch
+        time, so this jax.device_get waits on transfers already in flight
+        instead of initiating them. Every separate host sync costs a ~95ms
+        round trip on the axon tunnel, so the drain never pays more than
+        one. Returns one CompactResult per fast rec, in chain order."""
+        plan = work.drain
+        out: list = [None] * len(plan.layout)
+        fetched = {}
+        if plan.fetches:
+            import jax
+
+            t0 = time.perf_counter()
+            fetched = jax.device_get(plan.fetches)
+            self.stats["t_drain_fetch_ms"] += \
+                (time.perf_counter() - t0) * 1e3
+        for i, ent in enumerate(plan.layout):
+            if ent[0] == "host":
+                out[i] = ent[1]
+            else:
+                _, key, idx = ent
+                chosen, scores, nf_last, ok = fetched[key]
+                out[i] = kernels.CompactResult(
+                    chosen=chosen[idx], scores=scores[idx],
+                    nf_last=int(nf_last[idx]), ok=bool(ok[idx]))
+        return out
 
     # ------------------------------------------------------------- slow path
     def _process_slow(self, ev: Evaluation, token: str) -> None:

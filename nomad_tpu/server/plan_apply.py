@@ -388,8 +388,32 @@ class PlanApplier:
                     opt = OptimisticSnapshot(self.raft.fsm.state.snapshot(),
                          nt=self._nt())
 
-                group = self._verify_group(batch, opt,
-                                           overlapped=wait is not None)
+                def resync():
+                    # Spurious-partial guard: the one-sided overlay can
+                    # double-count the in-flight group once its commit
+                    # lands in the live tensor mid-verify. A plan that
+                    # verifies PARTIAL while an apply is outstanding gets
+                    # one re-verify against settled state — a genuine
+                    # overcommit still fails, a double-count victim passes
+                    # instead of bouncing its whole eval through the
+                    # worker's exact-path fallback (and the chain rebase
+                    # stall that follows it). Also reports whether the
+                    # joined apply FAILED: verdicts that assumed it landed
+                    # (e.g. its evictions) are then stale, and the caller
+                    # must re-verify them — setting wait=None here skips
+                    # the run loop's own apply_failed re-check.
+                    nonlocal wait
+                    failed_before = self.stats["apply_failed"]
+                    if wait is not None:
+                        wait.join()
+                        wait = None
+                    return (OptimisticSnapshot(
+                                self.raft.fsm.state.snapshot(),
+                                nt=self._nt()),
+                            self.stats["apply_failed"] != failed_before)
+
+                group, opt = self._verify_group(
+                    batch, opt, overlapped=wait is not None, resync=resync)
                 if not group:
                     continue
 
@@ -405,7 +429,7 @@ class PlanApplier:
                         # The apply this group's verification assumed never
                         # landed (e.g. its evictions); re-verify against the
                         # real state before committing.
-                        group = self._verify_group(
+                        group, opt = self._verify_group(
                             [p for p, _ in group], opt, overlapped=False)
                         if not group:
                             wait = None
@@ -431,18 +455,48 @@ class PlanApplier:
             self._pool = None
 
     def _verify_group(self, batch: List[PendingPlan],
-                      opt: OptimisticSnapshot, overlapped: bool
-                      ) -> List[Tuple[PendingPlan, PlanResult]]:
+                      opt: OptimisticSnapshot, overlapped: bool,
+                      resync=None
+                      ) -> Tuple[List[Tuple[PendingPlan, PlanResult]],
+                                 OptimisticSnapshot]:
         """Verify plans in queue order against the shared overlay; each
         admitted plan's result is layered into `opt` so the next plan of the
         group sees it (the group analogue of the single-plan chain). No-op
         results respond immediately; rejected plans were answered by
-        _verify."""
+        _verify. A PARTIAL verdict reached while an apply was in flight is
+        suspect (the one-sided overlay may have double-counted that commit
+        as it landed): `resync` waits the apply out and returns a settled
+        snapshot, and the plan gets exactly one clean re-verify. Returns
+        (group, opt) — opt is replaced when a resync happened."""
         group: List[Tuple[PendingPlan, PlanResult]] = []
         tv0 = time.perf_counter()
-        for pending in batch:
+        queue = list(batch)
+        i = 0
+        while i < len(queue):
+            pending = queue[i]
             result = self._verify(pending, opt,
                                   overlapped=overlapped or bool(group))
+            if (result is not None and result.RefreshIndex
+                    and overlapped and resync is not None):
+                opt, in_flight_failed = resync()
+                overlapped = False
+                if in_flight_failed:
+                    # The apply this group's earlier verdicts assumed
+                    # never landed (e.g. its evictions): every admitted
+                    # plan is stale. Re-verify them all against the
+                    # settled state, in order — the run loop's own
+                    # apply_failed re-check won't run (wait is None now).
+                    queue = [p for p, _ in group] + queue[i:]
+                    group = []
+                    i = 0
+                    continue
+                # The settled snapshot lacks this group's own admitted
+                # results; restore them so plan ordering is preserved.
+                for _, r in group:
+                    opt.apply_result(r)
+                result = self._verify(pending, opt,
+                                      overlapped=bool(group))
+            i += 1
             if result is None:
                 continue
             if not result.NodeUpdate and not result.NodeAllocation:
@@ -451,7 +505,7 @@ class PlanApplier:
             opt.apply_result(result)
             group.append((pending, result))
         self.stats["t_verify_ms"] += (time.perf_counter() - tv0) * 1e3
-        return group
+        return group, opt
 
     def _verify(self, pending: PendingPlan, opt: OptimisticSnapshot,
                 overlapped: bool) -> Optional[PlanResult]:

@@ -81,6 +81,11 @@ class NodeTensor:
 
         self.row_of: Dict[str, int] = {}
         self.node_of: List[Optional[str]] = [None] * n
+        # Lazily built object-dtype mirror of node_of for vectorized
+        # row->node-ID gathers (the windowed collect maps a whole window's
+        # chosen rows in one fancy index instead of a Python lookup per
+        # placement). Invalidated whenever a row's identity changes.
+        self._node_id_arr: Optional[np.ndarray] = None
         self._free: List[int] = list(range(n - 1, -1, -1))
         self._reserved_cache: Dict[str, np.ndarray] = {}
         # Bumped whenever a row's IDENTITY changes (node removed, row freed
@@ -176,6 +181,7 @@ class NodeTensor:
                 row = self._alloc_row()
                 self.row_of[node.ID] = row
                 self.node_of[row] = node.ID
+                self._node_id_arr = None
                 self.usage[row] = 0.0
             cap = resources_vec(node.Resources)
             reserved = resources_vec(node.Reserved)
@@ -208,6 +214,7 @@ class NodeTensor:
             if row is None:
                 return
             self.node_of[row] = None
+            self._node_id_arr = None
             self.capacity[row] = 0.0
             self.score_cap[row] = 1.0
             self.usage[row] = 0.0
@@ -362,6 +369,20 @@ class NodeTensor:
                                      d["usage"], packed)
 
     # ------------------------------------------------------------- queries
+    def node_id_array(self) -> np.ndarray:
+        """Object-dtype [n_rows] mirror of node_of, rebuilt lazily when a
+        row's identity changes. Callers get a SNAPSHOT: a node removed
+        after the return may still appear — the same benign race as a live
+        node_of read per placement; the plan applier's re-verification
+        against committed state owns the outcome either way."""
+        with self._lock:
+            arr = self._node_id_arr
+            if arr is None or len(arr) != self.n_rows:
+                arr = np.empty(self.n_rows, dtype=object)
+                arr[:] = self.node_of
+                self._node_id_arr = arr
+            return arr
+
     def rows_for(self, node_ids: Sequence[str]) -> np.ndarray:
         return np.array([self.row_of[i] for i in node_ids], dtype=np.int32)
 

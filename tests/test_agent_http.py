@@ -305,6 +305,32 @@ def test_debug_profile_returns_loadable_pstats(dev_agent, tmp_path):
     assert any("nomad_tpu" in f or "threading" in f for f in files), files
 
 
+def test_debug_sched_stats_exports_worker_schema(dev_agent):
+    """/v1/agent/debug/sched-stats: the operator surface for the
+    pipelined worker's stage timers/counters — every key of the declared
+    stats schema must be present (no lazily-created keys that appear only
+    after the stage first runs)."""
+    from nomad_tpu.server.pipelined_worker import (
+        STATS_COUNTERS,
+        STATS_TIMERS_MS,
+    )
+
+    agent, api = dev_agent
+    out = api.agent.sched_stats()
+    workers = out["Workers"]
+    assert workers, "leader must export its scheduling workers"
+    pipelined = [w for w in workers if w["Type"] == "PipelinedWorker"]
+    assert pipelined, [w["Type"] for w in workers]
+    for w in pipelined:
+        assert w["Window"] >= 1
+        stats = w["Stats"]
+        for key in STATS_COUNTERS + STATS_TIMERS_MS:
+            assert key in stats, f"schema key {key} missing from endpoint"
+    totals = out["Totals"]
+    assert totals["windows"] == sum(
+        w["Stats"]["windows"] for w in pipelined)
+
+
 def test_debug_profile_rejects_malformed_seconds(dev_agent):
     """Malformed ?seconds must be a client error (400), not an unhandled
     ValueError surfacing as a 500."""

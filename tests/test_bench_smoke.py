@@ -1,0 +1,40 @@
+"""bench.py --smoke: the in-tree perf-path regression guard.
+
+Runs the REAL benchmark entry point (subprocess, same interpreter) at its
+tiny CPU-safe shapes and asserts it completes with the placement-parity
+quality gate green. Slow-marked: it is a multi-second end-to-end run, so
+tier-1 (`-m 'not slow'`) skips it while `pytest -m slow` and soak sweeps
+exercise it.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.mark.slow
+def test_bench_smoke_completes_with_parity():
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    proc = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "bench.py"), "--smoke"],
+        capture_output=True, text=True, timeout=300, cwd=ROOT, env=env)
+    assert proc.returncode == 0, (proc.stdout[-2000:], proc.stderr[-2000:])
+    # The bench prints ONE json line (plus whatever libraries chatter).
+    line = next(ln for ln in reversed(proc.stdout.strip().splitlines())
+                if ln.startswith("{"))
+    result = json.loads(line)
+    assert result["value"] > 0
+    detail = result["detail"]
+    assert detail["placement_parity"]["ok"] is True
+    stats = detail["e2e_worker_stats"]
+    # The fast path actually ran, and the declared stats schema is intact.
+    assert stats["fast"] > 0
+    for key in ("t_dispatch_ms", "t_collect_ms", "t_drain_fetch_ms",
+                "t_build_ms", "t_planwait_ms"):
+        assert key in stats

@@ -108,6 +108,100 @@ class TestSmokeSchedule:
             cs.shutdown()
 
 
+class TestSnapshotPersistSchedule:
+    """ROADMAP candidate site: state snapshot persist. An injected
+    persist failure must degrade gracefully — FSM intact, log NOT
+    truncated, apply loop alive — and the snapshot must land once the
+    fault heals (the counter re-arms, so the next apply retries)."""
+
+    def test_persist_failure_keeps_log_then_recovers(self):
+        cs = _boot_single()
+        try:
+            assert wait_for(lambda: cs.server.is_leader(), timeout=15)
+            for _ in range(4):
+                cs.endpoints.handle("Node.Register",
+                                    {"Node": to_dict(mock.node())})
+            raft = cs.server.raft.node
+            jobs = [make_job() for _ in range(3)]
+            eval_ids = []
+            with ChaosSchedule(name="snap-persist") \
+                    .arm(0.0, "raft.snapshot.persist=error") as sched:
+                sched.join(5.0)
+                for job in jobs:
+                    resp = cs.endpoints.handle("Job.Register",
+                                               {"Job": to_dict(job)})
+                    eval_ids.append(resp["EvalID"])
+                assert wait_for(
+                    lambda: _all_terminal(cs.server.state, eval_ids),
+                    timeout=30, interval=0.1,
+                    msg="evals terminal while snapshot persist is failing")
+                first = raft.log.first_index()
+                snap_before = raft.take_snapshot()
+                # Degraded, not broken: the persist failed, so the log
+                # kept every entry and no snapshot index advanced.
+                assert raft.log.first_index() == first
+                assert snap_before == 0
+                assert failpoints.snapshot()[
+                    "raft.snapshot.persist"]["fired"] >= 1
+            # Healed (context exit disarms): the forced snapshot lands.
+            snap_after = raft.take_snapshot()
+            assert snap_after > 0
+            assert_invariants(cs.server.state, jobs, per_job=PER_JOB,
+                              eval_ids=eval_ids)
+        finally:
+            cs.shutdown()
+
+
+class TestBlockedWakeupSchedule:
+    """ROADMAP candidate site: the blocked-evals capacity wakeup. A lost
+    wakeup event (dropped at the seam) strands parked evals ONLY until
+    the next real capacity change — the recorded unblock indexes are the
+    recovery net, and nothing is lost or duplicated."""
+
+    def test_lost_wakeup_recovers_on_next_capacity_change(self):
+        srv = Server(ServerConfig(num_schedulers=1, scheduler_window=8))
+        srv.establish_leadership()
+        try:
+            first = mock.node()
+            first.Resources.CPU = 1000
+            first.Reserved = None
+            srv.node_register(first)
+            job = make_job()
+            job.TaskGroups[0].Count = 4
+            job.TaskGroups[0].Tasks[0].Resources.CPU = 600
+            eval_id, _, _ = srv.job_register(job)
+            assert wait_for(
+                lambda: (ev := srv.state.eval_by_id(eval_id)) is not None
+                and ev.Status in TERMINAL and ev.BlockedEval,
+                timeout=30, msg="exhaustion never spawned a blocked eval")
+
+            def live_allocs():
+                return [a for a in srv.state.allocs_by_job(job.ID)
+                        if not a.terminal_status()]
+
+            placed_before = len(live_allocs())
+            assert placed_before < 4
+            with ChaosSchedule(name="lost-wakeup") \
+                    .arm(0.0, "server.blocked.unblock=drop") as sched:
+                sched.join(5.0)
+                # Capacity arrives but the wakeup event is dropped: the
+                # parked eval must stay parked (nothing schedules).
+                srv.node_register(mock.node())
+                time.sleep(0.5)
+                assert len(live_allocs()) == placed_before, \
+                    "a dropped wakeup still scheduled work"
+                assert failpoints.snapshot()[
+                    "server.blocked.unblock"]["fired"] >= 1
+            # Healed: the NEXT capacity change delivers its wakeup and the
+            # blocked eval places the remainder.
+            srv.node_register(mock.node())
+            assert wait_for(lambda: len(live_allocs()) == 4, timeout=30,
+                            msg="blocked eval never recovered after heal")
+            assert_invariants(srv.state, [job], per_job=4)
+        finally:
+            srv.shutdown()
+
+
 @pytest.mark.slow
 class TestStormSchedules:
     """Multi-second storms against the networked 3-server cluster —

@@ -211,6 +211,24 @@ class TestClusterCommands:
         finally:
             failpoints.disarm_all()
 
+    def test_sched_stats_prints_pipeline_timers(self, capsys, address):
+        """`nomad-tpu sched-stats` surfaces the pipelined worker's stage
+        timers/counters (the numbers bench.py prints) via the debug-gated
+        endpoint."""
+        rc, out, _ = run_cli(capsys, "sched-stats", "-address", address)
+        assert rc == 0
+        assert "PipelinedWorker" in out
+        # Flow counters and at least the headline stage timers show up.
+        assert "fast=" in out and "windows=" in out
+        for key in ("t_dispatch_ms", "t_collect_ms", "t_drain_fetch_ms"):
+            assert key in out
+
+        rc, out, _ = run_cli(capsys, "sched-stats", "-address", address,
+                             "-json")
+        assert rc == 0
+        payload = json.loads(out)
+        assert payload["Workers"][0]["Stats"]["windows"] >= 0
+
     def test_unknown_job_errors_cleanly(self, capsys, address):
         rc, out, err = run_cli(capsys, "status", "-address", address,
                                "no-such-job")

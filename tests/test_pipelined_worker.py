@@ -260,7 +260,7 @@ class TestStalePhantomUsage:
             rec_a = work.fast[0]
             srv.eval_broker.nack(rec_a.ev.ID, rec_a.token)
 
-            work.packed = w._drain_window([rec.res for rec in work.fast])
+            work.packed = w._drain_window(work)
             w._finish_fast(work)
 
             # A was abandoned (stale), not acked, not planned.
@@ -329,13 +329,13 @@ class TestStalePhantomUsage:
             # Window 1's record goes stale (redelivered) before its build.
             rec_a = work1.fast[0]
             srv.eval_broker.nack(rec_a.ev.ID, rec_a.token)
-            work1.packed = w._drain_window([r.res for r in work1.fast])
+            work1.packed = w._drain_window(work1)
             w._finish_fast(work1)
             assert rec_a.stale
 
             # Window 2 finishes AFTER the taint: its squeezed eval re-runs
             # on the exact path and places for real.
-            work2.packed = w._drain_window([r.res for r in work2.fast])
+            work2.packed = w._drain_window(work2)
             w._finish_fast(work2)
             e_b = srv.state.eval_by_id(eval_b)
             assert e_b is not None and e_b.Status == EvalStatusComplete
@@ -345,6 +345,133 @@ class TestStalePhantomUsage:
                         if e.Status == EvalStatusBlocked]
         finally:
             srv.shutdown()
+
+
+class TestFastSlowEquivalence:
+    """A fixed-seed window run through _finish_fast must commit the same
+    placements (node, scores, ports) as the same evals run through
+    _process_slow — the fast path only accelerates evals whose outcome is
+    provably identical. One record is force-failed at plan commit
+    (plan.apply.commit failpoint) so the fallback/phantom-taint re-run is
+    part of the compared window, not a separate test."""
+
+    def _fleet(self, n=6):
+        return [mock.node() for _ in range(n)]
+
+    def _jobs(self):
+        from nomad_tpu.structs import NetworkResource
+        from nomad_tpu.structs.structs import Port
+
+        jobs = [simple_job(count=3, cpu=120 + 10 * i, mem=64)
+                for i in range(4)]
+        # One group WITH a (static, deterministic) port ask: exercises the
+        # exact per-placement network path on both sides.
+        pj = simple_job(count=1, cpu=80, mem=32)
+        task = pj.TaskGroups[0].Tasks[0]
+        task.Resources.Networks = [
+            NetworkResource(MBits=1,
+                            ReservedPorts=[Port("http", 12345)])]
+        jobs.append(pj)
+        return jobs
+
+    def _placements(self, srv, jobs):
+        out = {}
+        for job in jobs:
+            allocs = sorted(
+                (a for a in srv.state.allocs_by_job(job.ID)
+                 if not a.terminal_status()), key=lambda a: a.Name)
+            out[job.ID] = [
+                (a.Name, a.NodeID,
+                 round((a.Metrics.Scores or {}).get(
+                     f"{a.NodeID}.binpack", 0.0), 4),
+                 sorted((p.Label, p.Value)
+                        for r in a.TaskResources.values()
+                        for net in r.Networks
+                        for p in net.ReservedPorts))
+                for a in allocs]
+        return out
+
+    def test_window_matches_per_eval_path(self, monkeypatch):
+        import numpy as np
+
+        from nomad_tpu.resilience import failpoints
+        from nomad_tpu.server.pipelined_worker import PipelinedWorker
+
+        # Zero tie-break noise on BOTH paths: placements become a pure
+        # function of the (identical) fleet + submission order.
+        monkeypatch.setattr(
+            "nomad_tpu.scheduler.stack.make_noise_vec",
+            lambda n_rows, rng: np.zeros(n_rows, dtype=np.float32))
+
+        fleet = self._fleet()
+        jobs = self._jobs()
+        # The forced-fallback eval rides its OWN second window: a commit
+        # failure re-runs the record AFTER the rest of its window commits,
+        # so window membership is what keeps the usage each eval observes
+        # identical between the two paths.
+        fallback_job = simple_job(count=2, cpu=90, mem=48)
+        results = {}
+        try:
+            for mode in ("fast", "slow"):
+                srv = Server(ServerConfig(num_schedulers=0,
+                                          pipelined_scheduling=True,
+                                          scheduler_window=16))
+                srv.establish_leadership()
+                try:
+                    for node in fleet:
+                        srv.node_register(node.copy())
+                    for job in jobs:
+                        srv.job_register(job.copy())
+                    w = PipelinedWorker(
+                        srv.raft, srv.eval_broker, srv.plan_queue,
+                        srv.blocked_evals, srv.tindex,
+                        ["service", "batch", "system"], window=16)
+                    batch = w._dequeue_window()
+                    assert len(batch) == len(jobs)
+                    batch.sort(key=lambda p: p[0].JobID)
+                    if mode == "fast":
+                        work = w._dispatch_window(batch)
+                        assert work is not None
+                        assert len(work.fast) == len(jobs)
+                        work.packed = w._drain_window(work)
+                        w._finish_fast(work)
+                        assert w.stats["fast"] == len(jobs)
+                    else:
+                        for ev, token in batch:
+                            w._process_slow(ev, token)
+
+                    # Second window: ONE record whose plan commit is
+                    # forced to fail — _finish_fast must re-run it on the
+                    # exact path (the phantom-taint machinery raises
+                    # _chain_dirty so the next window rebases).
+                    srv.job_register(fallback_job.copy())
+                    batch2 = w._dequeue_window()
+                    assert len(batch2) == 1
+                    if mode == "fast":
+                        failpoints.arm("plan.apply.commit", "error",
+                                       count=1)
+                        work2 = w._dispatch_window(batch2)
+                        assert work2 is not None and len(work2.fast) == 1
+                        work2.packed = w._drain_window(work2)
+                        w._finish_fast(work2)
+                        assert w.stats["fallback"] == 1, \
+                            "the forced-fallback record never re-ran"
+                        assert w._chain_dirty, \
+                            "fallback must taint the chain for rebase"
+                    else:
+                        for ev, token in batch2:
+                            w._process_slow(ev, token)
+                    results[mode] = self._placements(
+                        srv, jobs + [fallback_job])
+                finally:
+                    srv.shutdown()
+        finally:
+            failpoints.disarm_all()
+        assert results["fast"] == results["slow"]
+        # Non-vacuous: real scores and the static port came through.
+        flat = [t for allocs in results["fast"].values() for t in allocs]
+        assert any(score > 0 for _, _, score, _ in flat)
+        assert any(ports == [("http", 12345)] for _, _, _, ports in flat)
 
 
 class TestWindowFusion:
@@ -378,7 +505,7 @@ class TestWindowFusion:
             assert len(batch) == 8
             work = w._dispatch_window(batch)
             assert work is not None and len(work.fast) == 8
-            work.packed = w._drain_window([r.res for r in work.fast])
+            work.packed = w._drain_window(work)
             w._finish_fast(work)
             for job in jobs:
                 want = job.TaskGroups[0].Count

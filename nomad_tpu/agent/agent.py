@@ -60,6 +60,11 @@ class AgentConfig:
     # Telemetry (reference: command/agent/config.go Telemetry block)
     statsd_addr: str = ""
     telemetry_interval: float = 10.0
+    # Evaluation-lifecycle tracing (telemetry/trace.py): disarmed by
+    # default — near-zero cost; the debug endpoint can toggle at runtime.
+    trace_enabled: bool = False
+    trace_sample_ratio: float = 1.0
+    trace_ring: int = 128
     # Route agent logs to syslog too (reference: enable_syslog)
     enable_syslog: bool = False
     # Expose /v1/agent/debug/* (reference: enable_debug gating pprof)
@@ -147,10 +152,13 @@ class Agent:
 
     def start(self) -> None:
         # (reference: command/agent/command.go:556-580 setupTelemetry)
-        from nomad_tpu.telemetry import metrics
+        from nomad_tpu.telemetry import metrics, trace
         metrics.configure(statsd_addr=self.config.statsd_addr,
                           collection_interval=self.config.telemetry_interval,
                           host_label=self.config.node_name)
+        trace.configure(enabled=self.config.trace_enabled,
+                        sample_ratio=self.config.trace_sample_ratio,
+                        ring=self.config.trace_ring)
         try:
             if self.config.server_enabled:
                 if self.config.dev_mode:

@@ -96,6 +96,10 @@ def config_from_dict(data: dict) -> AgentConfig:
             cfg.telemetry_interval = float(raw)
         else:
             cfg.telemetry_interval = parse_duration(raw) / 1e9
+    cfg.trace_enabled = bool(telemetry.get("trace", cfg.trace_enabled))
+    cfg.trace_sample_ratio = float(
+        telemetry.get("trace_sample_ratio", cfg.trace_sample_ratio))
+    cfg.trace_ring = int(telemetry.get("trace_ring", cfg.trace_ring))
 
     client = data.get("client") or {}
     cfg.client_enabled = bool(client.get("enabled", False))

@@ -720,6 +720,48 @@ def route(agent, method: str, path: str, query, get_body):
             raise CodedError(400, str(e))
         return {"Touched": touched, "Sites": failpoints.snapshot()}, None
 
+    if path == "/v1/agent/debug/trace":
+        # Evaluation-lifecycle tracing (telemetry/trace.py), debug-gated
+        # like faults/stacks/profile. GET lists retained traces (or one
+        # full trace with ?id=..., Chrome trace-event JSON with
+        # &format=chrome); PUT reconfigures ({"Enabled":..,
+        # "SampleRatio":.., "Ring":..}); DELETE clears collected traces.
+        if not getattr(agent.config, "enable_debug", False):
+            raise CodedError(404, "debug endpoints disabled "
+                                  "(set enable_debug)")
+        from nomad_tpu.telemetry import trace as _trace
+
+        if method == "GET":
+            trace_id = query.get("id", [""])[0]
+            fmt = query.get("format", [""])[0]
+            full = _trace.get_trace(trace_id) if trace_id else None
+            if trace_id and full is None:
+                # Unknown ids 404 on BOTH paths — the chrome exporter
+                # would otherwise 200 an empty, useless file.
+                raise KeyError(f"trace not found: {trace_id}")
+            if fmt == "chrome":
+                return _trace.export_chrome(trace_id or None), None
+            if trace_id:
+                return {"Trace": full}, None
+            out = _trace.status()
+            out["Traces"] = _trace.traces()
+            return out, None
+        if method == "DELETE":
+            _trace.clear()
+            return {"Cleared": True}, None
+        _require_write(method)
+        payload = get_body() or {}
+        if not isinstance(payload, dict):
+            raise CodedError(400, "body must be a JSON object")
+        try:
+            _trace.configure(
+                enabled=payload.get("Enabled"),
+                sample_ratio=payload.get("SampleRatio"),
+                ring=payload.get("Ring"))
+        except (TypeError, ValueError) as e:
+            raise CodedError(400, str(e))
+        return _trace.status(), None
+
     if path == "/v1/agent/debug/sched-stats":
         # Scheduling-pipeline observability: the same per-worker stage
         # timers and flow counters bench.py prints (PipelinedWorker.stats,

@@ -330,6 +330,37 @@ class Agent:
         """Scheduling-pipeline stage timers/counters (debug-gated)."""
         return self.c.get("/v1/agent/debug/sched-stats")[0]
 
+    # Evaluation-lifecycle tracing (debug-gated; telemetry/trace.py)
+    def traces(self):
+        """Status + summaries of retained traces."""
+        return self.c.get("/v1/agent/debug/trace")[0]
+
+    def trace(self, trace_id: str, chrome: bool = False):
+        """One full trace; ``chrome=True`` returns Chrome trace-event
+        JSON loadable in Perfetto."""
+        params = {"id": trace_id}
+        if chrome:
+            params["format"] = "chrome"
+        return self.c.request("GET", "/v1/agent/debug/trace", params)[0]
+
+    def trace_export(self):
+        """Chrome trace-event JSON of every retained trace."""
+        return self.c.request("GET", "/v1/agent/debug/trace",
+                              {"format": "chrome"})[0]
+
+    def configure_trace(self, enabled=None, sample_ratio=None, ring=None):
+        body = {}
+        if enabled is not None:
+            body["Enabled"] = bool(enabled)
+        if sample_ratio is not None:
+            body["SampleRatio"] = float(sample_ratio)
+        if ring is not None:
+            body["Ring"] = int(ring)
+        return self.c.put("/v1/agent/debug/trace", body)[0]
+
+    def clear_traces(self):
+        return self.c.delete("/v1/agent/debug/trace")[0]
+
 
 class Services:
     """Service registry queries (/v1/services, /v1/service/<name>)."""

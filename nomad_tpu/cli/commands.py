@@ -162,6 +162,26 @@ def main(argv) -> int:
                    help="print the raw JSON payload")
     _add_meta(p)
 
+    p = sub.add_parser("trace",
+                       help="evaluation-lifecycle traces "
+                            "(needs enable_debug on the agent)")
+    p.add_argument("trace_id", nargs="?", default="",
+                   help="trace id (or unique prefix) to show; omit to list")
+    p.add_argument("-enable", action="store_true",
+                   help="turn tracing on")
+    p.add_argument("-disable", action="store_true",
+                   help="turn tracing off")
+    p.add_argument("-ratio", type=float, default=None,
+                   help="head-sampling ratio in [0,1] (with -enable)")
+    p.add_argument("-export", metavar="FILE", default="",
+                   help="write Chrome trace-event JSON (the given trace, "
+                        "or all retained ones) for Perfetto")
+    p.add_argument("-clear", action="store_true",
+                   help="drop all collected traces")
+    p.add_argument("-json", action="store_true",
+                   help="print the raw JSON payload")
+    _add_meta(p)
+
     p = sub.add_parser("system-gc", help="force garbage collection")
     _add_meta(p)
 
@@ -196,6 +216,18 @@ def main(argv) -> int:
 
 
 # ---------------------------------------------------------------- commands
+
+def dump_telemetry(signum=None, frame=None) -> None:
+    """SIGUSR1 handler: dump the in-memory telemetry snapshot to the agent
+    log as one JSON line (module-level, not a closure, so tests can drive
+    it without an agent process)."""
+    import logging
+
+    from nomad_tpu.telemetry import metrics
+
+    logging.getLogger("nomad.agent").info(
+        "metrics snapshot: %s", json.dumps(metrics.snapshot()))
+
 
 def cmd_agent(args) -> int:
     import logging
@@ -286,28 +318,28 @@ def cmd_agent(args) -> int:
         except Exception:
             log.exception("SIGHUP reload failed; keeping current config")
             return
-        from nomad_tpu.telemetry import metrics
+        from nomad_tpu.telemetry import metrics, trace
 
         metrics.configure(statsd_addr=fresh.statsd_addr,
                           collection_interval=fresh.telemetry_interval,
                           host_label=fresh.node_name or config.node_name)
+        trace.configure(enabled=fresh.trace_enabled,
+                        sample_ratio=fresh.trace_sample_ratio,
+                        ring=fresh.trace_ring)
         config.statsd_addr = fresh.statsd_addr
         config.telemetry_interval = fresh.telemetry_interval
-        log.info("SIGHUP: config reloaded (telemetry applied; topology "
-                 "changes need a restart)")
-
-    # SIGUSR1: dump the in-memory telemetry snapshot to the log
-    # (reference: the in-mem sink's signal-triggered dump).
-    def dump_metrics(signum, frame):
-        from nomad_tpu.telemetry import metrics
-
-        logging.getLogger("nomad.agent").info(
-            "metrics snapshot: %s", json.dumps(metrics.snapshot()))
+        config.trace_enabled = fresh.trace_enabled
+        config.trace_sample_ratio = fresh.trace_sample_ratio
+        config.trace_ring = fresh.trace_ring
+        log.info("SIGHUP: config reloaded (telemetry + tracing applied; "
+                 "topology changes need a restart)")
 
     import signal as _signal
 
     _signal.signal(_signal.SIGHUP, reload)
-    _signal.signal(_signal.SIGUSR1, dump_metrics)
+    # SIGUSR1: dump the in-memory telemetry snapshot to the log
+    # (reference: the in-mem sink's signal-triggered dump).
+    _signal.signal(_signal.SIGUSR1, dump_telemetry)
     try:
         while True:
             time.sleep(1)
@@ -797,6 +829,119 @@ def cmd_sched_stats(args) -> int:
         for k in sorted(k for k in stats if k.startswith("t_")):
             print(f"  {k:<20} {stats[k]:>12.1f}")
     return 0
+
+
+def _render_span_tree(spans: list, out) -> None:
+    """Indent spans by parent relationship, chronological within a level."""
+    by_parent: dict = {}
+    ids = {s["SpanID"] for s in spans}
+    for s in spans:
+        parent = s.get("ParentID")
+        # Spans whose parent never landed locally (remote/unfinished) sit
+        # at the top level rather than vanishing.
+        key = parent if parent in ids else None
+        by_parent.setdefault(key, []).append(s)
+
+    def emit(parent, depth):
+        for s in sorted(by_parent.get(parent, ()),
+                        key=lambda x: x["Start"]):
+            dur = s.get("DurationMs")
+            dur_s = f"{dur:.2f}ms" if dur is not None else "open"
+            mark = " !" if s.get("Error") else ""
+            out.append(f"{'  ' * depth}{s['Name']:<28} {dur_s:>10}"
+                       f"  [{s.get('Thread', '')}]{mark}")
+            for ev in s.get("Events", ()):
+                attrs = " ".join(f"{k}={v}" for k, v in
+                                 (ev.get("Attrs") or {}).items())
+                out.append(f"{'  ' * (depth + 1)}@{ev['OffsetMs']:.2f}ms "
+                           f"{ev['Name']} {attrs}".rstrip())
+            emit(s["SpanID"], depth + 1)
+
+    emit(None, 0)
+
+
+def cmd_trace(args) -> int:
+    """Evaluation-lifecycle traces: list/show/export (Chrome trace-event
+    JSON for Perfetto) and toggle collection — same debug-gated pattern as
+    `faults` and `sched-stats`."""
+    client = _client(args)
+    if args.enable or args.disable:
+        out = client.agent.configure_trace(
+            enabled=args.enable, sample_ratio=args.ratio)
+        state = "enabled" if out.get("Enabled") else "disabled"
+        print(f"Tracing {state} (sample ratio {out.get('SampleRatio')}, "
+              f"ring {out.get('Ring')})")
+        return 0
+    if args.clear:
+        client.agent.clear_traces()
+        print("Collected traces cleared")
+        return 0
+    if args.export:
+        if args.trace_id:
+            trace_id = _resolve_trace_id(client, args.trace_id)
+            payload = client.agent.trace(trace_id, chrome=True)
+        else:
+            payload = client.agent.trace_export()
+        with open(args.export, "w") as f:
+            json.dump(payload, f)
+        print(f"Wrote {len(payload.get('traceEvents', []))} events to "
+              f"{args.export} (load in Perfetto / chrome://tracing)")
+        return 0
+    if args.trace_id:
+        full = client.agent.trace(
+            _resolve_trace_id(client, args.trace_id)).get("Trace", {})
+        if args.json:
+            print(json.dumps(full, indent=2))
+            return 0
+        print(f"Trace   = {full['TraceID']}")
+        print(f"Root    = {full.get('Root', '')}")
+        print(f"Error   = {full.get('Error', False)}")
+        print(f"Spans   = {len(full.get('Spans', []))}")
+        out: list = []
+        _render_span_tree(full.get("Spans", []), out)
+        for line in out:
+            print(line)
+        for ev in full.get("Events", ()):
+            attrs = " ".join(f"{k}={v}" for k, v in
+                             (ev.get("Attrs") or {}).items())
+            print(f"* {ev['Name']} {attrs}".rstrip())
+        return 0
+    out = client.agent.traces()
+    if args.json:
+        print(json.dumps(out, indent=2))
+        return 0
+    state = "enabled" if out.get("Enabled") else "disabled"
+    print(f"Tracing {state} (sample ratio {out.get('SampleRatio')}, "
+          f"ring {out.get('Ring')})")
+    traces = out.get("Traces") or []
+    if not traces:
+        print("No traces collected")
+        return 0
+    print(f"{'Trace':<34} {'Root':<24} {'Spans':>5} {'ms':>10} "
+          f"{'Done':<5} Err")
+    for t in traces:
+        dur = t.get("DurationMs")
+        print(f"{t['TraceID']:<34} {t.get('Root', ''):<24} "
+              f"{t.get('Spans', 0):>5} "
+              f"{dur if dur is None else round(dur, 2)!s:>10} "
+              f"{str(t.get('Complete', False)).lower():<5} "
+              f"{'!' if t.get('Error') else ''}")
+    return 0
+
+
+def _resolve_trace_id(client: Client, given: str) -> str:
+    """Unique-prefix resolution against the retained trace list, matching
+    the node/alloc/eval short-id UX."""
+    traces = client.agent.traces().get("Traces") or []
+    ids = [t["TraceID"] for t in traces if t["TraceID"].startswith(given)]
+    if given in ids or not ids:
+        return given  # exact (or unknown: let the server 404)
+    if len(ids) > 1:
+        print(f"Prefix {given!r} matched multiple traces:", file=sys.stderr)
+        for i in ids:
+            print(f"  {i}", file=sys.stderr)
+        raise SystemExit(1)
+    return ids[0]
 
 
 def cmd_system_gc(args) -> int:

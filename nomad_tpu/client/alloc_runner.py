@@ -13,6 +13,7 @@ import threading
 from typing import Callable, Dict, Optional
 
 from nomad_tpu.structs import Allocation, TaskEvent, TaskState
+from nomad_tpu.telemetry import trace
 from nomad_tpu.structs.structs import (
     AllocClientStatusComplete,
     AllocClientStatusFailed,
@@ -52,7 +53,19 @@ class AllocRunner:
 
     # ------------------------------------------------------------- lifecycle
     def run(self) -> None:
-        """(reference: alloc_runner.go:365-464)"""
+        """(reference: alloc_runner.go:365-464). Resumes the placing
+        evaluation's trace (linked by eval id — in-process in dev mode,
+        the degraded-but-correct no-op across real processes) so the
+        client-side alloc/task startup joins the same trace as the
+        server-side scheduling that produced it."""
+        with trace.resume(trace.linked("eval", self.alloc.EvalID),
+                          "client.alloc_run", alloc=self.alloc.ID,
+                          job=self.alloc.JobID):
+            # Task runners started below resume via the alloc id.
+            trace.link("alloc", self.alloc.ID)
+            self._run_inner()
+
+    def _run_inner(self) -> None:
         tg = (self.alloc.Job.lookup_task_group(self.alloc.TaskGroup)
               if self.alloc.Job is not None else None)
         if tg is None:

@@ -14,6 +14,7 @@ from typing import Callable, Dict, List, Optional, Protocol, Tuple
 
 from nomad_tpu.resilience.retry import Backoff, CircuitBreaker, RetryPolicy
 from nomad_tpu.state.watch import Item
+from nomad_tpu.telemetry import trace
 from nomad_tpu.structs import Allocation, Node, from_dict, to_dict
 
 
@@ -270,6 +271,15 @@ class NetServerChannel:
                 pass
 
     def _call(self, method: str, body: dict, timeout: Optional[float] = None):
+        # Child-only span: a traced client operation (e.g. the service
+        # sync root) sees its wire call — with failovers and NotLeader
+        # retries as events — and the pool injects the carrier into the
+        # envelope so the server side joins the same trace.
+        with trace.span("client.rpc." + method):
+            return self._call_traced(method, body, timeout)
+
+    def _call_traced(self, method: str, body: dict,
+                     timeout: Optional[float] = None):
         from nomad_tpu.rpc.pool import RPCError
 
         def one_round():

@@ -13,6 +13,7 @@ import time
 from typing import Callable, Optional
 
 from nomad_tpu.structs import Allocation, Task, TaskEvent, TaskState
+from nomad_tpu.telemetry import trace
 from nomad_tpu.structs.structs import (
     TaskArtifactDownloadFailed,
     TaskDriverFailure,
@@ -52,6 +53,7 @@ class TaskRunner:
 
         self.handle = None
         self.handle_id: str = ""
+        self._launch_span = None
         self._destroy = threading.Event()
         self._restart = threading.Event()
         self._restart_reason = ""
@@ -85,6 +87,16 @@ class TaskRunner:
             logger.exception("task %s: failed to restore handle", self.task.Name)
             return False
 
+    def _finish_launch_span(self, error: Optional[str] = None,
+                            reattached: bool = False) -> None:
+        span = self._launch_span
+        if span is None:
+            return
+        self._launch_span = None
+        if reattached:
+            span.set_attr("reattached", True)
+        span.finish(error=error)
+
     def _driver_ctx(self) -> DriverContext:
         return DriverContext(task_name=self.task.Name, config=self.config,
                              node=self.node)
@@ -97,8 +109,16 @@ class TaskRunner:
         """(reference: task_runner.go:252-457)"""
         self._set_state(TaskStatePending, TaskEvent.new(TaskReceived))
 
+        # Trace the LAUNCH leg only (receive -> first running/dead), not
+        # the task's whole lifetime: the span joins the placing eval's
+        # trace through the alloc link the AllocRunner registered.
+        self._launch_span = trace.start_from(
+            trace.linked("alloc", self.alloc.ID), "client.task_start",
+            alloc=self.alloc.ID, task=self.task.Name)
+
         if self.handle is None:
             if not self._prepare():
+                self._finish_launch_span(error="validation/artifacts")
                 return
         else:
             # Reattached to a live executor after agent restart: report
@@ -107,10 +127,14 @@ class TaskRunner:
             event = TaskEvent.new(TaskStarted)
             event.Message = "reattached to running task"
             self._set_state(TaskStateRunning, event)
+            self._finish_launch_span(reattached=True)
 
         while not self._destroy.is_set():
             if self.handle is None:
-                if not self._start_task():
+                started = self._start_task()
+                self._finish_launch_span(
+                    error=None if started else "driver start failed")
+                if not started:
                     return
 
             result = self._wait_for_exit()

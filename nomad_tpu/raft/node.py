@@ -580,15 +580,19 @@ class RaftNode:
                       timeout: Optional[float] = None) -> Tuple[int, Any]:
         """Replicate one command; block until it is applied to the local FSM.
         Returns (index, fsm_result). Raises NotLeaderError on non-leaders
-        (reference: Server.raftApply, nomad/rpc.go:262-276)."""
-        fut = _Future()
-        with self._lock:
-            if self._role != LEADER:
-                raise NotLeaderError(self._leader_id)
-            index = self._append_locked(EntryType.Command, data)
-            self._futures[index] = fut
-        self._wait_applied(index, fut, timeout, "apply")
-        return index, fut.result
+        (reference: Server.raftApply, nomad/rpc.go:262-276). A traced
+        caller sees the full consensus wait as a raft.apply child span."""
+        from nomad_tpu.telemetry import trace
+
+        with trace.span("raft.apply", bytes=len(data)):
+            fut = _Future()
+            with self._lock:
+                if self._role != LEADER:
+                    raise NotLeaderError(self._leader_id)
+                index = self._append_locked(EntryType.Command, data)
+                self._futures[index] = fut
+            self._wait_applied(index, fut, timeout, "apply")
+            return index, fut.result
 
     def _wait_applied(self, index: int, fut: _Future,
                       timeout: Optional[float], what: str) -> None:

@@ -62,6 +62,8 @@ KNOWN_SITES: Dict[str, str] = {
                               "(drop=lost wakeup event)",
     "rpc.pool.call": "rpc: pooled client call over the wire",
     "rpc.server.handle": "rpc: server-side endpoint dispatch",
+    "services.sync": "client: service-registry sync push to the servers "
+                     "(drop=lost batch; retried next flush)",
     "worker.dequeue": "server: scheduling worker eval dequeue",
 }
 
@@ -144,6 +146,12 @@ def _fire_armed(site: str) -> Optional[str]:
                 del _armed[site]
                 _refresh_active_locked()
         mode, delay, message = spec.mode, spec.delay, spec.message
+    # Resilience <-> tracing: a triggered fault annotates the active span
+    # (and retains the trace via the error tail rule), so "which failpoint
+    # did this evaluation hit?" reads straight off its timeline.
+    from nomad_tpu.telemetry import trace as _trace
+
+    _trace.add_event("failpoint", site=site, mode=mode)
     # Act outside the lock: a delay must not serialize every other site.
     if mode == "error":
         raise FailpointError(site, message)

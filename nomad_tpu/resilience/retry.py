@@ -112,6 +112,14 @@ class RetryPolicy:
                     if remaining <= 0:
                         raise
                     delay = min(delay, remaining)
+                # Resilience <-> tracing: every retry of a traced
+                # operation lands on its active span (one truthiness
+                # check when tracing is disarmed).
+                from nomad_tpu.telemetry import trace as _trace
+
+                _trace.add_event("retry", attempt=attempt,
+                                 error=type(exc).__name__,
+                                 delay=round(delay, 4))
                 if self.on_retry is not None:
                     self.on_retry(exc, attempt, delay)
                 if self.sleep(delay):

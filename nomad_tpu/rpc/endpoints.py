@@ -19,7 +19,7 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 from nomad_tpu.raft.node import NotLeaderError
 from nomad_tpu.resilience import failpoints
 from nomad_tpu.state.watch import Item
-from nomad_tpu.telemetry import metrics
+from nomad_tpu.telemetry import metrics, trace
 from nomad_tpu.structs import (
     Allocation,
     Evaluation,
@@ -149,10 +149,27 @@ class Endpoints:
         "Service.List", "Service.GetService",
     })
 
+    # Chatty/long-poll methods that must not each mint a fresh trace when
+    # tracing is enabled (they still JOIN a caller's trace via the wire
+    # carrier): heartbeats, pings, and blocking watch queries.
+    _UNTRACED_ROOTS = frozenset({
+        "Status.Ping", "Status.Leader", "Status.Peers",
+        "Node.Heartbeat", "Node.GetClientAllocs", "Node.GetAllocs",
+        "Eval.Dequeue", "Agent.Members",
+    })
+
     # ------------------------------------------------------------- dispatch
     def handle(self, method: str, body: Any) -> Any:
         """Every RPC is timed under nomad.rpc.<Method> (reference: the
-        per-endpoint MeasureSince calls, e.g. eval_endpoint.go:73)."""
+        per-endpoint MeasureSince calls, e.g. eval_endpoint.go:73) and,
+        with tracing enabled, spanned as rpc.<Method> — the trace ingress
+        for the evaluation lifecycle."""
+        opener = (trace.span if method in self._UNTRACED_ROOTS
+                  else trace.root_span)
+        with opener("rpc." + method, method=method):
+            return self._handle(method, body)
+
+    def _handle(self, method: str, body: Any) -> Any:
         start = time.monotonic()
         metrics.incr_counter(("nomad", "rpc", "request"))
         try:
@@ -276,6 +293,14 @@ class Endpoints:
         enforce = body.get("EnforceIndex")
         eval_id, jmi, index = self.server.job_register(
             job, enforce_index=enforce)
+        if eval_id:
+            # Async-hop link: the broker/worker/applier/client stages of
+            # this evaluation resume THIS trace by eval id. (The broker
+            # also links at enqueue; this covers replicated mode, where
+            # the FSM hook runs on the raft apply thread with no ambient
+            # context.)
+            trace.link("eval", eval_id)
+            trace.add_event("eval.created", eval=eval_id, job=job.ID)
         return {"EvalID": eval_id, "JobModifyIndex": jmi, "Index": index,
                 "Warnings": warnings}
 
@@ -330,6 +355,8 @@ class Endpoints:
 
     def job_evaluate(self, body) -> Dict[str, Any]:
         eval_id, index = self.server.job_evaluate(body["JobID"])
+        if eval_id:
+            trace.link("eval", eval_id)
         return {"EvalID": eval_id, "Index": index}
 
     def job_plan(self, body) -> Dict[str, Any]:

@@ -13,6 +13,7 @@ from typing import Any, Dict, Optional
 
 from nomad_tpu.resilience import failpoints
 from nomad_tpu.resilience.retry import Backoff, RetryPolicy
+from nomad_tpu.telemetry import trace
 
 from .wire import RPC_NOMAD, MessageCodec, recv_frame, send_frame
 
@@ -103,7 +104,8 @@ class _Conn:
             self._waiters[seq] = waiter
         try:
             with self._send_lock:
-                send_frame(self.sock, MessageCodec.request(seq, method, body))
+                send_frame(self.sock, MessageCodec.request(
+                    seq, method, body, trace=trace.inject()))
         except OSError as exc:
             with self._waiter_lock:
                 self._waiters.pop(seq, None)

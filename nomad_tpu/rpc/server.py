@@ -10,6 +10,8 @@ import socket
 import threading
 from typing import Any, Callable, Dict, Optional
 
+from nomad_tpu.telemetry import trace
+
 from .pool import DroppedRPCError
 from .wire import (
     RPC_NOMAD,
@@ -162,7 +164,10 @@ class RPCServer:
                   handler: Handler, frame: Dict[str, Any]) -> None:
         seq = frame.get("Seq", 0)
         try:
-            result = handler(frame["Method"], frame.get("Body"))
+            # Attach the caller's trace context (if the envelope carried
+            # one) so handler spans join the remote trace.
+            with trace.attach(frame.get("Trace")):
+                result = handler(frame["Method"], frame.get("Body"))
             resp = MessageCodec.response(seq, body=result)
         except DroppedRPCError:
             # A black-holed request (rpc.server.handle drop failpoint):

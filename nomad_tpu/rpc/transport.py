@@ -9,6 +9,7 @@ from __future__ import annotations
 from typing import Any, Callable, Dict, Optional
 
 from nomad_tpu.raft.transport import TransportError
+from nomad_tpu.telemetry import trace
 
 from .pool import ConnPool, ConnError, RPCError
 from .wire import RPC_RAFT
@@ -40,10 +41,14 @@ class TCPTransport:
 
     def send(self, target: str, method: str, payload: Dict[str, Any]
              ) -> Dict[str, Any]:
-        try:
-            return self.pool.call(target, method, payload,
-                                  timeout=self.request_timeout)
-        except (ConnError, OSError, TimeoutError) as exc:
-            raise TransportError(f"raft rpc to {target} failed: {exc}")
-        except RPCError as exc:
-            raise TransportError(str(exc))
+        # Child-only span: raft replication threads carry no ambient
+        # trace, but a traced caller blocking on consensus (apply_command
+        # under a plan apply) sees its peer round trips.
+        with trace.span("raft.rpc." + method):
+            try:
+                return self.pool.call(target, method, payload,
+                                      timeout=self.request_timeout)
+            except (ConnError, OSError, TimeoutError) as exc:
+                raise TransportError(f"raft rpc to {target} failed: {exc}")
+            except RPCError as exc:
+                raise TransportError(str(exc))

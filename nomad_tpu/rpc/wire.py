@@ -57,8 +57,15 @@ class MessageCodec:
     """Request/response envelope helpers."""
 
     @staticmethod
-    def request(seq: int, method: str, body: Any) -> Dict[str, Any]:
-        return {"Seq": seq, "Method": method, "Body": body}
+    def request(seq: int, method: str, body: Any,
+                trace: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
+        out: Dict[str, Any] = {"Seq": seq, "Method": method, "Body": body}
+        if trace:
+            # Trace carrier (telemetry/trace.py): rides the envelope, not
+            # the body, so handlers never see it and one trace connects
+            # caller and callee processes.
+            out["Trace"] = trace
+        return out
 
     @staticmethod
     def response(seq: int, body: Any = None,

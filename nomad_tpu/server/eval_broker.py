@@ -18,6 +18,7 @@ from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
 
 from nomad_tpu.structs import Evaluation, generate_uuid
+from nomad_tpu.telemetry import trace
 from nomad_tpu.timerwheel import TimerHandle, wheel
 
 FAILED_QUEUE = "_failed"
@@ -132,6 +133,12 @@ class EvalBroker:
                 self._process_enqueue(ev, token)
 
     def _process_enqueue(self, ev: Evaluation, token: str) -> None:
+        # Tracing: remember the enqueuing context (one dict write when a
+        # trace is active, one truthiness check otherwise) so the worker
+        # that dequeues this eval — any thread, any time — can resume it,
+        # and stamp the hop on the active span.
+        trace.link("eval", ev.ID)
+        trace.add_event("broker.enqueue", eval=ev.ID, job=ev.JobID)
         if ev.ID in self._evals:
             if token == "":
                 return
@@ -219,6 +226,11 @@ class EvalBroker:
 
     def _dequeue_for_sched(self, sched: str) -> Tuple[Evaluation, str]:
         ev = self._ready[sched].pop()
+        entry = trace.linked_entry("eval", ev.ID)
+        if entry is not None:
+            # Synthesized queue-wait span: enqueue-link time -> now.
+            trace.record_span(entry[0], "broker.wait", entry[1],
+                              eval=ev.ID, scheduler=sched)
         token = generate_uuid()
         timer = wheel.after(self.nack_timeout, self.nack, ev.ID, token)
         self._unack[ev.ID] = _Unack(ev, token, timer)

@@ -18,7 +18,7 @@ from typing import Any, Callable, Dict, List, Optional
 
 from nomad_tpu.server.timetable import TimeTable
 from nomad_tpu.state.state_store import StateStore
-from nomad_tpu.telemetry import metrics
+from nomad_tpu.telemetry import metrics, trace
 from nomad_tpu.structs import (
     Allocation,
     Evaluation,
@@ -95,16 +95,18 @@ class FSM:
 
     def apply(self, index: int, msg_type: MessageType, payload: Dict[str, Any]) -> Any:
         """(reference: fsm.go:99-144 Apply dispatch; each handler is timed
-        under nomad.fsm.<op> as in fsm.go:147 MeasureSince)"""
+        under nomad.fsm.<op> as in fsm.go:147 MeasureSince, and — inside
+        an active trace — spanned as fsm.<op>, child-only so background
+        applies never mint traces)"""
         start = time.monotonic()
         self.timetable.witness(index, time.time())
         handler = _HANDLERS[msg_type]
+        leaf = _MSG_METRIC.get(msg_type, msg_type.name.lower())
         try:
-            return handler(self, index, payload)
+            with trace.span("fsm." + leaf, index=index):
+                return handler(self, index, payload)
         finally:
-            metrics.measure_since(
-                ("nomad", "fsm",
-                 _MSG_METRIC.get(msg_type, msg_type.name.lower())), start)
+            metrics.measure_since(("nomad", "fsm", leaf), start)
 
     # ------------------------------------------------------------- handlers
     def _apply_node_register(self, index: int, req: Dict[str, Any]):

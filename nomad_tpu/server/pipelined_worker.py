@@ -74,6 +74,7 @@ from nomad_tpu.scheduler.util import (
     tainted_nodes,
 )
 from nomad_tpu.structs import AllocMetric, Evaluation, Plan
+from nomad_tpu.telemetry import trace
 from nomad_tpu.structs.structs import (
     EvalStatusBlocked,
     EvalStatusComplete,
@@ -146,6 +147,7 @@ class _FastEval:
     fallback: bool = False
     stale: bool = False           # redelivered mid-window: abandoned
     shareable: bool = False       # prep eligible for place_batch_multi
+    span: object = None           # trace span covering dispatch -> ack
 
 
 class _MultiSlice:
@@ -343,6 +345,9 @@ class PipelinedWorker(Worker):
                     work.packed = self._drain_window(work)
                     self.stats["t_drain_ms"] += \
                         (time.perf_counter() - t0) * 1e3
+                    for rec in work.fast:
+                        if rec.span is not None:
+                            rec.span.event("drained")
             except Exception:
                 work.failed = True
                 if not (self._stop.is_set()
@@ -384,6 +389,8 @@ class PipelinedWorker(Worker):
                     # Nack everything; already-acked/stale evals surface as
                     # NotOutstanding races that _send_nack logs at debug.
                     for rec in work.fast:
+                        if rec.span is not None:
+                            rec.span.finish(error="window finish failed")
                         self._send_nack(rec.ev.ID, rec.token)
                     for ev, token in work.slow:
                         self._send_nack(ev.ID, token)
@@ -517,6 +524,12 @@ class PipelinedWorker(Worker):
             if rec is None:
                 slow.append((ev, token))
             else:
+                # Explicit (cross-thread) span: this eval's window ride is
+                # dispatch (this thread) -> drain -> build/ack (the stage
+                # threads); finished wherever the rec leaves the pipeline.
+                rec.span = trace.start_from(
+                    trace.linked("eval", ev.ID), "worker.window",
+                    eval=ev.ID, type=ev.Type)
                 if rec.res is not None:  # host path launched inline
                     usage_chain = rec.res.usage_after
                 fast.append(rec)
@@ -888,12 +901,22 @@ class PipelinedWorker(Worker):
         self.stats["fast"] += len(done)
         for rec in done:
             self._send_ack(rec.ev.ID, rec.token)
+            if rec.span is not None:
+                rec.span.set_attr("path", "fast")
+                rec.span.finish()
         for rec in fast:
             if rec.fallback:
                 self.stats["fallback"] += 1
+                if rec.span is not None:
+                    # Tail-retention rule: a fallback marks the trace.
+                    rec.span.event("fallback", eval=rec.ev.ID)
+                    rec.span.finish()
                 self._process_slow(rec.ev, rec.token)
             elif rec.stale:
                 self.stats["stale"] += 1
+                if rec.span is not None:
+                    rec.span.event("stale", eval=rec.ev.ID)
+                    rec.span.finish()
 
     def _status_evals(self, rec: _FastEval) -> List[Evaluation]:
         """Terminal status (+ blocked follow-up) for one fast eval, matching

@@ -40,7 +40,7 @@ from nomad_tpu.structs import (
     remove_allocs,
 )
 from nomad_tpu.structs.structs import NodeStatusReady
-from nomad_tpu.telemetry import metrics
+from nomad_tpu.telemetry import metrics, trace
 
 from .eval_broker import EvalBroker
 from .fsm import DevRaft, MessageType
@@ -478,6 +478,12 @@ class PlanApplier:
                                   overlapped=overlapped or bool(group))
             if (result is not None and result.RefreshIndex
                     and overlapped and resync is not None):
+                # PARTIAL while an apply was in flight: the one-sided
+                # overlay may have double-counted — annotate the eval's
+                # trace so the re-verify shows up in its timeline.
+                trace.add_trace_event(
+                    trace.linked("eval", pending.plan.EvalID),
+                    "plan.partial_reverify", eval=pending.plan.EvalID)
                 opt, in_flight_failed = resync()
                 overlapped = False
                 if in_flight_failed:
@@ -520,8 +526,12 @@ class PlanApplier:
                 self.stats["rejected"] += 1
                 return None
         try:
-            with metrics.measure(("nomad", "plan", "evaluate")):
-                result = evaluate_plan(opt, plan, self._pool, nt=self._nt())
+            with trace.resume(trace.linked("eval", plan.EvalID),
+                              "plan.evaluate", eval=plan.EvalID,
+                              overlapped=overlapped):
+                with metrics.measure(("nomad", "plan", "evaluate")):
+                    result = evaluate_plan(opt, plan, self._pool,
+                                           nt=self._nt())
         except Exception as e:  # verification error: reject the plan
             pending.respond(None, e)
             self.stats["rejected"] += 1
@@ -534,27 +544,44 @@ class PlanApplier:
                      ) -> None:
         """Commit a verified group as ONE consensus entry, then answer every
         waiting worker. All plans of the group share the entry's index."""
+        # Every plan's trace gets a plan.apply span covering the shared
+        # commit (explicit spans: each belongs to its OWN trace); the first
+        # live span doubles as the ambient context, so fsm/raft child
+        # spans AND failpoint/retry events of the commit land on it.
+        spans = [trace.start_from(trace.linked("eval", pending.plan.EvalID),
+                                  "plan.apply", eval=pending.plan.EvalID,
+                                  batch=len(group))
+                 for pending, _ in group]
+        primary = next((s for s in spans if s is not None), None)
         try:
             ta0 = time.perf_counter()
-            with metrics.measure(("nomad", "plan", "apply")):
-                if len(group) == 1:
-                    pending, result = group[0]
-                    index = self._apply(pending.plan, result)
-                else:
-                    if failpoints.fire("plan.apply.commit") == "drop":
-                        raise failpoints.FailpointError("plan.apply.commit")
-                    index = self.raft.apply(MessageType.AllocUpdate, {
-                        "Batch": [{"Job": pending.plan.Job,
-                                   "Alloc": _result_allocs(result)}
-                                  for pending, result in group],
-                    })
+            with (primary if primary is not None else trace.attach(None)):
+                with metrics.measure(("nomad", "plan", "apply")):
+                    if len(group) == 1:
+                        pending, result = group[0]
+                        index = self._apply(pending.plan, result)
+                    else:
+                        if failpoints.fire("plan.apply.commit") == "drop":
+                            raise failpoints.FailpointError(
+                                "plan.apply.commit")
+                        index = self.raft.apply(MessageType.AllocUpdate, {
+                            "Batch": [{"Job": pending.plan.Job,
+                                       "Alloc": _result_allocs(result)}
+                                      for pending, result in group],
+                        })
             self.stats["t_apply_ms"] += (time.perf_counter() - ta0) * 1e3
+            for span in spans:
+                if span is not None:
+                    span.finish()
             for pending, result in group:
                 result.AllocIndex = index
                 self.stats["applied"] += 1
                 pending.respond(result, None)
         except Exception as e:
             self.stats["apply_failed"] += 1
+            for span in spans:
+                if span is not None:
+                    span.finish(error=str(e))
             for pending, _ in group:
                 pending.respond(None, e)
 
@@ -566,7 +593,10 @@ class PlanApplier:
         if result is None:
             return
         if result.NodeUpdate or result.NodeAllocation:
-            result.AllocIndex = self._apply(pending.plan, result)
+            with trace.resume(trace.linked("eval", pending.plan.EvalID),
+                              "plan.apply", eval=pending.plan.EvalID,
+                              batch=1):
+                result.AllocIndex = self._apply(pending.plan, result)
         pending.respond(result, None)
 
     def _apply(self, plan: Plan, result: PlanResult) -> int:

@@ -27,7 +27,7 @@ from typing import List, Optional, Tuple
 from nomad_tpu.resilience import failpoints
 from nomad_tpu.scheduler import new_scheduler
 from nomad_tpu.scheduler.scheduler import SetStatusError
-from nomad_tpu.telemetry import metrics
+from nomad_tpu.telemetry import metrics, trace
 from nomad_tpu.structs import Evaluation, Plan, PlanResult, from_dict, to_dict
 from nomad_tpu.structs.structs import EvalStatusBlocked
 from nomad_tpu.tensor import TensorIndex
@@ -299,8 +299,11 @@ class Worker:
             ev, token, wait_index = got
             self._eval, self._token = ev, token
             try:
-                self._wait_for_index(max(ev.ModifyIndex, wait_index))
-                self._invoke_scheduler(ev, token)
+                with trace.resume(trace.linked("eval", ev.ID),
+                                  "worker.process_eval",
+                                  eval=ev.ID, type=ev.Type):
+                    self._wait_for_index(max(ev.ModifyIndex, wait_index))
+                    self._invoke_scheduler(ev, token)
             except Exception:
                 # Leadership loss tears down the plan queue / broker under a
                 # mid-flight eval; drop quietly, redelivery handles the rest
@@ -321,8 +324,11 @@ class Worker:
             return False
         ev, token, wait_index = got
         try:
-            self._wait_for_index(max(ev.ModifyIndex, wait_index))
-            self._invoke_scheduler(ev, token)
+            with trace.resume(trace.linked("eval", ev.ID),
+                              "worker.process_eval",
+                              eval=ev.ID, type=ev.Type):
+                self._wait_for_index(max(ev.ModifyIndex, wait_index))
+                self._invoke_scheduler(ev, token)
         except Exception:
             logger.exception("worker: failed to process eval %s", ev.ID)
             self._send_nack(ev.ID, token)
@@ -364,18 +370,23 @@ class Worker:
 
     def _invoke_scheduler(self, ev: Evaluation, token: str) -> None:
         """(reference: worker.go:246-283; timed per scheduler type like
-        worker.go's invoke_scheduler MeasureSince)"""
+        worker.go's invoke_scheduler MeasureSince). Resumes the eval's
+        trace when not already inside it (the pipelined slow/fallback
+        path calls this without the run loop's ambient span)."""
         start = time.monotonic()
         try:
-            self._snapshot = self.raft.fsm.state.snapshot()
-            if ev.Type == "_core":
-                if self.core_scheduler is not None:
-                    self.core_scheduler.process(ev)
-                return
-            sched = new_scheduler(ev.Type, self._snapshot, self,
-                                  self.tindex, logger,
-                                  impl=self.scheduler_impl)
-            sched.process(ev)
+            with trace.resume(trace.linked("eval", ev.ID),
+                              "worker.invoke_scheduler",
+                              eval=ev.ID, type=ev.Type):
+                self._snapshot = self.raft.fsm.state.snapshot()
+                if ev.Type == "_core":
+                    if self.core_scheduler is not None:
+                        self.core_scheduler.process(ev)
+                    return
+                sched = new_scheduler(ev.Type, self._snapshot, self,
+                                      self.tindex, logger,
+                                      impl=self.scheduler_impl)
+                sched.process(ev)
         finally:
             metrics.measure_since(
                 ("nomad", "worker", "invoke_scheduler", ev.Type), start)
@@ -405,7 +416,8 @@ class Worker:
         start = time.monotonic()
         plan.EvalToken = self._token
         try:
-            result = self.backend.submit_plan(plan)
+            with trace.span("worker.submit_plan", eval=plan.EvalID):
+                result = self.backend.submit_plan(plan)
         finally:
             metrics.measure_since(("nomad", "worker", "submit_plan"), start)
 
@@ -446,29 +458,34 @@ class Worker:
             plan.EvalToken = self._token
         partial = False
         try:
-            submit = getattr(self.backend, "submit_plans", None)
-            if submit is not None:
-                try:
-                    results = submit(plans)
-                except PartialPlanError as exc:
-                    if not exc.results:
-                        raise  # nothing committed: nack + redeliver
-                    logger.warning("worker: %s", exc)
-                    results, partial = list(exc.results), True
-            else:
-                results = []
-                try:
-                    for p in plans:
-                        results.append(self.backend.submit_plan(p))
-                except Exception:
-                    if not results:
-                        raise  # nothing committed: nack + redeliver
-                    # Degrade to a partial sweep, but NEVER silently: the
-                    # cause may be a real bug, not an injected fault.
-                    logger.exception(
-                        "worker: plan sweep failed after %d chunk(s)",
-                        len(results))
-                    partial = True
+            with trace.span("worker.submit_plans", chunks=len(plans)):
+                submit = getattr(self.backend, "submit_plans", None)
+                if submit is not None:
+                    try:
+                        results = submit(plans)
+                    except PartialPlanError as exc:
+                        if not exc.results:
+                            raise  # nothing committed: nack + redeliver
+                        logger.warning("worker: %s", exc)
+                        results, partial = list(exc.results), True
+                else:
+                    results = []
+                    try:
+                        for p in plans:
+                            results.append(self.backend.submit_plan(p))
+                    except Exception:
+                        if not results:
+                            raise  # nothing committed: nack + redeliver
+                        # Degrade to a partial sweep, but NEVER silently:
+                        # the cause may be a real bug, not an injected
+                        # fault.
+                        logger.exception(
+                            "worker: plan sweep failed after %d chunk(s)",
+                            len(results))
+                        partial = True
+                if partial:
+                    trace.add_event("fallback", kind="partial_plan_sweep",
+                                    committed=len(results))
         finally:
             metrics.measure_since(("nomad", "worker", "submit_plan"), start)
         refresh = max((r.RefreshIndex for r in results if r is not None),

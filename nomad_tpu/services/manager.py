@@ -21,6 +21,8 @@ import threading
 import time
 from typing import Callable, Dict, List, Optional, Tuple
 
+from nomad_tpu.resilience import failpoints
+from nomad_tpu.telemetry import trace
 from nomad_tpu.structs import (
     Allocation,
     CheckState,
@@ -275,18 +277,31 @@ class ServiceManager:
             deletes = list(self._deletes)
             self._dirty.clear()
             self._deletes.clear()
-        try:
-            self.sync_fn(upserts, deletes)
-        except Exception:
-            logger.exception("service sync failed; will retry")
-            with self._lock:
-                for reg in upserts:
-                    if reg.ID in self._instances:
-                        self._dirty.add(reg.ID)
-                # Only re-queue deletes still absent from _instances: a
-                # registration re-registered between the failed sync and the
-                # retry must not get a delete racing its upsert (the FSM
-                # applies upserts then deletes, which would deregister the
-                # live service until the next anti-entropy full sync).
-                self._deletes.update(
-                    rid for rid in deletes if rid not in self._instances)
+        # Traced as its own root (only when a batch actually pushes): the
+        # sync seam is the ROADMAP-named failpoint site, and a triggered
+        # fault must land as an event on this span.
+        with trace.root_span("client.services.sync",
+                             upserts=len(upserts), deletes=len(deletes)):
+            try:
+                if failpoints.fire("services.sync") == "drop":
+                    # A lost batch, the way a partitioned wire would lose
+                    # it: the except path re-queues everything for the
+                    # next flush / anti-entropy pass.
+                    raise failpoints.FailpointError(
+                        "services.sync", "service sync batch dropped")
+                self.sync_fn(upserts, deletes)
+            except Exception:
+                logger.exception("service sync failed; will retry")
+                with self._lock:
+                    for reg in upserts:
+                        if reg.ID in self._instances:
+                            self._dirty.add(reg.ID)
+                    # Only re-queue deletes still absent from _instances: a
+                    # registration re-registered between the failed sync and
+                    # the retry must not get a delete racing its upsert (the
+                    # FSM applies upserts then deletes, which would
+                    # deregister the live service until the next
+                    # anti-entropy full sync).
+                    self._deletes.update(
+                        rid for rid in deletes
+                        if rid not in self._instances)

@@ -1,7 +1,9 @@
 """Telemetry: operational metrics with in-memory aggregation and push sinks
 (reference: the go-metrics instrumentation threaded through nomad/*.go and
-configured by command/agent/command.go setupTelemetry)."""
+configured by command/agent/command.go setupTelemetry), plus Dapper-style
+evaluation-lifecycle tracing (trace.py)."""
 
+from . import trace  # noqa: F401
 from .metrics import (
     InMemSink,
     MetricsRegistry,
@@ -17,6 +19,7 @@ from .metrics import (
 )
 
 __all__ = [
+    "trace",
     "InMemSink",
     "MetricsRegistry",
     "StatsdSink",

@@ -13,11 +13,14 @@ rendered dotted ("nomad.fsm.apply") for sinks and the HTTP endpoint.
 
 from __future__ import annotations
 
+import logging
 import socket
 import threading
 import time
 from contextlib import contextmanager
 from typing import Any, Dict, Iterable, List, Optional, Tuple
+
+logger = logging.getLogger("nomad.telemetry")
 
 Key = Tuple[str, ...]
 
@@ -175,21 +178,49 @@ class MetricsRegistry:
     def configure(self, statsd_addr: str = "",
                   collection_interval: float = 10.0,
                   host_label: str = "") -> None:
-        """(reference: command/agent/command.go:556-580 setupTelemetry)"""
-        with self._lock:
-            self.inmem = InMemSink(interval=collection_interval)
-            sinks: List[Any] = [self.inmem]
-            if statsd_addr:
+        """(reference: command/agent/command.go:556-580 setupTelemetry)
+
+        Reload-safe: a SIGHUP reconfigure swaps the sink list atomically
+        (``_fan`` snapshots the reference under the lock and the list is
+        never mutated in place) and CLOSES any replaced StatsdSink — the
+        old UDP socket would otherwise leak once per reload. A statsd
+        sink that cannot be constructed (unresolvable address) degrades
+        to a logged warning instead of aborting agent boot/reload; the
+        in-memory sink always survives."""
+        sinks: List[Any] = [InMemSink(interval=collection_interval)]
+        if statsd_addr:
+            try:
                 sinks.append(StatsdSink(statsd_addr, host_label=host_label))
+            except (OSError, ValueError) as exc:
+                logger.warning(
+                    "telemetry: statsd sink %s unavailable (%s); "
+                    "keeping in-memory sink only", statsd_addr, exc)
+        with self._lock:
+            old = self._sinks
+            self.inmem = sinks[0]
             self._sinks = sinks
             self.host_label = host_label
+        for sink in old:
+            if sink in sinks:
+                continue
+            close = getattr(sink, "close", None)
+            if close is not None:
+                try:
+                    close()
+                except Exception:
+                    pass
 
     def add_sink(self, sink: Any) -> None:
         with self._lock:
-            self._sinks.append(sink)
+            # Replace, never mutate: _fan iterates its snapshot lock-free.
+            self._sinks = self._sinks + [sink]
 
     def _fan(self, op: str, key: Key, value: float) -> None:
-        for sink in self._sinks:
+        # Snapshot the list REFERENCE under the lock: configure() swaps
+        # whole lists, so a concurrent reload can never tear this walk.
+        with self._lock:
+            sinks = self._sinks
+        for sink in sinks:
             try:
                 getattr(sink, op)(key, value)
             except Exception:

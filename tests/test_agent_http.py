@@ -63,6 +63,15 @@ class TestHTTPAPI:
             snap = api.agent.metrics()
             assert set(snap) == {"Timestamp", "Gauges", "Counters",
                                  "Samples"}
+            # Entry shapes (reference: go-metrics DisplayMetrics): gauges
+            # are {Name, Value}; counters and samples are aggregates.
+            for g in snap["Gauges"]:
+                assert set(g) == {"Name", "Value"}
+            for agg in list(snap["Counters"]) + list(snap["Samples"]):
+                assert set(agg) == {"Name", "Count", "Sum", "Min", "Max",
+                                    "Mean"}
+                assert agg["Count"] >= 1
+                assert agg["Min"] <= agg["Mean"] <= agg["Max"]
             # The HTTP snapshot shows the current interval; the sample we
             # just forced may land either side of a rotation boundary, so
             # assert against the sink's retained intervals.

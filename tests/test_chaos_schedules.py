@@ -202,6 +202,74 @@ class TestBlockedWakeupSchedule:
             srv.shutdown()
 
 
+class TestServiceSyncSchedule:
+    """ROADMAP candidate site: the service-registry sync seam
+    (services/manager.py `services.sync`). An injected sync failure must
+    degrade gracefully — registrations re-queue and land once the fault
+    heals — and the triggered fault must show up as an event on the
+    active trace span (resilience <-> tracing integration)."""
+
+    def test_sync_failure_requeues_then_heals_and_traces(self):
+        import threading
+
+        from nomad_tpu import mock
+        from nomad_tpu.services.manager import ServiceManager
+        from nomad_tpu.telemetry import trace
+
+        synced: list = []
+        delivered = threading.Event()
+
+        def sync_fn(upserts, deletes):
+            synced.append((list(upserts), list(deletes)))
+            if upserts:
+                delivered.set()
+
+        trace.configure(enabled=True, sample_ratio=1.0)
+        trace.clear()
+        mgr = None
+        try:
+            mgr = ServiceManager(mock.node(), sync_fn)
+            alloc = mock.alloc()
+            task = alloc.Job.TaskGroups[0].Tasks[0]
+            from nomad_tpu.structs import Service
+
+            task.Services = [Service(Name="traced-svc")]
+            with ChaosSchedule(name="svc-sync") \
+                    .arm(0.0, "services.sync=error:count=2") as sched:
+                sched.join(5.0)
+                mgr.register_task(alloc, task)
+                # Degraded: the armed flushes fail and re-queue; once the
+                # count exhausts (self-heals), the batch must land.
+                assert wait_for(delivered.is_set, timeout=30,
+                                msg="sync batch never landed after heal")
+            assert failpoints.snapshot()["services.sync"]["fired"] >= 1
+            regs = [r for ups, _ in synced for r in ups]
+            assert any(r.ServiceName == "traced-svc" for r in regs)
+
+            # The triggered fault is an event on the sync span's trace.
+            def fault_span():
+                for t in trace.traces():
+                    full = trace.get_trace(t["TraceID"])
+                    for s in full["Spans"]:
+                        if s["Name"] != "client.services.sync":
+                            continue
+                        for ev in s["Events"]:
+                            if ev["Name"] == "failpoint" and \
+                                    ev["Attrs"].get("site") == \
+                                    "services.sync":
+                                return s
+                return None
+
+            assert wait_for(lambda: fault_span() is not None, timeout=10,
+                            msg="failpoint event never landed on the "
+                                "client.services.sync span")
+        finally:
+            if mgr is not None:
+                mgr.shutdown()
+            trace.configure(enabled=False)
+            trace.clear()
+
+
 @pytest.mark.slow
 class TestStormSchedules:
     """Multi-second storms against the networked 3-server cluster —

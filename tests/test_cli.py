@@ -229,6 +229,82 @@ class TestClusterCommands:
         payload = json.loads(out)
         assert payload["Workers"][0]["Stats"]["windows"] >= 0
 
+    def test_trace_enable_list_show_export_disable(self, capsys, address,
+                                                   dev_agent, tmp_path):
+        """`nomad-tpu trace` drives the tracing surface end to end:
+        runtime enable, a traced register, list, span-tree render, the
+        Perfetto export, clear, disable."""
+        from nomad_tpu.telemetry import trace
+
+        try:
+            rc, out, _ = run_cli(capsys, "trace", "-address", address,
+                                 "-enable", "-ratio", "1.0")
+            assert rc == 0 and "enabled" in out
+
+            # One traced mutation through the HTTP API.
+            from nomad_tpu.api import Client as APIClient
+            from nomad_tpu.jobspec import parse_job
+
+            api = APIClient(address=address)
+            job = parse_job('''
+job "clitrace" {
+  datacenters = ["dc1"]
+  type = "batch"
+  group "g" {
+    task "t" {
+      driver = "raw_exec"
+      config { command = "/bin/sh" args = ["-c", "exit 0"] }
+      resources { cpu = 20 memory = 16 disk = 300 }
+    }
+  }
+}
+''')
+            job.init_fields()
+            eval_id, _ = api.jobs.register(job)
+            assert wait_for(lambda: api.evaluations.info(eval_id)[0]
+                            ["Status"] == "complete", timeout=40)
+
+            def listed():
+                rc, out, _ = run_cli(capsys, "trace", "-address", address)
+                return out if rc == 0 and "rpc.Job.Register" in out else None
+
+            assert wait_for(lambda: listed() is not None, timeout=15,
+                            msg="trace list never showed the register")
+
+            rc, out, _ = run_cli(capsys, "trace", "-address", address,
+                                 "-json")
+            assert rc == 0
+            listing = json.loads(out)
+            tid = next(t["TraceID"] for t in listing["Traces"]
+                       if t["Root"] == "rpc.Job.Register")
+
+            # Span tree by unique id prefix.
+            rc, out, _ = run_cli(capsys, "trace", "-address", address,
+                                 tid[:12])
+            assert rc == 0
+            assert "rpc.Job.Register" in out and "broker.wait" in out
+
+            # Perfetto export.
+            dest = str(tmp_path / "trace.json")
+            rc, out, _ = run_cli(capsys, "trace", "-address", address,
+                                 tid, "-export", dest)
+            assert rc == 0
+            with open(dest) as f:
+                payload = json.load(f)
+            assert payload["traceEvents"]
+
+            rc, out, _ = run_cli(capsys, "trace", "-address", address,
+                                 "-clear")
+            assert rc == 0
+            rc, out, _ = run_cli(capsys, "trace", "-address", address,
+                                 "-disable")
+            assert rc == 0 and "disabled" in out
+        finally:
+            trace.configure(enabled=False)
+            trace.clear()
+            run_cli(capsys, "stop", "-detach", "-address", address,
+                    "clitrace")
+
     def test_unknown_job_errors_cleanly(self, capsys, address):
         rc, out, err = run_cli(capsys, "status", "-address", address,
                                "no-such-job")

@@ -55,6 +55,22 @@ class TestInMemSink:
         assert InMemSink(interval=0).interval == 1.0
         assert InMemSink(interval=0.001).interval == 1.0
 
+    def test_interval_rollover_starts_fresh_and_retains_past(self):
+        """Crossing an interval boundary opens a NEW aggregation window
+        (snapshot shows only the current one) while the previous interval
+        stays retained for the dump/debug surfaces."""
+        sink = InMemSink(interval=10.0, retain=5)
+        with sink._lock:
+            cur = sink._current(1000.0)
+        cur["counters"]["hits"] = object()
+        with sink._lock:
+            nxt = sink._current(1011.0)  # next 10s bucket
+        assert nxt is not cur
+        assert nxt["counters"] == {}
+        assert len(sink._intervals) == 2
+        assert sink._intervals[0]["start"] == 1000.0
+        assert sink._intervals[1]["start"] == 1010.0
+
 
 class TestStatsdSink:
     def test_datagrams_cross_the_socket(self):
@@ -95,6 +111,85 @@ class TestRegistry:
         reg.add_sink(Bad())
         reg.set_gauge(("g",), 1)  # must not raise
         assert reg.snapshot()["Gauges"][0]["Value"] == 1
+
+    def test_reconfigure_closes_replaced_statsd_sink(self):
+        """SIGHUP reloads swap the sink list; the replaced StatsdSink's
+        UDP socket must be closed, not leaked (one socket per reload)."""
+        recv = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        recv.bind(("127.0.0.1", 0))
+        addr = "127.0.0.1:%d" % recv.getsockname()[1]
+        try:
+            reg = MetricsRegistry()
+            reg.configure(statsd_addr=addr)
+            old = next(s for s in reg._sinks
+                       if isinstance(s, StatsdSink))
+            reg.configure(statsd_addr=addr)
+            new = next(s for s in reg._sinks
+                       if isinstance(s, StatsdSink))
+            assert new is not old
+            assert old._sock.fileno() == -1, "replaced sink not closed"
+            assert new._sock.fileno() != -1
+            new.close()
+        finally:
+            recv.close()
+
+    def test_unresolvable_statsd_addr_degrades_not_raises(self):
+        """A bad statsd target must not abort agent boot/reload: warn and
+        keep the in-memory sink."""
+        reg = MetricsRegistry()
+        reg.configure(statsd_addr="no-such-host.invalid:8125")
+        assert not any(isinstance(s, StatsdSink) for s in reg._sinks)
+        reg.set_gauge(("still", "working"), 1.0)
+        assert reg.snapshot()["Gauges"][0]["Value"] == 1.0
+
+    def test_fan_survives_concurrent_reconfigure(self):
+        """_fan snapshots the sink-list reference under the lock; a storm
+        of configure() swaps racing a storm of writes must neither raise
+        nor blank telemetry."""
+        import threading
+
+        reg = MetricsRegistry()
+        stop = threading.Event()
+        errors = []
+
+        def reconfigure():
+            while not stop.is_set():
+                try:
+                    reg.configure(collection_interval=60.0)
+                except Exception as exc:  # pragma: no cover
+                    errors.append(exc)
+
+        t = threading.Thread(target=reconfigure, daemon=True)
+        t.start()
+        try:
+            for i in range(2000):
+                reg.incr_counter(("race", "hits"))
+        finally:
+            stop.set()
+            t.join(5.0)
+        assert not errors
+
+
+class TestTelemetryDumpHandler:
+    def test_sigusr1_dump_logs_valid_snapshot_json(self, caplog):
+        """The SIGUSR1 handler (cli/commands.py dump_telemetry) dumps the
+        in-memory snapshot as one parseable JSON log line."""
+        import json
+        import logging
+
+        from nomad_tpu.cli.commands import dump_telemetry
+
+        telemetry.configure(collection_interval=3600.0)
+        telemetry.incr_counter(("dump", "probe"))
+        with caplog.at_level(logging.INFO, logger="nomad.agent"):
+            dump_telemetry()  # signature-compatible with signal delivery
+        [record] = [r for r in caplog.records
+                    if "metrics snapshot" in r.getMessage()]
+        payload = json.loads(record.getMessage().split(":", 1)[1])
+        assert set(payload) == {"Timestamp", "Gauges", "Counters",
+                                "Samples"}
+        assert any(c["Name"] == "dump.probe"
+                   for c in payload["Counters"])
 
 
 class TestSchedulingCycleMetrics:

@@ -1,0 +1,146 @@
+"""Static telemetry/tracing lint: catches silent key drift between code
+and the declared registries/docs.
+
+* Every ``failpoints.fire("...")`` literal in the source tree must be
+  declared in ``failpoints.KNOWN_SITES`` (a renamed seam that keeps its
+  old registry entry would list as armable but never fire) — and every
+  declared site must still be referenced in source (a deleted seam must
+  lose its registry entry).
+* Every literal metrics key must follow the documented ``nomad.*``
+  naming scheme (tuple of lowercase dotted segments).
+* Every literal trace span name must follow the ``subsystem.operation``
+  scheme the README's tracing section documents.
+"""
+
+import ast
+import os
+import re
+
+import nomad_tpu
+from nomad_tpu.resilience import failpoints
+
+PKG_ROOT = os.path.dirname(os.path.abspath(nomad_tpu.__file__))
+
+_METRIC_FNS = {"set_gauge", "incr_counter", "add_sample", "measure",
+               "measure_since"}
+_TRACE_SPAN_FNS = {"span", "root_span", "resume", "start_from"}
+_SEGMENT_RE = re.compile(r"^[a-z0-9_]+$")
+_SPAN_NAME_RE = re.compile(
+    r"^[a-z][a-z0-9_]*(\.[A-Za-z][A-Za-z0-9_]*)+$")
+
+
+def _py_files():
+    for dirpath, dirnames, filenames in os.walk(PKG_ROOT):
+        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+        for fn in filenames:
+            if fn.endswith(".py"):
+                yield os.path.join(dirpath, fn)
+
+
+def _parsed():
+    for path in _py_files():
+        with open(path, encoding="utf-8") as f:
+            yield path, ast.parse(f.read(), filename=path)
+
+
+def _call_name(node: ast.Call) -> str:
+    func = node.func
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    if isinstance(func, ast.Name):
+        return func.id
+    return ""
+
+
+def _receiver(node: ast.Call) -> str:
+    func = node.func
+    if isinstance(func, ast.Attribute) and isinstance(func.value, ast.Name):
+        return func.value.id
+    return ""
+
+
+def test_every_fired_site_is_declared_and_vice_versa():
+    fired = set()
+    for path, tree in _parsed():
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call) or _call_name(node) != "fire":
+                continue
+            if _receiver(node) not in ("failpoints", ""):
+                continue
+            if node.args and isinstance(node.args[0], ast.Constant) \
+                    and isinstance(node.args[0].value, str):
+                fired.add(node.args[0].value)
+    undeclared = fired - set(failpoints.KNOWN_SITES)
+    assert not undeclared, \
+        f"failpoint sites fired in source but missing from " \
+        f"KNOWN_SITES: {sorted(undeclared)}"
+    unreferenced = set(failpoints.KNOWN_SITES) - fired
+    assert not unreferenced, \
+        f"KNOWN_SITES entries no source location fires (renamed seam?): " \
+        f"{sorted(unreferenced)}"
+
+
+def test_metric_key_literals_follow_nomad_scheme():
+    bad = []
+    for path, tree in _parsed():
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if _call_name(node) not in _METRIC_FNS:
+                continue
+            if _receiver(node) not in ("metrics", "telemetry", "registry",
+                                       "reg", ""):
+                continue
+            if not node.args or not isinstance(node.args[0], ast.Tuple):
+                continue
+            elts = node.args[0].elts
+            consts = [e.value for e in elts
+                      if isinstance(e, ast.Constant)
+                      and isinstance(e.value, str)]
+            if not consts:
+                continue
+            rel = os.path.relpath(path, PKG_ROOT)
+            if isinstance(elts[0], ast.Constant) and consts[0] != "nomad":
+                bad.append((rel, node.lineno, tuple(consts),
+                            "first segment must be 'nomad'"))
+                continue
+            # Dynamic trailing segments (ev.Type, RPC method names) are
+            # exempt; every CONSTANT segment must match the scheme.
+            for seg in consts:
+                if seg != "nomad" and not all(
+                        _SEGMENT_RE.match(p) for p in seg.split(".")):
+                    bad.append((rel, node.lineno, tuple(consts),
+                                f"segment {seg!r} breaks [a-z0-9_]"))
+                    break
+    assert not bad, f"metric key literals off the nomad.* scheme: {bad}"
+
+
+def test_trace_span_name_literals_follow_scheme():
+    bad = []
+    for path, tree in _parsed():
+        if os.path.relpath(path, PKG_ROOT) == os.path.join("telemetry",
+                                                           "trace.py"):
+            continue  # the implementation's docstrings/internals
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name_arg = None
+            fn = _call_name(node)
+            recv = _receiver(node)
+            if recv not in ("trace", "_trace"):
+                continue
+            if fn in _TRACE_SPAN_FNS:
+                # span(name)/root_span(name) take name first;
+                # resume/start_from take (carrier, name).
+                idx = 0 if fn in ("span", "root_span") else 1
+                if len(node.args) > idx:
+                    name_arg = node.args[idx]
+            elif fn == "record_span" and len(node.args) > 1:
+                name_arg = node.args[1]
+            if name_arg is None or not isinstance(name_arg, ast.Constant) \
+                    or not isinstance(name_arg.value, str):
+                continue  # dynamic names ("rpc." + method) are exempt
+            if not _SPAN_NAME_RE.match(name_arg.value):
+                bad.append((os.path.relpath(path, PKG_ROOT), node.lineno,
+                            name_arg.value))
+    assert not bad, f"trace span literals off the a.b scheme: {bad}"

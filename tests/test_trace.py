@@ -1,0 +1,333 @@
+"""Evaluation-lifecycle tracing tests (telemetry/trace.py): span model +
+carrier propagation unit tests, and the end-to-end acceptance drive — one
+job register through a dev agent to a running task, asserting a single
+connected trace across server- and client-side work, retrievable through
+/v1/agent/debug/trace and exportable as Chrome trace-event JSON."""
+
+import json
+import time
+
+import pytest
+
+from nomad_tpu.agent import Agent, AgentConfig
+from nomad_tpu.api import Client as APIClient
+from nomad_tpu.jobspec import parse_job
+from nomad_tpu.telemetry import trace
+
+from helpers import wait_for  # noqa: E402
+
+pytestmark = pytest.mark.timing_retry
+
+
+@pytest.fixture(autouse=True)
+def _trace_reset():
+    """Every test starts disarmed with an empty collector and leaves the
+    global tracer the way tier-1 expects it: OFF."""
+    trace.configure(enabled=False, sample_ratio=1.0, ring=128)
+    trace.clear()
+    yield
+    trace.configure(enabled=False, sample_ratio=1.0, ring=128)
+    trace.clear()
+
+
+class TestSpanModel:
+    def test_disarmed_is_noop(self):
+        s = trace.root_span("anything")
+        assert s is trace._NOOP
+        assert trace.span("child") is trace._NOOP
+        assert trace.inject() is None
+        assert trace.linked("eval", "x") is None
+        trace.add_event("ignored")  # must not raise
+        assert trace.traces() == []
+
+    def test_nesting_and_parent_ids(self):
+        trace.configure(enabled=True)
+        with trace.root_span("rpc.test", method="t") as root:
+            with trace.span("fsm.test") as child:
+                assert child.trace_id == root.trace_id
+                assert child.parent_id == root.span_id
+        [summary] = trace.traces()
+        assert summary["Complete"]
+        full = trace.get_trace(summary["TraceID"])
+        names = {s["Name"] for s in full["Spans"]}
+        assert names == {"rpc.test", "fsm.test"}
+
+    def test_durations_are_monotonic_ms(self):
+        trace.configure(enabled=True)
+        with trace.root_span("rpc.sleep"):
+            time.sleep(0.02)
+        full = trace.get_trace(trace.traces()[0]["TraceID"])
+        [span] = full["Spans"]
+        assert span["DurationMs"] >= 15.0
+        assert abs(span["Start"] - time.time()) < 5.0  # wall anchor
+
+    def test_carrier_roundtrip_resume(self):
+        trace.configure(enabled=True)
+        with trace.root_span("rpc.origin") as root:
+            carrier = trace.inject()
+        assert carrier["TraceID"] == root.trace_id
+        assert carrier["SpanID"] == root.span_id
+        # Another "process"/thread resumes from the carrier alone.
+        with trace.resume(carrier, "worker.remote") as remote:
+            assert remote.trace_id == root.trace_id
+            assert remote.parent_id == root.span_id
+
+    def test_resume_prefers_ambient_context(self):
+        trace.configure(enabled=True)
+        with trace.root_span("rpc.a") as a:
+            with trace.resume({"TraceID": "f" * 32, "SpanID": "b" * 16},
+                              "nested") as nested:
+                assert nested.trace_id == a.trace_id
+
+    def test_links_connect_async_hops(self):
+        trace.configure(enabled=True)
+        with trace.root_span("rpc.enqueue"):
+            trace.link("eval", "ev-123")
+        carrier = trace.linked("eval", "ev-123")
+        assert carrier is not None
+        with trace.resume(carrier, "worker.dequeue"):
+            pass
+        full = trace.get_trace(carrier["TraceID"])
+        assert {s["Name"] for s in full["Spans"]} == {"rpc.enqueue",
+                                                      "worker.dequeue"}
+
+    def test_record_span_synthesizes_queue_wait(self):
+        trace.configure(enabled=True)
+        with trace.root_span("rpc.q"):
+            carrier = trace.inject()
+        start = time.monotonic()
+        time.sleep(0.01)
+        trace.record_span(carrier, "broker.wait", start, eval="e")
+        full = trace.get_trace(carrier["TraceID"])
+        wait = next(s for s in full["Spans"] if s["Name"] == "broker.wait")
+        assert wait["DurationMs"] >= 5.0
+
+    def test_ring_is_bounded_at_configured_size(self):
+        trace.configure(enabled=True, ring=4)
+        for i in range(20):
+            with trace.root_span("rpc.n", i=i):
+                pass
+        assert len(trace.traces()) <= 4
+        trace.configure(ring=64)
+        for i in range(80):
+            with trace.root_span("rpc.n", i=i):
+                pass
+        assert len(trace.traces()) <= 64
+
+    def test_attach_without_spans_creates_no_trace(self):
+        """A carrier-bearing frame whose handler never opens a span (raft
+        replication on followers) must not pollute the ring with empty
+        traces — the local trace is created lazily at first span."""
+        trace.configure(enabled=True)
+        carrier = {"TraceID": "a" * 32, "SpanID": "b" * 16,
+                   "Sampled": True}
+        with trace.attach(carrier):
+            assert trace.inject() == carrier  # context still propagates
+        assert trace.traces() == []
+        assert trace.get_trace("a" * 32) is None
+        # ...but a handler that DOES span joins the remote trace.
+        with trace.attach(carrier):
+            with trace.span("rpc.Handled") as s:
+                assert s.trace_id == "a" * 32
+        [summary] = trace.traces()
+        assert summary["TraceID"] == "a" * 32
+
+    def test_head_sampling_zero_drops_clean_traces(self):
+        trace.configure(enabled=True, sample_ratio=0.0)
+        with trace.root_span("rpc.clean"):
+            pass
+        assert trace.traces() == []
+
+    def test_error_tail_rule_retains_unsampled_trace(self):
+        trace.configure(enabled=True, sample_ratio=0.0)
+        with trace.root_span("rpc.faulty"):
+            trace.add_event("failpoint", site="x", mode="error")
+        [summary] = trace.traces()
+        assert summary["Error"]
+
+    def test_failpoint_trigger_lands_on_active_span(self):
+        from nomad_tpu.resilience import failpoints
+
+        trace.configure(enabled=True)
+        failpoints.arm("trace.test.site", "delay", delay=0.0, count=1)
+        try:
+            with trace.root_span("rpc.fp"):
+                failpoints.fire("trace.test.site")
+        finally:
+            failpoints.disarm("trace.test.site")
+        full = trace.get_trace(trace.traces()[0]["TraceID"])
+        [span] = full["Spans"]
+        events = {e["Name"]: e["Attrs"] for e in span["Events"]}
+        assert events["failpoint"]["site"] == "trace.test.site"
+
+    def test_retry_attempts_land_on_active_span(self):
+        from nomad_tpu.resilience.retry import Backoff, RetryPolicy
+
+        trace.configure(enabled=True)
+        calls = {"n": 0}
+
+        def flaky():
+            calls["n"] += 1
+            if calls["n"] < 3:
+                raise ConnectionError("boom")
+            return "ok"
+
+        policy = RetryPolicy(max_attempts=5,
+                             backoff=Backoff(base=0.001, cap=0.002))
+        with trace.root_span("rpc.retry"):
+            assert policy.call(flaky) == "ok"
+        full = trace.get_trace(trace.traces()[0]["TraceID"])
+        [span] = full["Spans"]
+        retries = [e for e in span["Events"] if e["Name"] == "retry"]
+        assert len(retries) == 2
+        assert retries[0]["Attrs"]["error"] == "ConnectionError"
+
+    def test_metrics_bridge_records_nomad_trace_samples(self):
+        from nomad_tpu import telemetry
+
+        telemetry.configure(collection_interval=3600.0)
+        trace.configure(enabled=True)
+        with trace.root_span("rpc.bridged"):
+            pass
+        snap = telemetry.snapshot()
+        assert any(s["Name"] == "nomad.trace.rpc.bridged"
+                   for s in snap["Samples"])
+
+    def test_chrome_export_is_valid_trace_event_json(self):
+        trace.configure(enabled=True)
+        with trace.root_span("rpc.export"):
+            with trace.span("fsm.export"):
+                trace.add_event("failpoint", site="s", mode="drop")
+        out = trace.export_chrome()
+        json.loads(json.dumps(out))  # JSON-serializable end to end
+        events = out["traceEvents"]
+        complete = [e for e in events if e["ph"] == "X"]
+        assert len(complete) == 2
+        for e in complete:
+            assert {"name", "cat", "ph", "ts", "dur", "pid", "tid"} <= set(e)
+        assert any(e["ph"] == "i" for e in events)  # the failpoint instant
+        assert any(e["ph"] == "M" and e["name"] == "thread_name"
+                   for e in events)
+
+    def test_wire_envelope_carries_and_attaches(self):
+        """The msgpack envelope leg: a request built inside a trace carries
+        the carrier; the server dispatcher attach()es it so handler spans
+        join the caller's trace (rpc/wire.py + rpc/server.py)."""
+        from nomad_tpu.rpc.wire import MessageCodec
+
+        trace.configure(enabled=True)
+        with trace.root_span("rpc.client_side") as origin:
+            frame = MessageCodec.request(1, "Status.Ping", {},
+                                         trace=trace.inject())
+        assert frame["Trace"]["TraceID"] == origin.trace_id
+        # Simulated remote process: attach + a handler span.
+        with trace.attach(frame["Trace"]):
+            with trace.span("rpc.Status.Ping") as handler:
+                assert handler.trace_id == origin.trace_id
+                assert handler.parent_id == origin.span_id
+        assert MessageCodec.request(2, "m", {}).get("Trace") is None
+
+
+SLEEPER_JOB = '''
+job "tracejob" {
+  datacenters = ["dc1"]
+  type = "batch"
+  group "g" {
+    task "t" {
+      driver = "raw_exec"
+      config { command = "/bin/sh" args = ["-c", "sleep 2"] }
+      resources { cpu = 50 memory = 32 disk = 300 }
+    }
+  }
+}
+'''
+
+
+class TestEndToEndTrace:
+    """The acceptance drive: one register -> running mock task, one
+    connected trace spanning both sides of the control plane."""
+
+    @pytest.fixture()
+    def dev_agent(self, tmp_path):
+        config = AgentConfig.dev()
+        config.http_port = 0
+        config.data_dir = str(tmp_path / "agent")
+        agent = Agent(config)
+        agent.start()
+        api = APIClient(address=f"http://127.0.0.1:{agent.http.port}")
+        yield agent, api
+        agent.shutdown()
+
+    def test_register_to_running_task_is_one_trace(self, dev_agent):
+        agent, api = dev_agent
+        # Runtime toggle through the debug endpoint (the same surface the
+        # `trace` CLI drives).
+        status = api.agent.configure_trace(enabled=True, sample_ratio=1.0)
+        assert status["Enabled"] is True
+
+        job = parse_job(SLEEPER_JOB)
+        job.init_fields()
+        eval_id, _ = api.jobs.register(job)
+        assert eval_id
+        assert wait_for(lambda: api.evaluations.info(eval_id)[0]["Status"]
+                        == "complete", timeout=40)
+        assert wait_for(
+            lambda: (allocs := api.jobs.allocations("tracejob")[0])
+            and allocs[0]["ClientStatus"] in ("running", "complete"),
+            timeout=40, msg="alloc never started")
+
+        def register_trace():
+            listing = api.agent.traces()
+            for t in listing.get("Traces", ()):
+                if t["Root"] != "rpc.Job.Register":
+                    continue
+                full = api.agent.trace(t["TraceID"])["Trace"]
+                names = {s["Name"] for s in full["Spans"]}
+                if "client.task_start" in names:
+                    return full
+            return None
+
+        assert wait_for(lambda: register_trace() is not None, timeout=30,
+                        msg="client-side spans never joined the trace")
+        full = register_trace()
+        spans = full["Spans"]
+        # One trace id across every span.
+        assert {s["TraceID"] for s in spans} == {full["TraceID"]}
+        assert len(spans) >= 6
+        names = {s["Name"] for s in spans}
+        # Server side: broker, worker stage, plan apply, fsm.
+        assert "broker.wait" in names
+        assert names & {"worker.window", "worker.process_eval",
+                        "worker.invoke_scheduler"}
+        assert "plan.apply" in names
+        assert any(n.startswith("fsm.") for n in names)
+        # Client side: alloc pickup + task launch.
+        assert "client.alloc_run" in names
+        assert "client.task_start" in names
+
+        # Chrome trace-event export: valid JSON with complete events.
+        chrome = api.agent.trace(full["TraceID"], chrome=True)
+        events = chrome["traceEvents"]
+        assert events and all("ph" in e and "ts" in e and "pid" in e
+                              for e in events)
+        assert any(e["ph"] == "X" and e["name"] == "client.task_start"
+                   for e in events)
+        json.dumps(chrome)
+
+        # Unknown ids 404 on both the full and chrome paths.
+        from nomad_tpu.api import APIError
+
+        with pytest.raises(APIError) as exc:
+            api.agent.trace("f" * 32)
+        assert exc.value.code == 404
+        with pytest.raises(APIError) as exc:
+            api.agent.trace("f" * 32, chrome=True)
+        assert exc.value.code == 404
+
+        # Disable + clear puts the agent back in the disarmed state.
+        api.agent.configure_trace(enabled=False)
+        api.agent.clear_traces()
+        assert api.agent.traces()["Traces"] == []
+        from nomad_tpu.telemetry import trace as trace_mod
+
+        assert trace_mod.span("x") is trace_mod._NOOP

@@ -106,6 +106,7 @@ class LogRing(logging.Handler):
     def emit(self, record: logging.LogRecord) -> None:
         try:
             line = self.format(record)
+        # lint: allow(swallow, cannot log a failure of the log handler itself)
         except Exception:
             return
         # One lock for seq+append: a concurrent tail() must never see a
@@ -311,6 +312,7 @@ class Agent:
                     servers = RetryPolicy(
                         max_attempts=None, deadline=60.0,
                         backoff=Backoff(base=0.5, cap=5.0)).call(discover)
+                # lint: allow(swallow, exhausted discovery surfaces as the ValueError below)
                 except Exception:
                     servers = []
             if not servers:

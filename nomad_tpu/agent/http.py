@@ -213,6 +213,7 @@ def _capture_profile(seconds: float, period: float = 0.005) -> bytes:
         n_samples += 1
         if now >= deadline:
             break
+        # lint: allow(retry, fixed-cadence sampling profiler, not a retry)
         time.sleep(period)
     stats[("~", 0, f"<sampling-profile {n_samples} samples "
            f"@{period * 1e3:g}ms>")] = [n_samples, n_samples, 0.0, 0.0, {}]

@@ -1,0 +1,53 @@
+"""Zero-runtime-cost concurrency annotations.
+
+These exist for the static checkers (and the human reader): the
+``guarded_by`` class-attribute registry declares which mutable fields a
+lock protects, and ``requires_lock`` marks a method whose CALLER must
+hold the lock (the ``mu must be held`` doc-comment convention of the
+reference Go codebase, made machine-checkable). Neither does anything at
+runtime — the lint pass reads them syntactically.
+
+Usage::
+
+    class EvalBroker:
+        _concurrency = guarded_by(
+            "_lock", "_enabled", "_evals", "_unack")
+
+        @requires_lock("_lock")
+        def _enqueue_locked(self, ev): ...
+
+Methods whose name ends in ``_locked`` are treated by the checker as if
+decorated with ``requires_lock`` for every lock the class registers.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Tuple
+
+
+class GuardedBy:
+    """Declaration that ``fields`` may only be read/written while holding
+    ``self.<lock>`` (checked statically; carries no runtime behavior)."""
+
+    __slots__ = ("lock", "fields")
+
+    def __init__(self, lock: str, fields: Tuple[str, ...]):
+        self.lock = lock
+        self.fields = fields
+
+    def __repr__(self) -> str:
+        return f"guarded_by({self.lock!r}, fields={self.fields!r})"
+
+
+def guarded_by(lock: str, *fields: str) -> GuardedBy:
+    return GuardedBy(lock, tuple(fields))
+
+
+def requires_lock(*locks: str) -> Callable:
+    """Decorator marking a method that must be entered with ``self.<lock>``
+    already held. Identity at runtime."""
+
+    def deco(fn: Callable) -> Callable:
+        return fn
+
+    return deco

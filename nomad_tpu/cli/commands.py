@@ -201,6 +201,19 @@ def main(argv) -> int:
     p.add_argument("-servers", action="store_true",
                    help="print the known server addresses")
 
+    p = sub.add_parser("lint",
+                       help="static concurrency/telemetry lint "
+                            "(the `go vet` analogue)")
+    p.add_argument("paths", nargs="*",
+                   help="files/dirs to lint (default: the installed "
+                        "nomad_tpu tree)")
+    p.add_argument("-json", action="store_true", dest="as_json",
+                   help="machine-readable JSON output")
+    p.add_argument("-checker", action="append", default=None,
+                   help="run only this checker id (repeatable)")
+    p.add_argument("-show-suppressed", action="store_true",
+                   help="include suppressed findings in the output")
+
     args = parser.parse_args(argv)
     if args.command is None:
         parser.print_help()
@@ -342,6 +355,7 @@ def cmd_agent(args) -> int:
     _signal.signal(_signal.SIGUSR1, dump_telemetry)
     try:
         while True:
+            # lint: allow(retry, foreground agent idles until SIGINT)
             time.sleep(1)
     except KeyboardInterrupt:
         print("==> shutting down")
@@ -391,6 +405,7 @@ def _monitor_eval(client: Client, eval_id: str) -> int:
             grace = time.time() + 10
         except APIError:
             if time.time() < grace:
+                # lint: allow(retry, human-paced CLI poll of a remote eval)
                 time.sleep(0.25)
                 continue
             raise
@@ -410,6 +425,7 @@ def _monitor_eval(client: Client, eval_id: str) -> int:
                     print(f'    Blocked evaluation {ev["BlockedEval"][:8]} '
                           "waiting for capacity")
             return 0 if seen_status == "complete" else 1
+        # lint: allow(retry, human-paced CLI poll of a remote eval)
         time.sleep(0.25)
     print("    Timed out waiting for evaluation")
     return 1
@@ -987,3 +1003,33 @@ def cmd_services(args) -> int:
         print(f"{r['ServiceName']:<24} {r['Status']:<10} {addr:<22} "
               f"{r['NodeID'][:8]:<10} {r.get('TaskName') or '-'}")
     return 0
+
+
+def cmd_lint(args) -> int:
+    """Run the static analysis pass (reference intent: the `go vet` /
+    race-detector discipline the Go codebase gets for free). Exit 0 on a
+    clean tree, 1 when any unsuppressed finding survives."""
+    from nomad_tpu.analysis import all_checkers, run_checks
+
+    try:
+        findings = run_checks(paths=args.paths or None,
+                              checker_ids=args.checker,
+                              include_suppressed=args.show_suppressed)
+    except ValueError as e:
+        print(f"Error: {e}", file=sys.stderr)
+        print("known checkers: "
+              + ", ".join(c.id for c in all_checkers()), file=sys.stderr)
+        return 2
+    live = [f for f in findings if not f.suppressed]
+    if args.as_json:
+        print(json.dumps({"findings": [f.to_dict() for f in findings],
+                          "total": len(live)}, indent=2))
+    else:
+        import os as _os
+
+        for f in findings:
+            print(f.render(relative_to=_os.getcwd()))
+        print(f"{len(live)} finding(s)"
+              + (f" ({len(findings) - len(live)} suppressed)"
+                 if len(findings) != len(live) else ""))
+    return 1 if live else 0

@@ -187,7 +187,9 @@ class AllocRunner:
             try:
                 docker_samples = type(docker_handles[0]).stats_many(
                     docker_handles)
-            except Exception:
+            except Exception as exc:
+                logger.debug("alloc %s: docker stats sweep failed: %s",
+                             self.alloc.ID, exc)
                 docker_samples = {}
         tasks = {}
         agg_rss = 0
@@ -204,7 +206,9 @@ class AllocRunner:
                     sample = handle.stats()
                 usage = self._stats_tracker.usage(
                     f"{self.alloc.ID}/{name}", sample)
-            except Exception:
+            except Exception as exc:
+                logger.debug("alloc %s: stats for task %s failed: %s",
+                             self.alloc.ID, name, exc)
                 usage = None
             if usage is None:
                 continue

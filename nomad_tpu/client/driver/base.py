@@ -15,6 +15,7 @@ from typing import Any, Dict, List, Optional, Tuple
 
 from nomad_tpu.client.allocdir import AllocDir
 from nomad_tpu.client.env import TaskEnv
+from nomad_tpu.resilience.retry import Backoff, RetryPolicy
 from nomad_tpu.structs import Allocation, Node, Task
 
 
@@ -268,7 +269,8 @@ class ExecutorHandle(DriverHandle):
         self.executor_pid = executor_pid
         self._result: Optional[WaitResult] = None
         self._done = threading.Event()
-        self._watcher = threading.Thread(target=self._watch, daemon=True)
+        self._watcher = threading.Thread(target=self._watch, daemon=True,
+                                         name=f"driver-watch-{task_name}")
         self._watcher.start()
 
     # ------------------------------------------------------------- protocol
@@ -345,13 +347,15 @@ class ExecutorHandle(DriverHandle):
                 return
             if not _pid_alive(self.executor_pid):
                 # Executor died without writing status.
-                time.sleep(0.2)  # allow a just-written file to land
+                # lint: allow(retry, grace for a just-written exit file)
+                time.sleep(0.2)
                 if not os.path.exists(self._exit_path()):
                     self._result = WaitResult(
                         error="executor terminated unexpectedly")
                     self._done.set()
                     return
                 continue
+            # lint: allow(retry, exit-file poll is this supervisor's job)
             time.sleep(0.1)
 
     def wait(self, timeout: Optional[float] = None) -> Optional[WaitResult]:
@@ -482,17 +486,29 @@ def launch_executor(state_dir: str, task_name: str, spec: Dict[str, Any]
                      [p for p in [os.environ.get("PYTHONPATH"),
                                   _repo_root()] if p])),
     )
-    # Wait for the executor to write its state file.
+    # Wait for the executor to write its state file: RetryPolicy paces the
+    # poll (20-100ms jittered) under a 10s deadline; an early executor
+    # death is terminal and surfaces immediately.
     state_path = os.path.join(state_dir, f"{task_name}.executor_state.json")
-    deadline = time.time() + 10
-    while time.time() < deadline:
+
+    class _NotYet(Exception):
+        pass
+
+    def check() -> None:
         if os.path.exists(state_path):
-            break
+            return
         if proc.poll() is not None:
             raise RuntimeError(
                 f"executor exited immediately with code {proc.returncode}")
-        time.sleep(0.02)
-    else:
+        raise _NotYet()
+
+    policy = RetryPolicy(max_attempts=None, deadline=10.0,
+                         backoff=Backoff(base=0.02, cap=0.1),
+                         retry_on=(_NotYet,),
+                         trace_events=False)  # ms-cadence poll
+    try:
+        policy.call(check)
+    except _NotYet:
         raise RuntimeError("executor failed to start in time")
     return ExecutorHandle(state_dir, task_name, proc.pid)
 

@@ -6,6 +6,7 @@ operations without a vendored daemon client).
 from __future__ import annotations
 
 import json
+import logging
 import os
 import shutil
 import subprocess
@@ -17,6 +18,8 @@ from nomad_tpu.structs import Node, Task
 
 from .base import (ConfigField, ConfigSchema, Driver, DriverHandle,
                    ExecContext, WaitResult, config_map)
+
+logger = logging.getLogger("nomad.driver.docker")
 
 
 def docker_conn_env(config) -> dict:
@@ -63,7 +66,8 @@ class DockerHandle(DriverHandle):
         self._result: Optional[WaitResult] = None
         self._done = threading.Event()
         self._log_proc: Optional[subprocess.Popen] = None
-        self._watcher = threading.Thread(target=self._watch, daemon=True)
+        self._watcher = threading.Thread(target=self._watch, daemon=True,
+                                         name=f"docker-watch-{task_name}")
         self._watcher.start()
         if log_dir and task_name:
             self._start_log_pump()
@@ -177,10 +181,11 @@ class DockerHandle(DriverHandle):
                     return
 
         threading.Thread(target=pump, args=(self._log_proc.stdout, stdout),
-                         daemon=True).start()
+                         daemon=True, name="docker-log-stdout").start()
         threading.Thread(target=pump, args=(self._log_proc.stderr, stderr),
-                         daemon=True).start()
-        threading.Thread(target=checkpoint, daemon=True).start()
+                         daemon=True, name="docker-log-stderr").start()
+        threading.Thread(target=checkpoint, daemon=True,
+                         name="docker-log-checkpoint").start()
 
     def _watch(self) -> None:
         try:
@@ -189,6 +194,7 @@ class DockerHandle(DriverHandle):
                                  env=self.docker_env)
             code = int(out.stdout.strip() or 0)
             self._result = WaitResult(exit_code=code)
+        # lint: allow(swallow, error is delivered to the waiter in the WaitResult)
         except Exception as e:
             self._result = WaitResult(error=str(e))
         self._done.set()
@@ -251,7 +257,8 @@ class DockerHandle(DriverHandle):
                  "{{.ID}} {{.CPUPerc}} {{.MemUsage}}"] + ids,
                 capture_output=True, text=True, timeout=15,
                 env=live[0].docker_env)
-        except Exception:
+        except Exception as exc:
+            logger.debug("docker stats batch failed: %s", exc)
             return {}
         if out.returncode != 0:
             return {}
@@ -305,6 +312,7 @@ class DockerDriver(Driver):
             node.Attributes["driver.docker"] = "1"
             node.Attributes["driver.docker.version"] = out.stdout.strip()
             return True
+        # lint: allow(swallow, probe failure means the docker runtime is absent)
         except Exception:
             return False
 

@@ -26,6 +26,7 @@ class JavaDriver(Driver):
                                  text=True, timeout=10)
             version_line = (out.stderr or out.stdout).splitlines()[0]
             version = version_line.split('"')[1] if '"' in version_line else ""
+        # lint: allow(swallow, probe failure means the java runtime is absent)
         except Exception:
             return False
         node.Attributes["driver.java"] = "1"

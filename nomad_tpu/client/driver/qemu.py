@@ -26,6 +26,7 @@ class QemuDriver(Driver):
             out = subprocess.run([qemu, "--version"], capture_output=True,
                                  text=True, timeout=10)
             version = out.stdout.split("version")[-1].split()[0] if out.stdout else ""
+        # lint: allow(swallow, probe failure means the qemu runtime is absent)
         except Exception:
             return False
         node.Attributes["driver.qemu"] = "1"

@@ -82,8 +82,10 @@ def run_executor(spec_path: str) -> int:
             rotator.write(chunk)
         rotator.close()
 
-    t_out = threading.Thread(target=pump, args=(proc.stdout, stdout), daemon=True)
-    t_err = threading.Thread(target=pump, args=(proc.stderr, stderr), daemon=True)
+    t_out = threading.Thread(target=pump, args=(proc.stdout, stdout),
+                             daemon=True, name="executor-pump-stdout")
+    t_err = threading.Thread(target=pump, args=(proc.stderr, stderr),
+                             daemon=True, name="executor-pump-stderr")
     t_out.start()
     t_err.start()
 

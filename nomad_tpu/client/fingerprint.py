@@ -188,11 +188,13 @@ def _env_metadata_fingerprint(node: Node, config, *, option_key: str,
     try:
         _metadata_get(base + probe, timeout=2.0 if explicit else 0.3,
                       headers=headers)
+    # lint: allow(swallow, metadata probes fail normally off-platform)
     except Exception:
         return False  # not on this platform
     for key, unique in keys:
         try:
             value = value_of(_metadata_get(base + key, headers=headers))
+        # lint: allow(swallow, a missing metadata key is a normal partial set)
         except Exception:
             continue
         prefix = (f"unique.platform.{platform_name}." if unique
@@ -228,6 +230,7 @@ def _env_aws(node: Node, config) -> bool:
         with urllib.request.urlopen(req, timeout=0.3) as resp:
             headers = {"X-aws-ec2-metadata-token":
                        resp.read().decode().strip()}
+    # lint: allow(swallow, IMDSv1 fallback when the token endpoint is absent)
     except Exception:
         pass
     return _env_metadata_fingerprint(
@@ -286,6 +289,7 @@ def fingerprint_node(node: Node, config=None) -> Dict[str, bool]:
         name = fp.__name__.lstrip("_")
         try:
             results[name] = bool(fp(node, config))
+        # lint: allow(swallow, a crashed fingerprinter records as not-detected)
         except Exception:
             results[name] = False
     return results
@@ -301,6 +305,7 @@ def run_periodic_fingerprints(node: Node, config=None) -> bool:
         if fp.__name__.lstrip("_") in PERIODIC_FINGERPRINTERS:
             try:
                 fp(node, config)
+            # lint: allow(swallow, a crashed fingerprinter keeps old attrs)
             except Exception:
                 pass
     for key in set(before) | set(node.Attributes):

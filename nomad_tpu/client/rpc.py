@@ -9,6 +9,7 @@ TCP) plugs in behind the same interface.
 
 from __future__ import annotations
 
+import logging
 import threading
 from typing import Callable, Dict, List, Optional, Protocol, Tuple
 
@@ -16,6 +17,8 @@ from nomad_tpu.resilience.retry import Backoff, CircuitBreaker, RetryPolicy
 from nomad_tpu.state.watch import Item
 from nomad_tpu.telemetry import trace
 from nomad_tpu.structs import Allocation, Node, from_dict, to_dict
+
+logger = logging.getLogger("nomad.client.rpc")
 
 
 class ServerChannel(Protocol):
@@ -254,12 +257,14 @@ class NetServerChannel:
         self._stop_rebalance.set()
         try:
             self.pool.close()
+        # lint: allow(swallow, best-effort socket close on teardown)
         except Exception:
             pass
 
     def _ping(self, addr: str) -> bool:
         try:
             return bool(self.pool.call(addr, "Status.Ping", {}, timeout=3.0))
+        # lint: allow(swallow, a failed ping IS the False result)
         except Exception:
             return False
 
@@ -267,8 +272,8 @@ class NetServerChannel:
         while not self._stop_rebalance.wait(interval):
             try:
                 self.proxy.rebalance(self._ping)
-            except Exception:
-                pass
+            except Exception as exc:
+                logger.debug("server rebalance pass failed: %s", exc)
 
     def _call(self, method: str, body: dict, timeout: Optional[float] = None):
         # Child-only span: a traced client operation (e.g. the service
@@ -301,6 +306,7 @@ class NetServerChannel:
                     if exc.remote_type == "NotLeaderError":
                         raise  # election window: policy backs off + retries
                     raise _TerminalRemoteError(exc)  # failover won't help
+                # lint: allow(swallow, failure marks the server and fails over)
                 except Exception as exc:  # transport: try the next server
                     last_exc = exc
                     self.proxy.notify_failed(addr)

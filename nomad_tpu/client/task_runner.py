@@ -183,6 +183,7 @@ class TaskRunner:
             for artifact in self.task.Artifacts:
                 try:
                     get_artifact(artifact, task_dir, self.exec_ctx.task_env)
+                # lint: allow(swallow, error is recorded on the task event)
                 except Exception as e:
                     event = TaskEvent.new(TaskArtifactDownloadFailed)
                     event.DownloadError = str(e)
@@ -196,6 +197,7 @@ class TaskRunner:
                 driver = new_driver(self.task.Driver, self._driver_ctx())
                 self.handle = driver.start(self.exec_ctx, self.task)
                 self.handle_id = self.handle.id()
+            # lint: allow(swallow, error is recorded on the task event)
             except Exception as e:
                 event = TaskEvent.new(TaskDriverFailure)
                 event.DriverError = str(e)

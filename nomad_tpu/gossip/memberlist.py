@@ -38,6 +38,7 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import msgpack
 
+from nomad_tpu.analysis import guarded_by
 from nomad_tpu.resilience import failpoints
 from nomad_tpu.rpc.wire import recv_frame, send_frame
 
@@ -104,6 +105,10 @@ class Member:
 class Memberlist:
     """One gossip participant. Thread-safe; all background work runs on
     daemon threads started by `start()`."""
+
+    _concurrency = guarded_by(
+        "_lock", "_members", "_incarnation", "_probe_ring", "_probe_pos",
+        "_seq", "_broadcasts", "_left")
 
     def __init__(self, name: str, bind_addr: str = "127.0.0.1",
                  port: int = 0, tags: Optional[Dict[str, str]] = None,
@@ -198,9 +203,9 @@ class Memberlist:
         # push the leave out directly too — don't rely on gossip ticks
         for m in self._random_members(self.config.gossip_fanout * 2):
             self._send_udp((m.addr, m.port), [msg])
-        deadline = time.monotonic() + 4 * self.config.gossip_interval
-        while time.monotonic() < deadline:
-            time.sleep(self.config.gossip_interval)
+        # Give the gossip ticks a window to spread the leave; a concurrent
+        # shutdown() cuts the grace period short instead of blocking it.
+        self._shutdown.wait(4 * self.config.gossip_interval)
         self.shutdown()
 
     def force_leave(self, name: str) -> bool:
@@ -282,6 +287,8 @@ class Memberlist:
             try:
                 msgs = msgpack.unpackb(raw, raw=False)
             except Exception:
+                LOG.debug("%s: undecodable datagram from %s dropped",
+                          self.name, src)
                 continue
             for msg in msgs:
                 try:
@@ -319,7 +326,8 @@ class Memberlist:
         def run() -> None:
             if self._ping(target, taddr):
                 self._send_udp(reply_to, [(_ACK, orig_seq)])
-        threading.Thread(target=run, daemon=True).start()
+        threading.Thread(target=run, daemon=True,
+                         name=f"gossip-relay-{self.name}").start()
 
     def _ping(self, target: str, dest: Tuple[str, int]) -> bool:
         if failpoints.fire("gossip.probe") == "drop":
@@ -586,7 +594,8 @@ class Memberlist:
             except OSError:
                 return
             threading.Thread(target=self._handle_tcp, args=(conn,),
-                             daemon=True).start()
+                             daemon=True,
+                             name=f"gossip-tcp-conn-{self.name}").start()
 
     def _handle_tcp(self, conn: socket.socket) -> None:
         try:

@@ -22,6 +22,7 @@ from typing import Any, Dict, List, Optional, Tuple
 
 import msgpack
 
+from nomad_tpu.analysis import guarded_by
 from nomad_tpu.resilience import failpoints
 
 LOG = logging.getLogger("nomad.raft.log")
@@ -60,6 +61,9 @@ class LogEntry:
 class InMemLogStore:
     """Log + stable store kept in memory (reference: raft.NewInmemStore used
     by DevMode, nomad/server.go:612-616)."""
+
+    _concurrency = guarded_by("_lock", "_entries", "_first", "_last",
+                              "_stable", "_snapshot")
 
     def __init__(self):
         self._lock = threading.Lock()
@@ -146,6 +150,10 @@ class FileLogStore(InMemLogStore):
     def __init__(self, directory: str):
         super().__init__()
         self.dir = directory
+        # Serializes stable-kv persists end-to-end (snapshot + tmp write +
+        # replace). Distinct from _lock so the in-memory store stays
+        # readable during the fsync.
+        self._stable_io_lock = threading.Lock()
         os.makedirs(directory, exist_ok=True)
         self._log_path = os.path.join(directory, "raft.log")
         self._stable_path = os.path.join(directory, "stable.mp")
@@ -254,12 +262,21 @@ class FileLogStore(InMemLogStore):
 
     def set_stable(self, key: str, value: Any) -> None:
         super().set_stable(key, value)
-        tmp = self._stable_path + ".tmp"
-        with open(tmp, "wb") as fh:
-            fh.write(msgpack.packb(self._stable, use_bin_type=True))
-            fh.flush()
-            os.fsync(fh.fileno())
-        os.replace(tmp, self._stable_path)
+        # One persist at a time: the snapshot is taken under _lock (packb
+        # over the live dict racing a concurrent writer would raise or
+        # write a torn kv file), and the tmp-write + replace run under the
+        # io lock so two writers can't interleave in the shared tmp file.
+        # Whoever snapshots last snapshots AFTER both in-memory updates,
+        # so the final on-disk state contains every key.
+        with self._stable_io_lock:
+            with self._lock:
+                blob = msgpack.packb(self._stable, use_bin_type=True)
+            tmp = self._stable_path + ".tmp"
+            with open(tmp, "wb") as fh:
+                fh.write(blob)
+                fh.flush()
+                os.fsync(fh.fileno())
+            os.replace(tmp, self._stable_path)
 
     def store_snapshot(self, index: int, term: int, data: bytes) -> None:
         super().store_snapshot(index, term, data)

@@ -23,6 +23,7 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import msgpack
 
+from nomad_tpu.analysis import guarded_by, requires_lock
 from nomad_tpu.resilience import failpoints
 
 from .log import EntryType, LogEntry
@@ -67,6 +68,12 @@ class _Future:
 
 
 class RaftNode:
+    _concurrency = guarded_by(
+        "_lock", "_role", "_term", "_voted_for", "_leader_id", "_peers",
+        "_commit_index", "_last_applied", "_snap_index", "_snap_term",
+        "_applied_since_snap", "_next_index", "_match_index", "_futures",
+        "_election_deadline", "_shutdown", "_electable", "_repl_conds")
+
     def __init__(self, node_id: str, peers: List[str], log_store,
                  transport,
                  apply_fn: Callable[[int, int, bytes], Any],
@@ -130,6 +137,9 @@ class RaftNode:
         self._futures: Dict[int, _Future] = {}
 
         self._election_deadline = 0.0
+        # Event mirror of _shutdown for shutdown-aware sleeps: loops that
+        # pace with a wait() must wake the instant shutdown() is called.
+        self._stop_event = threading.Event()
         self._leader_events: "queue.Queue[Optional[bool]]" = queue.Queue()
         self._fsm_lock = threading.Lock()  # serializes apply_fn vs restore_fn
         self._apply_cond = threading.Condition(self._lock)
@@ -170,6 +180,7 @@ class RaftNode:
                     LOG.exception("leader-change callback failed")
 
     def shutdown(self) -> None:
+        self._stop_event.set()
         with self._lock:
             self._shutdown = True
             was_leader = self._role == LEADER
@@ -196,6 +207,7 @@ class RaftNode:
                 t.join(timeout=max(0.1, deadline - time.monotonic()))
         self._threads = []
 
+    @requires_lock("_lock")
     def _restore_from_disk(self) -> None:
         snap = self.log.latest_snapshot()
         if snap is not None:
@@ -237,7 +249,8 @@ class RaftNode:
 
     @property
     def last_index(self) -> int:
-        return max(self.log.last_index(), self._snap_index)
+        with self._lock:  # RLock: cheap re-entry from locked callers
+            return max(self.log.last_index(), self._snap_index)
 
     @property
     def applied_index(self) -> int:
@@ -271,6 +284,7 @@ class RaftNode:
             }
 
     # -------------------------------------------------------------- helpers
+    @requires_lock("_lock")
     def _last_log_info(self) -> Tuple[int, int]:
         last = self.log.last_index()
         if last == 0:
@@ -278,6 +292,7 @@ class RaftNode:
         e = self.log.get_entry(last)
         return last, e.Term if e else self._snap_term
 
+    @requires_lock("_lock")
     def _term_at(self, index: int) -> Optional[int]:
         if index == 0:
             return 0
@@ -286,6 +301,7 @@ class RaftNode:
         e = self.log.get_entry(index)
         return e.Term if e else None
 
+    @requires_lock("_lock")
     def _reset_election_timer(self) -> None:
         spread = (self.config.election_timeout_max
                   - self.config.election_timeout_min)
@@ -293,10 +309,12 @@ class RaftNode:
                                    + self.config.election_timeout_min
                                    + random.random() * spread)
 
+    @requires_lock("_lock")
     def _save_term_vote(self) -> None:
         self.log.set_stable("term", self._term)
         self.log.set_stable("voted_for", self._voted_for)
 
+    @requires_lock("_lock")
     def _step_down(self, term: int, leader: Optional[str] = None) -> None:
         """Caller holds the lock."""
         was_leader = self._role == LEADER
@@ -355,11 +373,13 @@ class RaftNode:
                     return
                 role = self._role
                 deadline = self._election_deadline
+                electable = self._electable
             now = time.monotonic()
             if (role in (FOLLOWER, CANDIDATE) and now >= deadline
-                    and self._electable):
+                    and electable):
                 self._run_election()
-            time.sleep(0.01)
+            if self._stop_event.wait(0.01):  # shutdown-aware pacing
+                return
 
     # ------------------------------------------------------------- election
     def _run_election(self) -> None:
@@ -403,7 +423,8 @@ class RaftNode:
                     if votes[0] >= votes_needed:
                         done.set()
 
-        threads = [threading.Thread(target=ask, args=(p,), daemon=True)
+        threads = [threading.Thread(target=ask, args=(p,), daemon=True,
+                                    name=f"raft-vote-{self.id}-{p}")
                    for p in peers]
         for t in threads:
             t.start()
@@ -416,6 +437,7 @@ class RaftNode:
             if won and self._role == CANDIDATE and self._term == term:
                 self._become_leader()
 
+    @requires_lock("_lock")
     def _become_leader(self) -> None:
         """Caller holds the lock."""
         LOG.info("%s became leader term=%d", self.id, self._term)
@@ -441,6 +463,7 @@ class RaftNode:
         self._leader_events.put(True)
 
     # ---------------------------------------------------------- replication
+    @requires_lock("_lock")
     def _start_replicator(self, peer: str) -> None:
         cond = self._repl_conds.get(peer)
         if cond is None:
@@ -452,7 +475,8 @@ class RaftNode:
         self._threads.append(t)
 
     def _replicate_loop(self, peer: str) -> None:
-        cond = self._repl_conds[peer]
+        with self._lock:
+            cond = self._repl_conds[peer]
         term_started = self.term
         while True:
             with self._lock:
@@ -549,6 +573,7 @@ class RaftNode:
                 else:
                     self._next_index[peer] = max(1, next_idx - 1)
 
+    @requires_lock("_lock")
     def _leader_advance_commit(self) -> None:
         """Caller holds the lock. Advance commit to the majority match index,
         but only over entries from the current term (Raft §5.4.2)."""
@@ -661,7 +686,8 @@ class RaftNode:
 
     @property
     def electable(self) -> bool:
-        return self._electable
+        with self._lock:
+            return self._electable
 
     def _config_change(self, mutate: Callable[[List[str]],
                                               Optional[List[str]]],
@@ -894,4 +920,5 @@ class RaftNode:
         with self._lock:
             self._applied_since_snap = self.config.snapshot_threshold
         self._maybe_snapshot()
-        return self._snap_index
+        with self._lock:
+            return self._snap_index

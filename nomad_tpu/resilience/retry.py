@@ -75,7 +75,8 @@ class RetryPolicy:
                  on_retry: Optional[
                      Callable[[BaseException, int, float], None]] = None,
                  sleep: Callable[[float], Any] = time.sleep,
-                 clock: Callable[[], float] = time.monotonic):
+                 clock: Callable[[], float] = time.monotonic,
+                 trace_events: bool = True):
         if max_attempts is None and deadline is None:
             raise ValueError("need max_attempts or deadline (or both)")
         self.max_attempts = max_attempts
@@ -86,6 +87,10 @@ class RetryPolicy:
         self.on_retry = on_retry
         self.sleep = sleep
         self.clock = clock
+        # High-frequency POLL-style policies (ms-cadence waits under a
+        # deadline) must opt out: one lagging wait would otherwise append
+        # hundreds of "retry" events to the active span.
+        self.trace_events = trace_events
 
     def call(self, fn: Callable[..., Any], *args: Any, **kwargs: Any) -> Any:
         """Run ``fn`` until it returns, the retry budget runs out (the
@@ -115,11 +120,12 @@ class RetryPolicy:
                 # Resilience <-> tracing: every retry of a traced
                 # operation lands on its active span (one truthiness
                 # check when tracing is disarmed).
-                from nomad_tpu.telemetry import trace as _trace
+                if self.trace_events:
+                    from nomad_tpu.telemetry import trace as _trace
 
-                _trace.add_event("retry", attempt=attempt,
-                                 error=type(exc).__name__,
-                                 delay=round(delay, 4))
+                    _trace.add_event("retry", attempt=attempt,
+                                     error=type(exc).__name__,
+                                     delay=round(delay, 4))
                 if self.on_retry is not None:
                     self.on_retry(exc, attempt, delay)
                 if self.sleep(delay):

@@ -62,7 +62,8 @@ class _Conn:
         self._waiters: Dict[int, "queue_like"] = {}
         self._waiter_lock = threading.Lock()
         self._dead = False
-        self._reader = threading.Thread(target=self._read_loop, daemon=True)
+        self._reader = threading.Thread(target=self._read_loop, daemon=True,
+                                        name=f"rpc-pool-read-{addr}")
         self._reader.start()
 
     def _read_loop(self) -> None:
@@ -104,6 +105,7 @@ class _Conn:
             self._waiters[seq] = waiter
         try:
             with self._send_lock:
+                # lint: allow(lock_blocking, lock exists to serialize socket writes)
                 send_frame(self.sock, MessageCodec.request(
                     seq, method, body, trace=trace.inject()))
         except OSError as exc:

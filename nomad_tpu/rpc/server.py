@@ -88,7 +88,7 @@ class RPCServer:
             with self._lock:
                 self._conns.add(conn)
             threading.Thread(target=self._handle_conn, args=(conn,),
-                             daemon=True).start()
+                             daemon=True, name="rpc-conn").start()
 
     def _handle_conn(self, conn: socket.socket) -> None:
         """(reference: handleConn byte-prefix dispatch, rpc.go:88-132)"""
@@ -158,7 +158,8 @@ class RPCServer:
             # head-of-line block the stream (reference: rpc.go:294-349).
             threading.Thread(
                 target=self._dispatch,
-                args=(conn, send_lock, handler, frame), daemon=True).start()
+                args=(conn, send_lock, handler, frame), daemon=True,
+                name=f"rpc-dispatch-{frame.get('Method', '?')}").start()
 
     def _dispatch(self, conn: socket.socket, send_lock: threading.Lock,
                   handler: Handler, frame: Dict[str, Any]) -> None:
@@ -181,10 +182,12 @@ class RPCServer:
             except OSError:
                 pass
             return
+        # lint: allow(swallow, error crosses the wire as the RPC response)
         except Exception as exc:  # errors cross the wire as strings
             resp = MessageCodec.response(seq, error=_err_string(exc))
         try:
             with send_lock:
+                # lint: allow(lock_blocking, lock exists to serialize socket writes)
                 send_frame(conn, resp)
         except OSError:
             pass

@@ -16,6 +16,7 @@ import time
 from dataclasses import dataclass
 from typing import Dict, List, Optional, Tuple
 
+from nomad_tpu.analysis import guarded_by, requires_lock
 from nomad_tpu.resilience import failpoints
 from nomad_tpu.structs import Evaluation
 from nomad_tpu.structs.structs import EvalTriggerMaxPlans
@@ -36,6 +37,10 @@ class BlockedStats:
 
 
 class BlockedEvals:
+    _concurrency = guarded_by(
+        "_lock", "_enabled", "_captured", "_escaped", "_jobs",
+        "_unblock_indexes", "_duplicates", "stats")
+
     def __init__(self, eval_broker: EvalBroker):
         self.eval_broker = eval_broker
         self._enabled = False
@@ -65,7 +70,8 @@ class BlockedEvals:
             if enabled:
                 self._stop = threading.Event()
                 self._watcher = threading.Thread(target=self._watch_capacity,
-                                                 daemon=True)
+                                                 daemon=True,
+                                                 name="blocked-evals-watch")
                 self._watcher.start()
             else:
                 self._stop.set()
@@ -111,6 +117,7 @@ class BlockedEvals:
             else:
                 self._captured[ev.ID] = wrapped
 
+    @requires_lock("_lock")
     def _missed_unblock(self, ev: Evaluation) -> bool:
         """(reference: blocked_evals.go:208-245)"""
         max_index = 0

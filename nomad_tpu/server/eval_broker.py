@@ -17,6 +17,7 @@ import threading
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
 
+from nomad_tpu.analysis import guarded_by, requires_lock
 from nomad_tpu.structs import Evaluation, generate_uuid
 from nomad_tpu.telemetry import trace
 from nomad_tpu.timerwheel import TimerHandle, wheel
@@ -75,6 +76,10 @@ class BrokerStats:
 
 
 class EvalBroker:
+    _concurrency = guarded_by(
+        "_lock", "_enabled", "_evals", "_job_evals", "_blocked", "_ready",
+        "_unack", "_requeue", "_time_wait", "stats")
+
     def __init__(self, nack_timeout: float = 60.0, delivery_limit: int = 3):
         if nack_timeout < 0:
             raise ValueError("timeout cannot be negative")
@@ -132,6 +137,7 @@ class EvalBroker:
             for ev, token in evals.values():
                 self._process_enqueue(ev, token)
 
+    @requires_lock("_lock")
     def _process_enqueue(self, ev: Evaluation, token: str) -> None:
         # Tracing: remember the enqueuing context (one dict write when a
         # trace is active, one truthiness check otherwise) so the worker
@@ -204,6 +210,7 @@ class EvalBroker:
                     if remaining <= 0 or not self._cond.wait(remaining):
                         return None, ""
 
+    @requires_lock("_lock")
     def _scan(self, schedulers: List[str]
               ) -> Optional[Tuple[Evaluation, str]]:
         eligible: List[str] = []
@@ -224,6 +231,7 @@ class EvalBroker:
             return None
         return self._dequeue_for_sched(random.choice(eligible))
 
+    @requires_lock("_lock")
     def _dequeue_for_sched(self, sched: str) -> Tuple[Evaluation, str]:
         ev = self._ready[sched].pop()
         entry = trace.linked_entry("eval", ev.ID)

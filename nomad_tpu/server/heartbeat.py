@@ -12,6 +12,7 @@ import random
 import threading
 from typing import Callable, Dict
 
+from nomad_tpu.analysis import guarded_by
 from nomad_tpu.timerwheel import DaemonPool, TimerHandle, wheel
 
 logger = logging.getLogger("nomad.heartbeat")
@@ -27,6 +28,8 @@ def _expiry_pool() -> DaemonPool:
 
 
 class HeartbeatTimers:
+    _concurrency = guarded_by("_lock", "_timers")
+
     def __init__(self, min_ttl: float = 10.0, grace: float = 10.0,
                  max_per_second: float = 50.0,
                  on_expire: Callable[[str], None] = lambda node_id: None):

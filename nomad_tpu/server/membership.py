@@ -148,8 +148,9 @@ class ServerMembership:
                 try:
                     if self.join(seeds) > 0:
                         return
-                except Exception:
-                    pass
+                except Exception as exc:
+                    LOG.debug("%s: join attempt %d raised: %s",
+                              self.gossip_name, attempt, exc)
                 if max_attempts and attempt >= max_attempts:
                     break
                 # Log the first few and then once a minute: a seed that is

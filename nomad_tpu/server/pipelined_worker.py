@@ -285,7 +285,7 @@ class PipelinedWorker(Worker):
         try:
             while not self._stop.is_set():
                 if self._paused.is_set():
-                    time.sleep(0.05)
+                    self._stop.wait(0.05)  # shutdown-aware pause spin
                     continue
                 batch = self._dequeue_window()
                 if not batch:
@@ -327,8 +327,10 @@ class PipelinedWorker(Worker):
                 logger.debug("eval %s redelivered between stages (%s)",
                              rec.ev.ID, e)
                 rec.stale = True
-            except Exception:
-                return  # broker teardown: downstream handling owns it
+            except Exception as exc:
+                # Broker teardown: downstream handling owns it.
+                logger.debug("outstanding-reset sweep aborted: %s", exc)
+                return
 
     def _drain_loop(self) -> None:
         """Stage 2: block on each window's device readback (a full network
@@ -409,8 +411,9 @@ class PipelinedWorker(Worker):
                     # lock; a no-op when nothing is dirty.
                     try:
                         self.tindex.nt.device_arrays()
+                    # lint: allow(swallow, next dispatch retries synchronously)
                     except Exception:
-                        pass  # next dispatch retries synchronously
+                        pass
 
     def _dequeue_window(self) -> List[Tuple[Evaluation, str]]:
         got = self._dequeue_evaluation()
@@ -1019,8 +1022,9 @@ class PipelinedWorker(Worker):
                 for arr in out:
                     try:
                         arr.copy_to_host_async()
+                    # lint: allow(swallow, fetch still works without the head start)
                     except Exception:
-                        pass  # fetch still works without the head start
+                        pass
         except (ImportError, TypeError, AttributeError):
             # Non-jax device results (host-side arrays in tests): resolve
             # everything inline, no fetch needed.

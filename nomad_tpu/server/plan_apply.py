@@ -278,7 +278,14 @@ def _evaluate_node_plan(snap, plan: Plan, node_id: str) -> bool:
 
 class PlanApplier:
     """The leader's plan-apply loop with verify/apply overlap
-    (reference: planApply, plan_apply.go:41-119)."""
+    (reference: planApply, plan_apply.go:41-119).
+
+    Concurrency note (why no guarded_by registry here): the applier's
+    mutable state is confined by protocol, not by a lock. The run loop
+    owns verify-side stats keys; the single in-flight apply thread owns
+    apply-side keys (`applied`/`apply_failed`/`t_apply_ms`); the run
+    loop only reads apply-side keys after `wait.join()`, which is the
+    happens-before edge. At most one apply thread exists at a time."""
 
     def __init__(self, plan_queue: PlanQueue, raft: DevRaft,
                  eval_broker: Optional[EvalBroker] = None,
@@ -532,6 +539,7 @@ class PlanApplier:
                 with metrics.measure(("nomad", "plan", "evaluate")):
                     result = evaluate_plan(opt, plan, self._pool,
                                            nt=self._nt())
+        # lint: allow(swallow, error is delivered to the plan's waiter)
         except Exception as e:  # verification error: reject the plan
             pending.respond(None, e)
             self.stats["rejected"] += 1
@@ -577,6 +585,7 @@ class PlanApplier:
                 result.AllocIndex = index
                 self.stats["applied"] += 1
                 pending.respond(result, None)
+        # lint: allow(swallow, error is delivered to every plan's waiter)
         except Exception as e:
             self.stats["apply_failed"] += 1
             for span in spans:

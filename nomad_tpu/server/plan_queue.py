@@ -14,6 +14,7 @@ import time
 from dataclasses import dataclass, field
 from typing import List, Optional, Tuple
 
+from nomad_tpu.analysis import guarded_by
 from nomad_tpu.structs import Plan, PlanResult
 
 
@@ -49,6 +50,8 @@ class PendingPlan:
 
 
 class PlanQueue:
+    _concurrency = guarded_by("_lock", "_enabled", "_heap", "stats")
+
     def __init__(self) -> None:
         self._lock = threading.Lock()
         self._cond = threading.Condition(self._lock)

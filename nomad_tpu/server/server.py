@@ -431,7 +431,8 @@ class Server:
                 except Exception:
                     logger.exception("leader loop task failed")
 
-        t = threading.Thread(target=loop, daemon=True)
+        t = threading.Thread(target=loop, daemon=True,
+                             name=f"leader-loop-{fn.__name__}")
         t.start()
         self._reapers.append(t)
 
@@ -883,6 +884,7 @@ class Server:
         try:
             fut.index = self.raft.apply(MessageType.AllocClientUpdate,
                                         {"Alloc": batch})
+        # lint: allow(swallow, error is delivered to every batched waiter)
         except Exception as e:  # NotLeaderError et al: every waiter sees it
             fut.error = e
         finally:

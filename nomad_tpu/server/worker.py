@@ -25,6 +25,7 @@ import time
 from typing import List, Optional, Tuple
 
 from nomad_tpu.resilience import failpoints
+from nomad_tpu.resilience.retry import Backoff, RetryPolicy
 from nomad_tpu.scheduler import new_scheduler
 from nomad_tpu.scheduler.scheduler import SetStatusError
 from nomad_tpu.telemetry import metrics, trace
@@ -147,10 +148,20 @@ class RemoteBackend:
     node's leader hint; while there is no leader (election in flight) every
     operation backs off instead of erroring."""
 
-    def __init__(self, pool, raft, local_addr: str):
+    def __init__(self, pool, raft, local_addr: str,
+                 stop_event: Optional[threading.Event] = None):
         self.pool = pool
         self.raft = raft
         self.local_addr = local_addr
+        # The owning Worker shares its stop event at construction (see
+        # Worker.__init__) so backoffs below are shutdown-aware.
+        self.stop_event = stop_event
+
+    def _backoff(self, delay: float) -> None:
+        if self.stop_event is not None:
+            self.stop_event.wait(delay)
+        else:
+            time.sleep(delay)
 
     def _leader(self) -> Optional[str]:
         leader = getattr(self.raft, "leader_id", None)
@@ -165,17 +176,18 @@ class RemoteBackend:
                 ) -> Tuple[Optional[Evaluation], str, int]:
         leader = self._leader()
         if leader is None:
-            time.sleep(0.1)
+            self._backoff(0.1)
             return None, "", 0
         try:
             resp = self.pool.call(leader, "Eval.Dequeue",
                                   {"Schedulers": list(schedulers),
                                    "Timeout": timeout},
                                   timeout=timeout + 10.0)
-        except Exception:
+        except Exception as exc:
             # Leader churn / transport failure: treat as an empty dequeue;
             # the run loop retries against the next leader hint.
-            time.sleep(0.1)
+            logger.debug("remote dequeue failed (leader churn?): %s", exc)
+            self._backoff(0.1)
             return None, "", 0
         ev = resp.get("Eval")
         return ((from_dict(Evaluation, ev) if ev else None),
@@ -250,6 +262,12 @@ class Worker:
         self.scheduler_impl = "tpu"  # or "cpu-reference" (bench denominator)
         self.backend = backend or LocalBackend(raft, eval_broker, plan_queue)
         self._stop = threading.Event()
+        # Share our stop event with a backend that paces on one (the
+        # RemoteBackend's leaderless/error backoffs), so stop() wakes a
+        # worker parked in a backend-side wait instead of letting it burn
+        # the backoff out.
+        if getattr(self.backend, "stop_event", False) is None:
+            self.backend.stop_event = self._stop
         self._paused = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._token: str = ""
@@ -291,7 +309,7 @@ class Worker:
         """(reference: worker.go:101-130)"""
         while not self._stop.is_set():
             if self._paused.is_set():
-                time.sleep(0.05)
+                self._stop.wait(0.05)  # shutdown-aware pause spin
                 continue
             got = self._dequeue_evaluation()
             if got is None:
@@ -342,28 +360,37 @@ class Worker:
             if failpoints.fire("worker.dequeue") == "drop":
                 # A lost round still consumed its blocking window — an
                 # instant None would busy-spin every worker thread
-                # through the failpoint lock at full CPU.
-                time.sleep(timeout)
+                # through the failpoint lock at full CPU. Shutdown-aware:
+                # a stop() mid-window returns immediately.
+                self._stop.wait(timeout)
                 return None
             ev, token, wait_index = self.backend.dequeue(self.schedulers,
                                                          timeout)
         except (RuntimeError, failpoints.FailpointError):
-            time.sleep(BACKOFF_BASELINE)
+            self._stop.wait(BACKOFF_BASELINE)
             return None
         if ev is None:
             return None
         return ev, token, wait_index
 
     def _wait_for_index(self, index: int) -> None:
-        """Raft-sync barrier (reference: worker.go:214-244)."""
+        """Raft-sync barrier (reference: worker.go:214-244). RetryPolicy
+        paces the poll (1-10ms jittered) under the RAFT_SYNC_LIMIT
+        deadline; the shutdown-aware sleep aborts the wait the moment
+        stop() is called instead of burning out the deadline."""
         start = time.monotonic()
-        deadline = start + RAFT_SYNC_LIMIT
+
+        def check() -> None:
+            if self.raft.fsm.state.latest_index() < index:
+                raise TimeoutError(f"timed out waiting for index {index}")
+
+        policy = RetryPolicy(max_attempts=None, deadline=RAFT_SYNC_LIMIT,
+                             backoff=Backoff(base=0.001, cap=0.01),
+                             retry_on=(TimeoutError,),
+                             sleep=self._stop.wait,
+                             trace_events=False)  # ms-cadence poll
         try:
-            while self.raft.fsm.state.latest_index() < index:
-                if time.monotonic() > deadline:
-                    raise TimeoutError(
-                        f"timed out waiting for index {index}")
-                time.sleep(0.001)
+            policy.call(check)
         finally:
             metrics.measure_since(("nomad", "worker", "wait_for_index"),
                                   start)

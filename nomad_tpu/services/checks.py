@@ -49,6 +49,7 @@ def run_check(check: ServiceCheck, address: str, port: int,
         if kind == ServiceCheckScript:
             return _script_check(check, timeout, cwd, env, exec_fn)
         return CheckStatusCritical, f"unknown check type {check.Type!r}"
+    # lint: allow(swallow, failure IS the critical check result)
     except Exception as e:  # a check must never take down the manager
         return CheckStatusCritical, str(e)
 
@@ -63,6 +64,7 @@ def _http_check(check: ServiceCheck, address: str, port: int,
             code = resp.status
     except urllib.error.HTTPError as e:
         code = e.code
+    # lint: allow(swallow, failure IS the critical check result)
     except Exception as e:
         return CheckStatusCritical, f"GET {url}: {e}"
     # Consul semantics: 2xx passing, 429 warning, else critical.
@@ -89,6 +91,7 @@ def _script_check(check: ServiceCheck, timeout: float,
     if exec_fn is not None:
         try:
             result = exec_fn(check.Command, list(check.Args), timeout)
+        # lint: allow(swallow, failure IS the critical check result)
         except Exception as e:
             result = (2, f"in-task exec failed: {e}")
         if result is not None:

@@ -20,6 +20,8 @@ import time
 from contextlib import contextmanager
 from typing import Any, Dict, Iterable, List, Optional, Tuple
 
+from nomad_tpu.analysis import guarded_by, requires_lock
+
 logger = logging.getLogger("nomad.telemetry")
 
 Key = Tuple[str, ...]
@@ -62,6 +64,8 @@ class InMemSink:
     value, counters and samples aggregate per interval, a bounded ring of
     past intervals is retained)."""
 
+    _concurrency = guarded_by("_lock", "_intervals")
+
     def __init__(self, interval: float = 10.0, retain: int = 60):
         # Sub-second intervals make every sample its own interval (and 0
         # would divide by zero inside the swallow-all sink fan-out, silently
@@ -71,6 +75,7 @@ class InMemSink:
         self._lock = threading.Lock()
         self._intervals: List[Dict[str, Any]] = []
 
+    @requires_lock("_lock")
     def _current(self, now: float) -> Dict[str, Any]:
         start = now - (now % self.interval)
         cur = self._intervals[-1] if self._intervals else None
@@ -169,6 +174,8 @@ class MetricsRegistry:
     """Fan-out front for all sinks. Always carries one InMemSink so the
     agent metrics endpoint works without configuration."""
 
+    _concurrency = guarded_by("_lock", "_sinks")
+
     def __init__(self) -> None:
         self._lock = threading.Lock()
         self.inmem = InMemSink()
@@ -207,6 +214,7 @@ class MetricsRegistry:
             if close is not None:
                 try:
                     close()
+                # lint: allow(swallow, best-effort close of a replaced sink)
                 except Exception:
                     pass
 
@@ -223,8 +231,9 @@ class MetricsRegistry:
         for sink in sinks:
             try:
                 getattr(sink, op)(key, value)
+            # lint: allow(swallow, a broken sink must never break the measured path)
             except Exception:
-                pass  # a broken sink must never break the measured path
+                pass
 
     # ------------------------------------------------------------- surface
     def set_gauge(self, key: Key, value: float) -> None:

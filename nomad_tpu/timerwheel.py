@@ -22,6 +22,8 @@ import threading
 import time
 from typing import Any, Callable, List, Optional, Tuple
 
+from nomad_tpu.analysis import guarded_by, requires_lock
+
 
 class DaemonPool:
     """Minimal fixed-size daemon worker pool.
@@ -71,6 +73,8 @@ class TimerHandle:
 
 
 class TimerWheel:
+    _concurrency = guarded_by("_cond", "_heap", "_pool", "_thread")
+
     def __init__(self, pool_size: int = 4):
         self._heap: List[Tuple[float, int, TimerHandle]] = []
         self._seq = itertools.count()
@@ -79,6 +83,7 @@ class TimerWheel:
         self._pool: Optional[DaemonPool] = None
         self._thread: Optional[threading.Thread] = None
 
+    @requires_lock("_cond")
     def _ensure_started(self) -> None:
         if self._thread is None or not self._thread.is_alive():
             self._pool = DaemonPool(self._pool_size, "timer-cb")

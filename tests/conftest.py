@@ -23,6 +23,14 @@ jax.config.update("jax_platforms", "cpu")
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+# Runtime lock diagnostics (opt-in): NOMAD_TPU_DEBUG_LOCKS=1 swaps
+# threading.Lock/RLock for order-tracking wrappers BEFORE any test
+# constructs a broker/raft/gossip object, so the chaos/cluster suites run
+# under the lock-order detector. Default-off: zero overhead when unset.
+from nomad_tpu.analysis import debug_locks as _debug_locks  # noqa: E402
+
+_debug_locks.install_from_env()
+
 
 # Build the native executor once if the toolchain is present; tests fall
 # back to the Python supervisor when it isn't (same file contract).

@@ -1,0 +1,54 @@
+"""Seeded lint violations — one (or more) per checker. NEVER imported;
+tests/test_analysis_lint.py and the `nomad-tpu lint` CLI parse it to
+prove every checker fires. Line comments name the expected checker id.
+"""
+
+import threading
+import time
+
+from nomad_tpu.analysis import guarded_by
+
+
+class BadStore:
+    _concurrency = guarded_by("_lock", "_items")
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._items = {}
+
+    def unlocked_access(self):
+        return len(self._items)          # guarded_by
+
+    def sleepy_critical_section(self):
+        with self._lock:
+            time.sleep(0.5)              # lock_blocking
+
+
+def hand_rolled_retry():
+    while True:
+        time.sleep(1.0)                  # retry
+
+
+def anonymous_thread():
+    threading.Thread(target=hand_rolled_retry).start()   # thread (x2:
+    #                             no name=, untracked non-daemon)
+
+
+def silent_swallow():
+    try:
+        hand_rolled_retry()
+    except Exception:
+        pass                             # swallow
+
+
+def undeclared_failpoint(failpoints):
+    failpoints.fire("fixture.not.a.declared.site")       # failpoint_site
+
+
+def bad_metric_key(metrics):
+    metrics.incr_counter(("Wrong-Scheme", "X"), 1)       # metric_key
+
+
+def bad_span_name(trace):
+    with trace.span("NotDotted"):        # trace_key
+        pass

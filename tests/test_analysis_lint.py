@@ -1,0 +1,175 @@
+"""Tier-1 gate for the static analysis framework (nomad_tpu/analysis):
+
+* the whole package tree is lint-clean — every checker, zero unsuppressed
+  findings (the Python analogue of the reference's `go vet` CI step);
+* every checker FIRES on the seeded-violation fixture, so a checker that
+  silently stops matching can't keep the gate green;
+* the `# lint: allow(<checker>, <reason>)` suppression grammar works and
+  demands a reason;
+* the telemetry-key checks migrated from tests/test_telemetry_lint.py
+  (failpoint registry round-trip, nomad.* metric keys, span-name scheme)
+  keep their assertions through the framework;
+* `nomad-tpu lint` exits 0 on the tree and nonzero on the fixture.
+"""
+
+import os
+import textwrap
+
+import pytest
+
+from nomad_tpu.analysis import all_checkers, run_checks
+from nomad_tpu.cli.commands import main as cli_main
+
+FIXTURE = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                       "fixtures", "lint_violations.py")
+
+EXPECTED_CHECKERS = {"guarded_by", "lock_blocking", "retry", "thread",
+                     "swallow", "failpoint_site", "metric_key", "trace_key"}
+
+
+def test_framework_hosts_the_expected_checkers():
+    ids = {c.id for c in all_checkers()}
+    assert EXPECTED_CHECKERS <= ids
+
+
+def test_tree_is_lint_clean():
+    findings = run_checks()
+    assert not findings, "unsuppressed lint findings:\n" + "\n".join(
+        f.render() for f in findings)
+
+
+@pytest.mark.parametrize("checker", sorted(EXPECTED_CHECKERS))
+def test_every_checker_fires_on_the_fixture(checker):
+    findings = run_checks(paths=[FIXTURE], checker_ids=[checker])
+    assert findings, f"checker {checker!r} found nothing in the fixture"
+    assert all(f.checker == checker for f in findings)
+    assert all(f.path == FIXTURE and f.line > 0 for f in findings)
+
+
+def test_thread_checker_distinguishes_unnamed_and_untracked():
+    messages = [f.message for f in
+                run_checks(paths=[FIXTURE], checker_ids=["thread"])]
+    assert any("without name=" in m for m in messages)
+    assert any("no retained handle" in m for m in messages)
+
+
+# ----------------------------------------------------------- suppressions
+def _write(tmp_path, body):
+    p = tmp_path / "case.py"
+    p.write_text(textwrap.dedent(body))
+    return str(p)
+
+
+def test_suppression_comment_silences_a_finding(tmp_path):
+    path = _write(tmp_path, """\
+        def f():
+            try:
+                pass
+            # lint: allow(swallow, fixture demonstrates suppression)
+            except Exception:
+                pass
+    """)
+    assert run_checks(paths=[path], checker_ids=["swallow"]) == []
+    suppressed = run_checks(paths=[path], checker_ids=["swallow"],
+                            include_suppressed=True)
+    assert len(suppressed) == 1 and suppressed[0].suppressed
+
+
+def test_suppression_requires_matching_checker_id(tmp_path):
+    path = _write(tmp_path, """\
+        def f():
+            try:
+                pass
+            # lint: allow(retry, wrong checker id on purpose)
+            except Exception:
+                pass
+    """)
+    assert len(run_checks(paths=[path], checker_ids=["swallow"])) == 1
+
+
+def test_suppression_without_reason_does_not_parse(tmp_path):
+    path = _write(tmp_path, """\
+        def f():
+            try:
+                pass
+            # lint: allow(swallow)
+            except Exception:
+                pass
+    """)
+    assert len(run_checks(paths=[path], checker_ids=["swallow"])) == 1
+
+
+def test_retry_checker_reports_nested_loop_sleep_once(tmp_path):
+    path = _write(tmp_path, """\
+        import time
+
+        def f(items):
+            while True:
+                for _ in items:
+                    time.sleep(1)
+    """)
+    assert len(run_checks(paths=[path], checker_ids=["retry"])) == 1
+
+
+def test_suppression_on_the_same_line(tmp_path):
+    path = _write(tmp_path, """\
+        import time
+
+        def f():
+            while True:
+                time.sleep(1)  # lint: allow(retry, demo same-line allow)
+    """)
+    assert run_checks(paths=[path], checker_ids=["retry"]) == []
+
+
+# ------------------------------------- migrated telemetry-key assertions
+def test_fired_failpoint_sites_match_known_sites():
+    """Same assertion test_telemetry_lint.py made: full-tree scans prove
+    fire() literals and KNOWN_SITES agree in BOTH directions."""
+    assert run_checks(checker_ids=["failpoint_site"]) == []
+
+
+def test_metric_and_trace_key_literals_follow_the_schemes():
+    assert run_checks(checker_ids=["metric_key", "trace_key"]) == []
+
+
+def test_unknown_checker_id_is_an_error():
+    with pytest.raises(ValueError):
+        run_checks(checker_ids=["no_such_checker"])
+
+
+# ------------------------------------------------------------------- CLI
+def test_cli_lint_clean_tree_exits_zero(capsys):
+    assert cli_main(["lint"]) == 0
+    assert "0 finding(s)" in capsys.readouterr().out
+
+
+def test_cli_lint_fixture_exits_nonzero(capsys):
+    assert cli_main(["lint", FIXTURE]) == 1
+    out = capsys.readouterr().out
+    for checker in EXPECTED_CHECKERS:
+        assert f"[{checker}]" in out, f"no {checker} finding in CLI output"
+
+
+def test_cli_lint_json_output(capsys):
+    import json
+
+    assert cli_main(["lint", "-json", FIXTURE]) == 1
+    payload = json.loads(capsys.readouterr().out)
+    assert payload["total"] == len(payload["findings"]) > 0
+    sample = payload["findings"][0]
+    assert {"checker", "path", "line", "message"} <= set(sample)
+
+
+def test_cli_lint_unknown_checker_exits_two(capsys):
+    assert cli_main(["lint", "-checker", "bogus"]) == 2
+    assert "known checkers" in capsys.readouterr().err
+
+
+def test_per_file_cache_serves_repeat_runs():
+    from nomad_tpu.analysis import framework
+
+    framework.load_file(FIXTURE)
+    before = framework._CACHE[os.path.abspath(FIXTURE)]
+    framework.load_file(FIXTURE)
+    assert framework._CACHE[os.path.abspath(FIXTURE)][2] is before[2]

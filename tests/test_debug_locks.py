@@ -1,0 +1,154 @@
+"""Runtime lock-order detector (nomad_tpu/analysis/debug_locks): the
+dynamic half of the concurrency pass. Exercised here exactly the way
+NOMAD_TPU_DEBUG_LOCKS=1 wires it in conftest — install() swaps the
+threading lock factories and time.sleep — then seeded misuse must be
+reported and clean usage must stay silent."""
+
+import threading
+import time
+
+import pytest
+
+from nomad_tpu.analysis import debug_locks
+
+
+@pytest.fixture
+def detector():
+    debug_locks.clear_findings()
+    debug_locks.install()
+    try:
+        yield debug_locks
+    finally:
+        debug_locks.uninstall()
+        debug_locks.clear_findings()
+
+
+def test_install_swaps_factories_and_uninstall_restores(detector):
+    assert isinstance(threading.Lock(), debug_locks.DebugLock)
+    assert isinstance(threading.RLock(), debug_locks.DebugRLock)
+    detector.uninstall()
+    assert not isinstance(threading.Lock(), debug_locks.DebugLock)
+    assert not isinstance(threading.RLock(), debug_locks.DebugRLock)
+
+
+def test_lock_order_inversion_is_reported(detector):
+    a = debug_locks.DebugLock("inv-A")
+    b = debug_locks.DebugLock("inv-B")
+    with a:
+        with b:
+            pass
+    assert detector.runtime_findings("lock_order_inversion") == []
+    with b:
+        with a:  # A->B then B->A: the seeded deadlock pattern
+            pass
+    findings = detector.runtime_findings("lock_order_inversion")
+    assert len(findings) == 1
+    assert set(findings[0].locks) == {"inv-A", "inv-B"}
+
+
+def test_consistent_order_stays_silent(detector):
+    a = debug_locks.DebugLock("ord-A")
+    b = debug_locks.DebugLock("ord-B")
+    for _ in range(3):
+        with a:
+            with b:
+                pass
+    assert detector.runtime_findings("lock_order_inversion") == []
+
+
+def test_blocking_call_under_lock_is_reported(detector):
+    lock = threading.Lock()  # a DebugLock via the patched factory
+    with lock:
+        time.sleep(0.001)    # the patched sleep sees the held lock
+    findings = detector.runtime_findings("blocking_under_lock")
+    assert len(findings) == 1
+    assert findings[0].locks == (lock.name,)
+    # ... and sleeping with nothing held is fine.
+    detector.clear_findings()
+    time.sleep(0.001)
+    assert detector.runtime_findings("blocking_under_lock") == []
+
+
+def test_long_hold_is_reported(detector, monkeypatch):
+    # The threshold is cached at install() (reading the env on every
+    # release would inflate the measured holds) — override the cache.
+    monkeypatch.setattr(debug_locks, "hold_threshold_s", 0.01)
+    lock = debug_locks.DebugLock("holder")
+    with lock:
+        debug_locks._REAL_SLEEP(0.05)
+    kinds = {f.locks for f in detector.runtime_findings("long_hold")}
+    assert ("holder",) in kinds
+
+
+def test_rlock_recursion_counts_as_one_hold(detector):
+    rl = debug_locks.DebugRLock("re-entrant")
+    with rl:
+        with rl:
+            assert len(debug_locks._held()) == 1
+        assert len(debug_locks._held()) == 1
+    assert debug_locks._held() == []
+
+
+def test_condition_wait_releases_the_held_stack(detector):
+    cond = threading.Condition()  # backed by a DebugRLock post-install
+    parked = threading.Event()
+    hit = []
+
+    def waiter():
+        with cond:
+            parked.set()  # set just before wait: the notifier can only
+            #               acquire cond once wait() has released it
+            cond.wait(timeout=5.0)
+            hit.append(len(debug_locks._held()))
+
+    t = threading.Thread(target=waiter, name="dbglock-waiter")
+    t.start()
+    assert parked.wait(timeout=5.0)
+    # Acquiring cond here proves the waiter's wait() RELEASED the lock
+    # (through _release_save on the debug wrapper).
+    with cond:
+        cond.notify_all()
+    t.join(timeout=5.0)
+    assert not t.is_alive()
+    assert hit == [1]  # re-acquired on wake, balanced afterwards
+
+
+def test_detector_reports_through_metrics(detector):
+    from nomad_tpu.telemetry import metrics
+
+    a = debug_locks.DebugLock("met-A")
+    b = debug_locks.DebugLock("met-B")
+    with a:
+        with b:
+            pass
+    with b:
+        with a:
+            pass
+    snap = metrics.snapshot()
+    names = [c["Name"] for c in snap["Counters"]]
+    assert "nomad.analysis.lock_order_inversion" in names
+
+
+def test_install_from_env_honors_the_flag(monkeypatch):
+    # The exact wiring conftest uses for NOMAD_TPU_DEBUG_LOCKS=1.
+    monkeypatch.delenv(debug_locks.ENV_VAR, raising=False)
+    assert debug_locks.install_from_env() is False
+    assert not debug_locks.installed()
+    monkeypatch.setenv(debug_locks.ENV_VAR, "1")
+    try:
+        assert debug_locks.install_from_env() is True
+        assert debug_locks.installed()
+    finally:
+        debug_locks.uninstall()
+        debug_locks.clear_findings()
+
+
+def test_default_off_leaves_threading_untouched():
+    # This test runs WITHOUT the detector fixture: the ambient state must
+    # be the raw stdlib (tier-1 runs with NOMAD_TPU_DEBUG_LOCKS unset).
+    import os
+
+    if os.environ.get(debug_locks.ENV_VAR) == "1":
+        pytest.skip("suite running in debug-locks mode")
+    assert not debug_locks.installed()
+    assert not isinstance(threading.Lock(), debug_locks.DebugLock)

@@ -40,12 +40,19 @@ N_NODES = int(os.environ.get("BENCH_NODES", 10_000))
 N_PLACEMENTS = int(os.environ.get("BENCH_PLACEMENTS", 30_000))
 PER_EVAL = int(os.environ.get("BENCH_PER_EVAL", 50))
 N_PARTITIONS = 64
-# One pipelined worker beats two at sustained load: the dispatch, drain, and
-# build stages of a single worker already fill the interpreter (GIL) and the
-# device chain; a second worker's threads just steal time slices from the
-# first (measured: 2 workers ~30 evals/s vs 1 worker ~130-230 at 400-eval
-# reps).
+# Pipelined workers share one device usage chain through the ChainArbiter
+# (windows interleave coherently; broker/plan-queue rounds are batched), so
+# N workers scale instead of collapsing (pre-arbiter: 2 workers ~30 evals/s
+# vs 130-230 for 1 — each kept a private chain the plan applier bounced).
+# The worker_scaling sweep below records the measured 1-vs-2 ratio in every
+# bench JSON so the trajectory is judged on scaling, not just 1-worker rate.
 N_WORKERS = int(os.environ.get("BENCH_WORKERS", 1))
+# Worker-scaling sweep shapes: ALWAYS smoke-sized — the sweep judges the
+# RATIO, not absolute rate, and two extra full-shape server boots would
+# double the bench wall clock.
+SCALING_NODES = int(os.environ.get("BENCH_SCALING_NODES", 512))
+SCALING_EVALS = int(os.environ.get("BENCH_SCALING_EVALS", 60))
+SCALING_REPS = int(os.environ.get("BENCH_SCALING_REPS", 4))
 # 64-eval windows measured best end-to-end in round 5: deep (256-eval)
 # windows serialize ~4x the scan steps per drain on the device chain,
 # while small windows amortize the tunnel RTT via the dispatch-time
@@ -76,12 +83,19 @@ def _apply_smoke():
     from a smoke run are NOT comparable to the headline shapes."""
     global N_NODES, N_PLACEMENTS, N_REPS, CPU_REF_EVALS
     global RUN_C2, RUN_C4, RUN_C5, PARITY_NODES, PARITY_EVALS
+    global SCALING_NODES, SCALING_EVALS
     N_NODES = min(N_NODES, 512)
     N_PLACEMENTS = min(N_PLACEMENTS, 2000)   # 40 evals @ PER_EVAL=50
     N_REPS = min(N_REPS, 3)
     CPU_REF_EVALS = min(CPU_REF_EVALS, 6)
     RUN_C2 = RUN_C4 = RUN_C5 = False
     PARITY_NODES, PARITY_EVALS = 200, 10
+    # The scaling sweep is already smoke-shaped; trim the node count and
+    # rep length so the whole smoke run stays under its 60s budget. The
+    # rep COUNT stays at the default: the max-of-reps ratio needs samples
+    # more than the budget needs the ~2s back.
+    SCALING_NODES = min(SCALING_NODES, 256)
+    SCALING_EVALS = min(SCALING_EVALS, 40)
 
 
 def _freeze_heap():
@@ -94,14 +108,20 @@ def _freeze_heap():
 
 
 def _tune_gc():
-    """Server-process GC tuning, applied identically before BOTH sides'
-    timed reps (TPU-served and CPU-served): collect, freeze the steady-state
-    heap (10k node structs + server machinery) out of the collector's view,
-    and raise the gen-0 threshold so a 20k-alloc registration storm doesn't
-    trigger full-heap scans mid-rep. The analogue of running the Go
-    reference with a tuned GOGC — a deployment setting, not a code path."""
+    """Server-process runtime tuning, applied identically before BOTH
+    sides' timed reps (TPU-served and CPU-served): collect, freeze the
+    steady-state heap (10k node structs + server machinery) out of the
+    collector's view, and raise the gen-0 threshold so a 20k-alloc
+    registration storm doesn't trigger full-heap scans mid-rep. The
+    analogue of running the Go reference with a tuned GOGC — a deployment
+    setting, not a code path. The GIL switch interval rises from its 5ms
+    default for the same reason: a scheduling server runs several
+    GIL-bound stage threads (N workers x dispatch/drain/build + the plan
+    applier), and 200 preemptions/sec of the dispatch loop is measurable
+    convoy overhead on a small core count."""
     _freeze_heap()
     gc.set_threshold(50_000, 50, 50)
+    sys.setswitchinterval(0.02)
 
 
 def build_nodes(n, n_dcs=1):
@@ -301,13 +321,13 @@ def bench_server_e2e(nodes, n_evals):
 
 
 def bench_served_config(nodes, job_fn, n_evals, reps=2, warm=3,
-                        window=None, latency_probes=3):
+                        window=None, latency_probes=3, workers=None):
     """Generic SERVED-path benchmark for one BASELINE config: live server,
     pipelined worker, clock from first register to last commit. Returns
     (median evals/sec, total placed, p50 single-eval latency, rep rates)."""
     from nomad_tpu.server import Server, ServerConfig
 
-    srv = Server(ServerConfig(num_schedulers=N_WORKERS,
+    srv = Server(ServerConfig(num_schedulers=workers or N_WORKERS,
                               pipelined_scheduling=True,
                               scheduler_window=window or WINDOW,
                               min_heartbeat_ttl=24 * 3600.0,
@@ -347,10 +367,90 @@ def bench_served_config(nodes, job_fn, n_evals, reps=2, warm=3,
         # FASTER of two reps as "the median" (optimistic bias).
         med = sorted(rates)[(len(rates) - 1) // 2]
         return (med, placed,
-                float(np.percentile(lats, 50)), [round(r, 2) for r in rates],
+                float(np.percentile(lats, 50)) if lats else 0.0,
+                [round(r, 2) for r in rates],
                 _pctiles_ms(storm_lats))
     finally:
         srv.shutdown()
+
+
+def bench_worker_scaling():
+    """1-vs-2-worker scaling of the served path, at smoke shapes. The
+    bench JSON records {workers_1, workers_2, ratio} so a scaling
+    regression (a second worker making things SLOWER — the pre-arbiter
+    state) is caught by trajectory review, not rediscovered by hand.
+
+    Both servers stay up and the timed reps INTERLEAVE (1w, 2w, 1w, 2w,
+    ...): short reps on a box with background load wander ±30%, and
+    interleaving puts both sides under the same drift instead of handing
+    one config a quiet machine. The reported rate is max-of-reps — the
+    ratio compares peak capability, and a max over a handful of short
+    reps is far less noisy than their median.
+
+    The sweep forces the DEVICE chain (host_placement=False): N-worker
+    scaling is a property of the device-chained architecture — async
+    kernel dispatches and GIL-releasing fetches are what one worker's
+    stages overlap with another's — and at smoke shapes the host-numpy
+    fallback would otherwise swallow the whole window into GIL-bound
+    Python, where a second worker can only ever tie (measured: host-path
+    ratio ~0.97-1.13 pure noise around parity; device-path ratio >1
+    consistently on a 2-core CPU box)."""
+    from nomad_tpu.server import Server, ServerConfig
+
+    nodes = build_nodes(SCALING_NODES)
+    servers = {}
+    out: dict = {"nodes": SCALING_NODES, "evals_per_rep": SCALING_EVALS}
+    try:
+        for n in (1, 2):
+            srv = Server(ServerConfig(num_schedulers=n,
+                                      pipelined_scheduling=True,
+                                      scheduler_window=WINDOW,
+                                      host_placement=False,
+                                      min_heartbeat_ttl=24 * 3600.0,
+                                      heartbeat_grace=24 * 3600.0))
+            srv.establish_leadership()
+            for node in nodes:
+                srv.node_register(node)
+            run = _make_storm_runner(srv)
+            run(2)
+            run(2)
+            srv.tindex.nt.warm_device()
+            run(SCALING_EVALS)  # full-size warm storm (compiles)
+            servers[n] = (srv, run)
+        _tune_gc()
+        for n in (1, 2):
+            # One untimed pair after the GC tuning: the first post-freeze
+            # storm pays one-off collector/cache effects that otherwise
+            # land inside whichever config runs first.
+            servers[n][1](SCALING_EVALS)
+            _freeze_heap()
+        rates: dict = {1: [], 2: []}
+        for _ in range(SCALING_REPS):
+            for n in (1, 2):  # interleaved A/B pair
+                srv, run = servers[n]
+                for w in srv.workers:
+                    if hasattr(w, "quiesce"):
+                        w.quiesce(30.0)
+                t0 = time.perf_counter()
+                eval_ids = run(SCALING_EVALS)
+                rates[n].append(
+                    round(SCALING_EVALS / (time.perf_counter() - t0), 2))
+                _freeze_heap()
+                # Per-rep placed counts (not just the last rep's): an
+                # under-placing rep is exactly the regression class the
+                # sweep exists to surface.
+                out.setdefault(f"workers_{n}_placed", []).append(sum(
+                    1 for eid in eval_ids
+                    for _ in srv.state.allocs_by_eval(eid)))
+        for n in (1, 2):
+            out[f"workers_{n}"] = max(rates[n])
+            out[f"workers_{n}_rep_rates"] = rates[n]
+        out["ratio"] = round(out["workers_2"] / out["workers_1"], 3) \
+            if out["workers_1"] else None
+        return out
+    finally:
+        for srv, _ in servers.values():
+            srv.shutdown()
 
 
 def build_plain_job(per_eval=PER_EVAL):
@@ -633,6 +733,10 @@ def main(argv=None):
             "storm_latency_ms": storm_pct,
             "rep_rates": rep_rates,
         }
+
+    # Horizontal worker scaling: always recorded (smoke shapes), so every
+    # BENCH file carries the 1-vs-2 ratio next to the single-worker rate.
+    detail["worker_scaling"] = bench_worker_scaling()
 
     detail["placement_parity"] = (parity := bench_placement_parity())
 

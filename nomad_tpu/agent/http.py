@@ -774,25 +774,33 @@ def route(agent, method: str, path: str, query, get_body):
                                   "(set enable_debug)")
         srv = need_server()
         workers = []
+        by_worker: Dict[str, Any] = {}
         totals: Dict[str, Any] = {}
         for i, w in enumerate(getattr(srv, "workers", [])):
             stats = getattr(w, "stats", None)
-            # ONE snapshot feeds both the worker entry and the totals:
-            # the worker threads mutate the live dict, and two reads
-            # could make Totals disagree with Workers[].Stats in the
-            # same response.
+            # ONE snapshot feeds the worker entry, the by-name map, and
+            # the totals: the worker threads mutate the live dict, and
+            # two reads could make Totals disagree with Workers[].Stats
+            # in the same response.
             snap = dict(stats) if stats is not None else None
+            name = getattr(w, "name", None) or f"worker-{i}"
             workers.append({
                 "Index": i,
+                "Name": name,
                 "Type": type(w).__name__,
                 "Window": getattr(w, "window", None),
                 "Stats": snap,
             })
             if snap is not None:
+                # Per-worker stats keyed by worker name: a scaling
+                # regression (one worker starved, one convoying on the
+                # chain lease) is invisible in the aggregate.
+                by_worker[name] = snap
                 for k, v in snap.items():
                     if isinstance(v, (int, float)):
                         totals[k] = totals.get(k, 0) + v
-        return {"Workers": workers, "Totals": totals}, None
+        return {"Workers": workers, "ByWorker": by_worker,
+                "Totals": totals}, None
 
     if path == "/v1/agent/metrics":
         # In-memory telemetry snapshot (reference shape: go-metrics

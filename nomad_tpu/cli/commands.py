@@ -832,7 +832,8 @@ def cmd_sched_stats(args) -> int:
         return 0
     for w in workers:
         window = f", window {w['Window']}" if w.get("Window") else ""
-        print(f"Worker {w['Index']} ({w['Type']}{window})")
+        name = w.get("Name") or f"worker-{w['Index']}"
+        print(f"Worker {name} ({w['Type']}{window})")
         stats = w.get("Stats")
         if not stats:
             print("  (no stats exported)")

@@ -65,6 +65,10 @@ KNOWN_SITES: Dict[str, str] = {
     "services.sync": "client: service-registry sync push to the servers "
                      "(drop=lost batch; retried next flush)",
     "worker.dequeue": "server: scheduling worker eval dequeue",
+    "worker.window.drain": "server: pipelined worker's window drain fetch "
+                           "(kill a worker's window mid-flight; the broker "
+                           "must redeliver its evals exactly once and the "
+                           "chain rebase recover)",
 }
 
 MODES = ("error", "delay", "drop")

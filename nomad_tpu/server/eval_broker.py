@@ -210,6 +210,54 @@ class EvalBroker:
                     if remaining <= 0 or not self._cond.wait(remaining):
                         return None, ""
 
+    def dequeue_window(self, schedulers: List[str], count: int,
+                       timeout: Optional[float] = None,
+                       fill_timeout: float = 0.0
+                       ) -> List[Tuple[Evaluation, str]]:
+        """Batch dequeue of up to `count` evals as ONE window under a
+        single lock hold (the N-worker fast path). Blocks like dequeue()
+        for the first eligible eval, then drains whatever else is already
+        ready; with fill_timeout > 0 it lingers that long for stragglers
+        (an enqueue burst still landing) before returning a short window.
+
+        Handing the whole window out inside one critical section gives
+        each worker a DISJOINT eval set in one lock round — per-eval
+        dequeue loops from two workers interleave-steal each other's
+        window fills and convoy on the lock, so both end up dispatching
+        half-size windows that each still pay a full device round trip."""
+        import time as _time
+
+        out: List[Tuple[Evaluation, str]] = []
+        if count <= 0:
+            return out
+        end = None if not timeout else _time.monotonic() + timeout
+        with self._lock:
+            while True:
+                if not self._enabled:
+                    raise RuntimeError("eval broker disabled")
+                got = self._scan(schedulers)
+                if got is not None:
+                    out.append(got)
+                    break
+                if end is None:
+                    self._cond.wait()
+                else:
+                    remaining = end - _time.monotonic()
+                    if remaining <= 0 or not self._cond.wait(remaining):
+                        return out
+            fill_end = _time.monotonic() + fill_timeout
+            while len(out) < count:
+                if not self._enabled:
+                    break
+                got = self._scan(schedulers)
+                if got is not None:
+                    out.append(got)
+                    continue
+                remaining = fill_end - _time.monotonic()
+                if remaining <= 0 or not self._cond.wait(remaining):
+                    break
+        return out
+
     @requires_lock("_lock")
     def _scan(self, schedulers: List[str]
               ) -> Optional[Tuple[Evaluation, str]]:
@@ -268,40 +316,81 @@ class EvalBroker:
             unack.nack_timer = wheel.after(self.nack_timeout, self.nack,
                                            eval_id, token)
 
+    def outstanding_reset_batch(self, pairs: List[Tuple[str, str]]
+                                ) -> set:
+        """outstanding_reset for a whole window under ONE lock hold (the
+        pipelined worker re-arms every live eval's nack deadline at each
+        stage entry; per-eval lock rounds from N workers convoy here and
+        let deadlines lapse mid-window — the redelivery storm behind the
+        `stale` counter). Returns the set of eval ids no longer
+        outstanding to this caller (redelivered / token rotated) instead
+        of raising — one stale eval must not abort the sweep for the
+        rest of the window."""
+        stale: set = set()
+        with self._lock:
+            for eval_id, token in pairs:
+                unack = self._unack.get(eval_id)
+                if unack is None or unack.token != token:
+                    stale.add(eval_id)
+                    continue
+                unack.nack_timer.cancel()
+                unack.nack_timer = wheel.after(self.nack_timeout, self.nack,
+                                               eval_id, token)
+        return stale
+
     def ack(self, eval_id: str, token: str) -> None:
         """(reference: eval_broker.go:461-519)"""
         with self._lock:
-            requeued = self._requeue.pop(token, None)
-            unack = self._unack.get(eval_id)
-            if unack is None:
-                raise NotOutstandingError(f"Evaluation ID not found: {eval_id}")
-            if unack.token != token:
-                raise TokenMismatchError(eval_id)
-            unack.nack_timer.cancel()
-            job_id = unack.eval.JobID
+            self._ack_locked(eval_id, token)
 
-            self.stats.TotalUnacked -= 1
-            queue = unack.eval.Type
-            if self._evals.get(eval_id, 0) > self.delivery_limit:
-                queue = FAILED_QUEUE
-            by = self.stats.ByScheduler.get(queue)
-            if by is not None:
-                by["Unacked"] -= 1
+    def ack_batch(self, pairs: List[Tuple[str, str]]
+                  ) -> List[Tuple[str, Exception]]:
+        """Ack a whole window's evals under ONE lock hold. Per-eval
+        broker races (redelivered mid-window, token rotated) are
+        returned, not raised — one lost eval must not abort the acks of
+        the rest of the window."""
+        failures: List[Tuple[str, Exception]] = []
+        with self._lock:
+            for eval_id, token in pairs:
+                try:
+                    self._ack_locked(eval_id, token)
+                except (NotOutstandingError, TokenMismatchError) as e:
+                    failures.append((eval_id, e))
+        return failures
 
-            self._unack.pop(eval_id, None)
-            self._evals.pop(eval_id, None)
-            self._job_evals.pop(job_id, None)
+    @requires_lock("_lock")
+    def _ack_locked(self, eval_id: str, token: str) -> None:
+        requeued = self._requeue.pop(token, None)
+        unack = self._unack.get(eval_id)
+        if unack is None:
+            raise NotOutstandingError(f"Evaluation ID not found: {eval_id}")
+        if unack.token != token:
+            raise TokenMismatchError(eval_id)
+        unack.nack_timer.cancel()
+        job_id = unack.eval.JobID
 
-            blocked = self._blocked.get(job_id)
-            if blocked is not None and len(blocked):
-                ev = blocked.pop()
-                if not len(blocked):
-                    self._blocked.pop(job_id, None)
-                self.stats.TotalBlocked -= 1
-                self._enqueue_locked(ev, ev.Type)
+        self.stats.TotalUnacked -= 1
+        queue = unack.eval.Type
+        if self._evals.get(eval_id, 0) > self.delivery_limit:
+            queue = FAILED_QUEUE
+        by = self.stats.ByScheduler.get(queue)
+        if by is not None:
+            by["Unacked"] -= 1
 
-            if requeued is not None:
-                self._process_enqueue(requeued, "")
+        self._unack.pop(eval_id, None)
+        self._evals.pop(eval_id, None)
+        self._job_evals.pop(job_id, None)
+
+        blocked = self._blocked.get(job_id)
+        if blocked is not None and len(blocked):
+            ev = blocked.pop()
+            if not len(blocked):
+                self._blocked.pop(job_id, None)
+            self.stats.TotalBlocked -= 1
+            self._enqueue_locked(ev, ev.Type)
+
+        if requeued is not None:
+            self._process_enqueue(requeued, "")
 
     def nack(self, eval_id: str, token: str) -> None:
         """(reference: eval_broker.go:520-560)"""

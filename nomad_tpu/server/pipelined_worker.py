@@ -38,6 +38,16 @@ GenericScheduler path (scheduler/generic_sched.py), as does any eval whose
 plan partially commits (stale chain) or whose winner fails host-side port
 assignment. Fallbacks preserve reference semantics bit-for-bit; the fast path
 only accelerates evals whose outcome is provably the same.
+
+N workers share ONE logical usage chain through the ChainArbiter
+(tensor/node_table.py): a window lease serializes the dispatch handoff so
+worker B's kernels chain on worker A's in-flight tail (each placement sees
+every placement dispatched before it, whoever dispatched it), while the
+drain fetches (GIL released) and build stages of different workers
+interleave. Broker windows batch-dequeue under one lock (disjoint eval
+sets, no interleave-stealing), per-stage deadline re-arms and window acks
+are one lock round each, and a window's plans enqueue contiguously — the
+contention seams that made a second worker SLOWER than one.
 """
 
 from __future__ import annotations
@@ -52,6 +62,7 @@ from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
+from nomad_tpu.resilience import failpoints
 from nomad_tpu.scheduler.context import EvalContext
 from nomad_tpu.scheduler.generic_sched import (
     _HANDLED_TRIGGERS,
@@ -75,6 +86,7 @@ from nomad_tpu.scheduler.util import (
 )
 from nomad_tpu.structs import AllocMetric, Evaluation, Plan
 from nomad_tpu.telemetry import trace
+from nomad_tpu.tensor.node_table import ChainArbiter
 from nomad_tpu.structs.structs import (
     EvalStatusBlocked,
     EvalStatusComplete,
@@ -82,7 +94,6 @@ from nomad_tpu.structs.structs import (
     JobTypeService,
 )
 
-from .eval_broker import NotOutstandingError, TokenMismatchError
 from .fsm import MessageType
 from .worker import DEQUEUE_TIMEOUT, Worker
 
@@ -108,6 +119,7 @@ STATS_COUNTERS = (
     "rebases",    # chain rebases onto committed usage
 )
 STATS_TIMERS_MS = (
+    "t_lease_ms",        # waiting for the shared chain-lease (ChainArbiter)
     "t_refresh_ms",      # node-table device refresh at dispatch
     "t_diff_ms",         # job diff/alloc filtering per eval
     "t_prep_ms",         # PreparedBatch assembly (device inputs)
@@ -194,14 +206,9 @@ class _WindowWork:
     packed: Optional[list] = None              # CompactResults, set by drain
     failed: bool = False                       # drain blew up: nack window
     chained: bool = False       # dispatched on a previous window's tail
-    taint_seq: int = 0          # _taint_seq observed at chain-read time
-
-
-# Force a pipeline drain + chain rebase after this many chained windows: the
-# chain misses slow-path/fallback commits (undercount — the applier catches
-# any oversubscription) and evictions (overcount — spurious blocked evals),
-# so its drift is bounded even through a storm that never pauses.
-_REBASE_WINDOWS = 256
+    taint_seq: int = 0          # arbiter taint seq observed at chain read
+    published: bool = False     # tail published: arbiter counts us in flight
+    chain_seq: int = 0          # chain position (arbiter finish barrier)
 
 
 def _prep_sig(job, place, batch: bool) -> Optional[tuple]:
@@ -236,7 +243,7 @@ class PipelinedWorker(Worker):
     """Drop-in Worker with windowed device-chained placement."""
 
     def __init__(self, *args, window: int = 32, host_placement: bool = True,
-                 **kwargs):
+                 chain_arbiter: Optional[ChainArbiter] = None, **kwargs):
         super().__init__(*args, **kwargs)
         self.window = max(1, window)
         self.host_placement = host_placement
@@ -248,38 +255,29 @@ class PipelinedWorker(Worker):
         # declared schema (STATS_COUNTERS/STATS_TIMERS_MS) — every key is
         # pre-seeded and mutated with +=, never lazily .get()-defaulted.
         self.stats = new_stats()
-        # Cross-window device usage chain (usage_after of the last dispatched
-        # fast eval). None = next window reads committed usage from the table.
-        self._chain = None
-        self._chain_epoch = -1
-        self._chained_windows = 0
-        # Phantom-usage taint (both guarded by _pending_lock): the build
-        # stage bumps _taint_seq and sets _chain_dirty when a window ends
-        # with stale/fallback records, whose chained kernel placements
-        # never commit. _chain_dirty makes the next DISPATCH rebase;
-        # _taint_seq lets windows already in flight on the tainted tail
-        # detect it at finish time and quarantine their failed placements.
-        self._chain_dirty = False
-        self._taint_seq = 0
+        # Cross-window (and cross-WORKER) usage chain: the server hands
+        # every pipelined worker the SAME arbiter so their windows
+        # interleave on one coherent chain. A standalone worker (tests)
+        # gets a private one — identical single-worker semantics.
+        self._arbiter = chain_arbiter or ChainArbiter(self.tindex.nt)
         # Stage handoffs: dispatch -> drain -> build, one window queued per
         # seam. The drain stage spends its time in a device readback (GIL
         # released) while the build stage runs host Python — splitting them
-        # lets window N+1's readback ride under window N's plan building.
+        # lets window N+1's readback ride under window N's plan building,
+        # and (with N workers) lets worker B build while worker A's fetch
+        # has the interpreter released.
         self._drain_q: "queue.Queue[Optional[_WindowWork]]" = queue.Queue(
             maxsize=1)
         self._build_q: "queue.Queue[Optional[_WindowWork]]" = queue.Queue(
             maxsize=1)
-        self._pending_windows = 0
-        self._pending_lock = threading.Lock()
-        self._drained = threading.Event()
-        self._drained.set()
 
     # -------------------------------------------------------------- run loop
     def run(self) -> None:
+        name = getattr(self, "name", "pipelined")
         drainer = threading.Thread(target=self._drain_loop, daemon=True,
-                                   name="pipelined-drain")
+                                   name=f"{name}-drain")
         builder = threading.Thread(target=self._build_loop, daemon=True,
-                                   name="pipelined-build")
+                                   name=f"{name}-build")
         drainer.start()
         builder.start()
         try:
@@ -287,12 +285,42 @@ class PipelinedWorker(Worker):
                 if self._paused.is_set():
                     self._stop.wait(0.05)  # shutdown-aware pause spin
                     continue
-                batch = self._dequeue_window()
-                if not batch:
+                # Wait for the lease to be FREE (without taking it), then
+                # dequeue ONE eval lease-free, take the lease, and batch-
+                # fill the window under it. Ordering matters at every
+                # step: parking on the arbiter first means a worker never
+                # dequeues evals it could not launch anyway (hostage
+                # evals burning their deadlines while the storm splinters
+                # into one-eval windows); dequeuing one eval before
+                # acquiring means an idle worker holds neither lease nor
+                # evals; filling under the lease captures everything that
+                # accumulated while another worker's dispatch held it —
+                # so windows stay full.
+                tw0 = time.perf_counter()
+                idle = self._arbiter.wait_dispatch_idle(DEQUEUE_TIMEOUT)
+                # The park above IS the convoy time (it only blocks while
+                # another worker's dispatch holds the lease), so it counts
+                # toward t_lease_ms — the later acquire is near-instant by
+                # construction and would report ~0 under real convoying.
+                self.stats["t_lease_ms"] += (time.perf_counter() - tw0) * 1e3
+                if not idle:
+                    continue
+                got = self._dequeue_first()
+                if got is None:
                     continue
                 work = None
+                batch: List[Tuple[Evaluation, str]] = [got]
+                tl0 = time.perf_counter()
                 try:
-                    work = self._dispatch_window(batch)
+                    lease = self._arbiter.acquire(self._stop, holder=self.name)
+                except RuntimeError:
+                    continue  # stopping; the eval redelivers via its timer
+                self.stats["t_lease_ms"] += (time.perf_counter() - tl0) * 1e3
+                if lease.rebased:
+                    self.stats["rebases"] += 1
+                try:
+                    batch.extend(self._fill_window())
+                    work = self._dispatch_window(batch, lease)
                 except Exception:
                     # Broker/plan-queue teardown on leadership loss: drop
                     # quietly, redelivery handles the rest (worker.go:88-99).
@@ -301,10 +329,12 @@ class PipelinedWorker(Worker):
                     logger.exception("pipelined worker: dispatch failed")
                     for ev, token in batch:
                         self._send_nack(ev.ID, token)
+                finally:
+                    # No-op when the dispatch published the tail; frees the
+                    # lease on empty windows, all-slow windows, and every
+                    # failure path.
+                    self._arbiter.abort(lease)
                 if work is not None:
-                    with self._pending_lock:
-                        self._pending_windows += 1
-                        self._drained.clear()
                     self._drain_q.put(work)
         finally:
             self._drain_q.put(None)
@@ -313,24 +343,27 @@ class PipelinedWorker(Worker):
 
     def _reset_window_deadlines(self, work: _WindowWork) -> None:
         """Push the broker nack deadline out for every live eval of the
-        window. A window can now wait behind two others' drain+build stages
-        (cold compiles take tens of seconds), so each stage entry re-arms
-        the deadline the way the pre-split loop's single pass did. An eval
-        already redelivered is marked stale here — its device work is
-        abandoned rather than racing another worker's."""
-        for rec in work.fast:
-            if rec.stale:
-                continue
-            try:
-                self.eval_broker.outstanding_reset(rec.ev.ID, rec.token)
-            except (NotOutstandingError, TokenMismatchError) as e:
-                logger.debug("eval %s redelivered between stages (%s)",
-                             rec.ev.ID, e)
-                rec.stale = True
-            except Exception as exc:
-                # Broker teardown: downstream handling owns it.
-                logger.debug("outstanding-reset sweep aborted: %s", exc)
-                return
+        window — ONE lock round for the whole window. A window can wait
+        behind two others' drain+build stages (cold compiles take tens of
+        seconds), so each stage entry re-arms the deadline the way the
+        pre-split loop's single pass did. An eval already redelivered is
+        marked stale here — its device work is abandoned rather than
+        racing another worker's."""
+        pairs = [(rec.ev.ID, rec.token) for rec in work.fast if not rec.stale]
+        if not pairs:
+            return
+        try:
+            stale = self.eval_broker.outstanding_reset_batch(pairs)
+        except Exception as exc:
+            # Broker teardown: downstream handling owns it.
+            logger.debug("outstanding-reset sweep aborted: %s", exc)
+            return
+        if stale:
+            for rec in work.fast:
+                if rec.ev.ID in stale and not rec.stale:
+                    logger.debug("eval %s redelivered between stages",
+                                 rec.ev.ID)
+                    rec.stale = True
 
     def _drain_loop(self) -> None:
         """Stage 2: block on each window's device readback (a full network
@@ -375,16 +408,14 @@ class PipelinedWorker(Worker):
                     self._process_slow(ev, token)
                 self.stats["t_slow_ms"] += (time.perf_counter() - t0) * 1e3
             except Exception:
-                if work.fast:
+                if work.published:
                     # None of this window's kernel placements will commit,
                     # but they are baked into the usage chain: raise the
                     # taint so in-flight windows quarantine their squeezed
                     # evals and the next dispatch rebases — the same
                     # phantom-usage hole as a stale record, via the
                     # whole-window-failure source.
-                    with self._pending_lock:
-                        self._taint_seq += 1
-                        self._chain_dirty = True
+                    self._arbiter.taint()
                 if not (self._stop.is_set()
                         or not self.eval_broker.enabled()):
                     logger.exception("pipelined worker: window finish failed")
@@ -397,15 +428,16 @@ class PipelinedWorker(Worker):
                     for ev, token in work.slow:
                         self._send_nack(ev.ID, token)
             finally:
-                with self._pending_lock:
-                    self._pending_windows -= 1
-                    drained = self._pending_windows == 0
-                    if drained:
-                        self._drained.set()
-                if drained:
-                    # The NEXT window will rebase onto committed usage and
-                    # pay the dirty-row refresh (one blocking host->device
-                    # RTT after a storm). This thread is idle until then —
+                if work.published:
+                    # Failure paths raise the taint above without reaching
+                    # _finish_fast's settle point; successors must not
+                    # wait out the barrier timeout for a dead window.
+                    self._arbiter.mark_settled(work.chain_seq)
+                if work.published and self._arbiter.finish_window():
+                    # Pipeline drained across ALL workers: the NEXT window
+                    # will rebase onto committed usage and pay the
+                    # dirty-row refresh (one blocking host->device RTT
+                    # after a storm). This thread is idle until then —
                     # prefetch the refresh now so dispatch finds clean
                     # device state. Serialized with dispatch by the tensor
                     # lock; a no-op when nothing is dirty.
@@ -415,46 +447,74 @@ class PipelinedWorker(Worker):
                     except Exception:
                         pass
 
-    def _dequeue_window(self) -> List[Tuple[Evaluation, str]]:
+    def _dequeue_first(self) -> Optional[Tuple[Evaluation, str]]:
+        """Blocking dequeue of a window's FIRST eval — the shared
+        Worker._dequeue_evaluation seam (failpoint + backoff handling
+        lives there, once), taken BEFORE the chain lease so an idle
+        worker parks holding neither lease nor evals."""
         got = self._dequeue_evaluation()
         if got is None:
-            return []
-        ev0, token0, wait_index = got
+            return None
+        ev, token, wait_index = got
         # Snapshot freshness barrier for the window (see worker.py
-        # dequeue WaitIndex); trivially satisfied on the leader, where the
-        # pipelined worker runs against its own committed state.
+        # dequeue WaitIndex); trivially satisfied on the leader, where
+        # the pipelined worker runs against its own committed state.
         self._window_wait_index = wait_index
-        batch = [(ev0, token0)]
-        while len(batch) < self.window:
-            try:
-                ev, token = self.eval_broker.dequeue(self.schedulers,
-                                                     FILL_TIMEOUT)
-            except RuntimeError:
-                break
-            if ev is None:
-                break
-            batch.append((ev, token))
-        return batch
+        return ev, token
+
+    def _fill_window(self) -> List[Tuple[Evaluation, str]]:
+        """Fill the rest of the window in ONE broker lock round
+        (EvalBroker.dequeue_window), AFTER the chain lease is in hand:
+        with N workers, per-eval fill loops interleave-steal each other's
+        windows and convoy on the broker lock — the batch hands this
+        worker a disjoint, contiguous set, including everything that
+        arrived while another worker's dispatch held the lease."""
+        try:
+            return self.eval_broker.dequeue_window(
+                self.schedulers, self.window - 1, FILL_TIMEOUT,
+                fill_timeout=FILL_TIMEOUT)
+        except RuntimeError:
+            return []
+
+    def _dequeue_window(self) -> List[Tuple[Evaluation, str]]:
+        """First eval + batch fill, lease-free (tests and callers that
+        dispatch synchronously)."""
+        got = self._dequeue_first()
+        if got is None:
+            return []
+        return [got] + self._fill_window()
 
     # ------------------------------------------------------------ the window
-    def _dispatch_window(self, batch: List[Tuple[Evaluation, str]]
-                         ) -> Optional[_WindowWork]:
-        # The window is in hand: push every eval's nack deadline out NOW.
-        # Filling + dispatching + draining a cold window (first compiles)
-        # can exceed the redelivery timeout (reference: worker.go heartbeats
-        # the broker via OutstandingReset during long scheduling). An eval
+    def _dispatch_window(self, batch: List[Tuple[Evaluation, str]],
+                         lease=None) -> Optional[_WindowWork]:
+        """Dispatch one window's kernels chained on the leased usage tail;
+        publishes the new tail (ending the lease) once the window's
+        launches are all in flight. run() passes the lease it acquired
+        BEFORE dequeuing and aborts it if we return unpublished; tests
+        calling without one get the same acquire/abort wrapper here."""
+        if lease is None:
+            lease = self._arbiter.acquire(self._stop, holder=self.name)
+            if lease.rebased:
+                self.stats["rebases"] += 1
+            try:
+                return self._dispatch_window(batch, lease)
+            finally:
+                self._arbiter.abort(lease)  # no-op after a publish
+        # The window is in hand: push every eval's nack deadline out NOW
+        # (one broker lock round for the whole window). Filling +
+        # dispatching + draining a cold window (first compiles) can exceed
+        # the redelivery timeout (reference: worker.go heartbeats the
+        # broker via OutstandingReset during long scheduling). An eval
         # already redelivered belongs to another worker — drop it here
         # rather than paying a device dispatch that the token check will
         # reject anyway.
-        live: List[Tuple[Evaluation, str]] = []
-        for ev, token in batch:
-            try:
-                self.eval_broker.outstanding_reset(ev.ID, token)
-                live.append((ev, token))
-            except (NotOutstandingError, TokenMismatchError) as e:
-                logger.debug("window drop: eval %s redelivered (%s)",
-                             ev.ID, e)
-        batch = live
+        stale_ids = self.eval_broker.outstanding_reset_batch(
+            [(ev.ID, token) for ev, token in batch])
+        if stale_ids:
+            for ev, _ in batch:
+                if ev.ID in stale_ids:
+                    logger.debug("window drop: eval %s redelivered", ev.ID)
+            batch = [(ev, t) for ev, t in batch if ev.ID not in stale_ids]
         if not batch:
             return None
         self._wait_for_index(max(
@@ -464,13 +524,12 @@ class PipelinedWorker(Worker):
         t0 = time.perf_counter()
 
         nt = self.tindex.nt
-        # Capture the taint sequence BEFORE reading the chain: a taint
-        # raised in between must surface as external at finish time (the
-        # false-positive direction — quarantining an untainted window's
-        # failed evals into exact-path re-runs — is safe).
-        with self._pending_lock:
-            taint_seq_at_dispatch = self._taint_seq
-        usage_chain = self._usage_chain(nt)
+        # The lease captured the taint sequence BEFORE handing out the
+        # chain: a taint raised in between must surface as external at
+        # finish time (the false-positive direction — quarantining an
+        # untainted window's failed evals into exact-path re-runs — is
+        # safe).
+        usage_chain = lease.chain
         chained_at_dispatch = usage_chain is not None
         # Shallow windows place HOST-SIDE (kernels.place_batch_host): on a
         # remote-attached TPU every host sync is a fixed ~100ms round trip,
@@ -598,17 +657,18 @@ class PipelinedWorker(Worker):
         self.stats["t_launch_ms"] += (time.perf_counter() - tl0) * 1e3
 
         if fast:
-            # Next window chains on this one's device-side usage tail even
-            # though its plans haven't committed yet.
-            self._chain = usage_chain
-            # Epoch captured at chain validation (_usage_chain), BEFORE this
-            # window dispatched: a row freed mid-dispatch still rebases the
-            # next window.
-            self._chain_epoch = self._dispatch_epoch
-            self._chained_windows += 1
+            # Publish the window's device-side usage tail as the shared
+            # chain even though its plans haven't committed yet: the next
+            # window — ANY worker's — chains on it. The lease carried the
+            # row epoch captured at chain validation, BEFORE this window
+            # dispatched: a row freed mid-dispatch still rebases the next
+            # window. Publishing also ends the lease, so another worker
+            # can start its dispatch while we assemble the drain plan.
+            self._arbiter.publish(lease, usage_chain)
         self.stats["windows"] += 1
         self.stats["slow"] += len(slow)
-        work = _WindowWork(fast=fast, slow=slow)
+        work = _WindowWork(fast=fast, slow=slow, published=bool(fast),
+                           chain_seq=lease.seq)
         # Build the drain plan NOW: the compaction kernels dispatch async
         # behind the window's placement kernels and their (much smaller)
         # outputs start copying to the host immediately, so the drain
@@ -629,10 +689,10 @@ class PipelinedWorker(Worker):
         self.stats["t_dispatch_ms"] += (time.perf_counter() - t0) * 1e3
         # Taint bookkeeping: a window dispatched on a previous window's
         # tail inherits any phantom usage that tail turns out to carry;
-        # record the taint sequence seen NOW so _finish_fast can detect a
-        # taint raised while this window was in flight.
+        # record the taint sequence the lease saw so _finish_fast can
+        # detect a taint raised while this window was in flight.
         work.chained = chained_at_dispatch
-        work.taint_seq = taint_seq_at_dispatch
+        work.taint_seq = lease.taint_seq
         return work
 
     def reset_stats(self) -> None:
@@ -642,54 +702,12 @@ class PipelinedWorker(Worker):
         self.stats.update(new_stats())
 
     def quiesce(self, timeout: float = 30.0) -> bool:
-        """Wait until every dispatched window has fully finished (drained,
-        built, acked). For tests/benchmarks that read or reset `stats`:
-        eval completion becomes visible at the EvalUpdate apply, which is
-        BEFORE the build stage's final stats writes for that window."""
-        return self._drained.wait(timeout)
-
-    def _usage_chain(self, nt):
-        """The usage array this window's kernels start from: the previous
-        window's device-side tail while windows are in flight, or None
-        (= committed usage from the table) after a rebase."""
-        chain = self._chain
-        self._dispatch_epoch = nt.row_epoch
-        with self._pending_lock:
-            # Atomic read+clear: an unguarded check-then-clear could erase
-            # a taint the build thread raised in between, leaving later
-            # windows chained on phantom usage with no quarantine.
-            dirty = self._chain_dirty
-            self._chain_dirty = False
-        if chain is not None and dirty:
-            # A finished window had stale/fallback records: their kernel
-            # placements are baked into this chain but will never commit
-            # as dispatched — phantom usage that squeezes later windows
-            # into spurious exhaustion. Wait out the in-flight windows and
-            # restart from committed state.
-            self._drained.wait(timeout=60.0)
-            chain = None
-        if chain is not None and (chain.shape[0] != nt.n_rows
-                                  or self._chain_epoch != nt.row_epoch):
-            # Table resized OR a row changed identity (node removed / freed
-            # row reused): the chain may carry a departed node's usage on a
-            # row that now belongs to someone else.
-            chain = None
-        if chain is not None and self._chained_windows >= _REBASE_WINDOWS:
-            # Bound chain drift: drain the pipeline, then restart from
-            # committed state.
-            self._drained.wait(timeout=60.0)
-            chain = None
-        if chain is not None and self._drained.is_set():
-            # Pipeline is empty: everything this chain carries has committed
-            # into the host mirror, so committed state is strictly fresher
-            # (it also includes slow-path/fallback commits the chain missed).
-            chain = None
-        if chain is None:
-            if self._chain is not None:
-                self.stats["rebases"] += 1
-            self._chain = None
-            self._chained_windows = 0
-        return chain
+        """Wait until every dispatched window — across ALL workers sharing
+        the chain arbiter — has fully finished (drained, built, acked).
+        For tests/benchmarks that read or reset `stats`: eval completion
+        becomes visible at the EvalUpdate apply, which is BEFORE the build
+        stage's final stats writes for that window."""
+        return self._arbiter.wait_drained(timeout)
 
     def _try_dispatch_fast(self, ev: Evaluation, token: str, snap,
                            usage_chain,
@@ -803,6 +821,7 @@ class PipelinedWorker(Worker):
         # exhaustion actually reads it, which an all-placed storm window
         # never does.
         acc = WindowAccumulator(nt.n_rows)
+        submit: List[_FastEval] = []
         for rec, cr in zip(fast, packed):
             if rec.stale:
                 continue  # redelivered between stages: abandoned
@@ -826,19 +845,34 @@ class PipelinedWorker(Worker):
                 rec.fallback = True  # nothing placeable; let sync path decide
                 continue
             rec.plan.EvalToken = rec.token
+            submit.append(rec)
+        # ONE broker lock round re-arms every submitting eval's deadline
+        # and surfaces redeliveries; ONE queue lock round enqueues the
+        # window's plans contiguously in chain order (a second worker's
+        # window cannot interleave into ours mid-submit).
+        if submit:
             try:
-                self.eval_broker.outstanding_reset(rec.ev.ID, rec.token)
-                if not rec.plan.is_no_op():
-                    rec.pending = self.plan_queue.enqueue(rec.plan)
-            except (NotOutstandingError, TokenMismatchError) as e:
-                # Redelivered mid-window: another worker owns this eval
-                # now — abandon it entirely (no fallback re-run, no ack).
-                logger.debug("eval %s redelivered mid-window (%s)",
-                             rec.ev.ID, e)
-                rec.stale = True
+                stale_ids = self.eval_broker.outstanding_reset_batch(
+                    [(r.ev.ID, r.token) for r in submit])
+                live = []
+                for rec in submit:
+                    if rec.ev.ID in stale_ids:
+                        # Redelivered mid-window: another worker owns this
+                        # eval now — abandon it entirely (no fallback
+                        # re-run, no ack).
+                        logger.debug("eval %s redelivered mid-window",
+                                     rec.ev.ID)
+                        rec.stale = True
+                    elif not rec.plan.is_no_op():
+                        live.append(rec)
+                for rec, pending in zip(live, self.plan_queue.enqueue_all(
+                        [r.plan for r in live])):
+                    rec.pending = pending
             except Exception:
-                logger.exception("plan enqueue failed for eval %s", rec.ev.ID)
-                rec.fallback = True
+                logger.exception("plan enqueue failed for window")
+                for rec in submit:
+                    if not rec.stale and rec.pending is None:
+                        rec.fallback = True
 
         t2 = time.perf_counter()
         self.stats["t_build_ms"] += (t2 - t1) * 1e3
@@ -871,13 +905,25 @@ class PipelinedWorker(Worker):
         # while this one (chained on its tail) was in flight.
         tainted_from = next((i for i, rec in enumerate(fast)
                              if rec.stale or rec.fallback), None)
-        with self._pending_lock:
-            external_taint = (work.chained
-                              and self._taint_seq != work.taint_seq)
-            if tainted_from is not None:
-                # Windows in flight on OUR tail inherit the phantom too.
-                self._taint_seq += 1
-                self._chain_dirty = True
+        # Chain-order barrier: every window published BEFORE ours must
+        # have made its taint decision first. One worker's build thread
+        # settles its own windows in order, but a window chained on
+        # ANOTHER worker's tail could otherwise beat that worker's build
+        # here and read the taint sequence before the phantom it rode on
+        # is announced.
+        if not self._arbiter.wait_turn(work.chain_seq, self._stop):
+            logger.debug("window %d: predecessors unsettled after barrier "
+                         "timeout; taint check may be early", work.chain_seq)
+        external_taint = (work.chained
+                          and self._arbiter.taint_changed(work.taint_seq))
+        if tainted_from is not None:
+            # Windows in flight on OUR tail — any worker's — inherit the
+            # phantom too.
+            self._arbiter.taint()
+        # Our taint decision is made: successors may now make theirs
+        # (they need our taint, not our acks — settle BEFORE the status
+        # batch and ack round below).
+        self._arbiter.mark_settled(work.chain_seq)
         if tainted_from is not None or external_taint:
             start = 0 if external_taint else tainted_from + 1
             for rec in fast[start:]:
@@ -902,8 +948,17 @@ class PipelinedWorker(Worker):
             self.raft.apply(MessageType.EvalUpdate, {"Evals": eval_updates})
         self.stats["t_evalupd_ms"] += (time.perf_counter() - t3) * 1e3
         self.stats["fast"] += len(done)
+        if done:
+            # ONE broker lock round acks the whole window; per-eval races
+            # (redelivered / token rotated) come back as failures instead
+            # of aborting the rest of the window's acks.
+            try:
+                for eval_id, e in self.eval_broker.ack_batch(
+                        [(rec.ev.ID, rec.token) for rec in done]):
+                    logger.debug("worker: ack skipped for %s: %s", eval_id, e)
+            except Exception:
+                logger.exception("worker: window ack failed")
         for rec in done:
-            self._send_ack(rec.ev.ID, rec.token)
             if rec.span is not None:
                 rec.span.set_attr("path", "fast")
                 rec.span.finish()
@@ -1050,6 +1105,12 @@ class PipelinedWorker(Worker):
         instead of initiating them. Every separate host sync costs a ~95ms
         round trip on the axon tunnel, so the drain never pays more than
         one. Returns one CompactResult per fast rec, in chain order."""
+        # Failure seam: a worker dying mid-window (process kill, tunnel
+        # drop during the fetch) must nack the window for exactly-once
+        # redelivery and taint the chain for a coherent rebase — the
+        # chaos schedule in tests/test_chaos_schedules.py drives it.
+        if failpoints.fire("worker.window.drain") == "drop":
+            raise failpoints.FailpointError("worker.window.drain")
         plan = work.drain
         out: list = [None] * len(plan.layout)
         fetched = {}

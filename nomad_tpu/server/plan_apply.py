@@ -363,11 +363,15 @@ class PlanApplier:
                 try:
                     pending = self.plan_queue.dequeue(timeout=0.5)
                     batch = [pending] if pending is not None else []
-                    while pending is not None and len(batch) < _APPLY_BATCH:
-                        nxt = self.plan_queue.dequeue(timeout=1e-4)
-                        if nxt is None:
-                            break
-                        batch.append(nxt)
+                    if pending is not None and len(batch) < _APPLY_BATCH:
+                        # ONE lock hold drains the rest of the group:
+                        # workers enqueue whole windows atomically
+                        # (PlanQueue.enqueue_all), so the group is either
+                        # already there or not coming this iteration —
+                        # per-plan timed dequeues only convoyed the lock
+                        # against concurrently submitting workers.
+                        batch.extend(self.plan_queue.dequeue_ready(
+                            _APPLY_BATCH - len(batch)))
                 except RuntimeError:
                     return  # queue disabled
                 live = []

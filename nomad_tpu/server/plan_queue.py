@@ -82,6 +82,41 @@ class PlanQueue:
             self._cond.notify_all()
             return pending
 
+    def enqueue_all(self, plans: List[Plan]) -> List[PendingPlan]:
+        """Enqueue a window's plans under ONE lock hold / ONE wakeup.
+        A pipelined worker submits its window back-to-back; per-plan lock
+        rounds convoy with a second submitting worker and interleave the
+        two windows' plans arbitrarily. One critical section keeps each
+        window contiguous in arrival order (same-priority plans pop FIFO),
+        which is the order the chain dispatched them in."""
+        with self._lock:
+            if not self._enabled:
+                raise RuntimeError("plan queue is disabled")
+            out: List[PendingPlan] = []
+            for plan in plans:
+                pending = PendingPlan(plan)
+                heapq.heappush(self._heap,
+                               (-plan.Priority, next(self._seq), pending))
+                out.append(pending)
+            self.stats["Depth"] += len(out)
+            self._cond.notify_all()
+            return out
+
+    def dequeue_ready(self, max_count: int) -> List[PendingPlan]:
+        """Pop up to max_count queued plans under ONE lock hold, without
+        waiting (the applier's group drain: per-plan dequeue rounds on
+        the serialization point convoy with concurrently submitting
+        workers)."""
+        out: List[PendingPlan] = []
+        with self._lock:
+            if not self._enabled:
+                raise RuntimeError("plan queue is disabled")
+            while self._heap and len(out) < max_count:
+                _, _, pending = heapq.heappop(self._heap)
+                out.append(pending)
+            self.stats["Depth"] -= len(out)
+        return out
+
     def dequeue(self, timeout: Optional[float] = None) -> Optional[PendingPlan]:
         """(reference: plan_queue.go:126-152)"""
         end = None if not timeout else time.monotonic() + timeout

@@ -294,7 +294,14 @@ class Server:
         self._restore_evals()
         self._restore_periodic_dispatcher()
 
-        # Workers
+        # Workers. Pipelined workers share ONE chain arbiter per
+        # leadership term: their windows interleave on a single coherent
+        # device usage chain (worker B's kernels see worker A's in-flight
+        # placements) instead of each keeping a private chain that the
+        # plan applier then bounces. Fresh per term — a prior term's
+        # taint/pending state must not leak into the new leader's chain.
+        from nomad_tpu.tensor.node_table import ChainArbiter
+        arbiter = ChainArbiter(self.tindex.nt)
         schedulers = list(self.config.enabled_schedulers) + [JobTypeCore]
         for i in range(self.config.num_schedulers):
             # The pipelined fast path IS the TPU engine; a non-default
@@ -309,7 +316,8 @@ class Server:
                                     self.tindex, schedulers,
                                     window=self.config.scheduler_window,
                                     host_placement=self.config
-                                    .host_placement)
+                                    .host_placement,
+                                    chain_arbiter=arbiter)
             else:
                 w = Worker(self.raft, self.eval_broker, self.plan_queue,
                            self.blocked_evals, self.tindex, schedulers)

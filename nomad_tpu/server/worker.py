@@ -261,6 +261,10 @@ class Worker:
         self.schedulers = schedulers or ["service", "batch", "system"]
         self.scheduler_impl = "tpu"  # or "cpu-reference" (bench denominator)
         self.backend = backend or LocalBackend(raft, eval_broker, plan_queue)
+        # Stable identity for per-worker observability (sched-stats keys
+        # its report by this) and stage-thread names; start() overwrites
+        # it with the server-assigned name.
+        self.name = "worker"
         self._stop = threading.Event()
         # Share our stop event with a backend that paces on one (the
         # RemoteBackend's leaderless/error backoffs), so stop() wakes a
@@ -279,6 +283,7 @@ class Worker:
 
     # ------------------------------------------------------------- lifecycle
     def start(self, name: str = "worker") -> None:
+        self.name = name
         self._stop.clear()
         self._thread = threading.Thread(target=self.run, daemon=True, name=name)
         self._thread.start()

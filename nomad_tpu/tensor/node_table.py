@@ -19,10 +19,12 @@ resident, delta-scatter updates, never re-ship the table).
 from __future__ import annotations
 
 import threading
+import time
 from typing import Dict, List, Optional, Sequence, Set, Tuple
 
 import numpy as np
 
+from nomad_tpu.analysis import guarded_by
 from nomad_tpu.structs import Allocation, Node, Resources
 from nomad_tpu.structs.structs import NodeStatusReady
 
@@ -405,6 +407,281 @@ class NodeTensor:
             if class_ok is not None:
                 mask &= class_ok[self.class_ids]
             return mask
+
+
+# Force a chain rebase after this many chained windows: the chain misses
+# slow-path/fallback commits (undercount — the applier catches any
+# oversubscription) and evictions (overcount — spurious blocked evals), so
+# its drift is bounded even through a storm that never pauses.
+REBASE_WINDOWS = 256
+
+
+class ChainLease:
+    """One window's exclusive hold on the shared device usage chain.
+
+    Returned by :meth:`ChainArbiter.acquire`; carries the usage array the
+    window's kernels must chain on (``None`` = committed usage from the
+    table), the arbiter's taint sequence at acquire time (windows in
+    flight compare it at finish to detect phantom usage raised under
+    them), and the node-table row epoch observed at chain-validation time
+    (a row changing identity mid-dispatch must still rebase the NEXT
+    window). The holder ends the lease with exactly one of
+    :meth:`ChainArbiter.publish` (fast evals dispatched — the window is
+    now in flight) or :meth:`ChainArbiter.abort` (nothing dispatched)."""
+
+    __slots__ = ("chain", "taint_seq", "epoch", "rebased", "released",
+                 "seq")
+
+    def __init__(self, chain, taint_seq: int, epoch: int, rebased: bool):
+        self.chain = chain
+        self.taint_seq = taint_seq
+        self.epoch = epoch
+        self.rebased = rebased
+        self.released = False  # publish/abort happened (one-shot)
+        self.seq = 0           # chain position, assigned at publish
+
+
+class ChainArbiter:
+    """Arbiter of the cross-worker device usage chain.
+
+    N pipelined workers place optimistically against one node table; their
+    windows chain each kernel on the previous window's ``usage_after`` so
+    every placement sees every placement dispatched before it — regardless
+    of which worker dispatched it. Without arbitration, two workers each
+    keep a PRIVATE chain from committed usage: neither sees the other's
+    in-flight placements, both argmax onto the same best rows, and the
+    plan applier bounces half the plans as partial commits (the measured
+    2-worker collapse). The arbiter serializes only the chain handoff:
+
+      * ``acquire`` — block until no other window is mid-dispatch, decide
+        whether the tail is still valid (taint/epoch/depth/drained checks,
+        previously per-worker ``_usage_chain``), and hand the tail out as
+        a :class:`ChainLease`.
+      * ``publish`` — install the window's ``usage_after`` as the new
+        tail and count the window in flight; the next ``acquire`` (any
+        worker) chains on it.
+      * ``taint`` / ``finish_window`` — a window that ends with stale or
+        fallback records left phantom usage in the chain; the taint bumps
+        the sequence (in-flight windows quarantine their squeezed evals
+        at finish) and marks the tail dirty so the next ``acquire`` drains
+        ALL lease holders — across every worker — and rebases onto
+        committed state coherently.
+
+    Dispatch serialization is not a scaling loss: the dispatch stage is
+    GIL-bound Python, so two workers' dispatches could not run
+    concurrently anyway — the win is that their drain fetches (GIL
+    released) and build stages interleave on a chain that stays
+    coherent."""
+
+    _concurrency = guarded_by(
+        "_cond", "_tail", "_tail_epoch", "_holder", "_pending",
+        "_windows_since_rebase", "_dirty", "_taint_seq", "_published_seq",
+        "_settled_seq")
+
+    def __init__(self, nt: NodeTensor, rebase_windows: int = REBASE_WINDOWS):
+        self.nt = nt
+        self.rebase_windows = rebase_windows
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._tail = None            # usage_after of the last dispatched window
+        self._tail_epoch = -1        # nt.row_epoch the tail was validated at
+        self._holder: Optional[str] = None  # window mid-dispatch (lease out)
+        self._pending = 0            # published windows not yet finished
+        self._windows_since_rebase = 0
+        self._dirty = False          # tail carries phantom usage: rebase next
+        self._taint_seq = 0
+        # Chain-order finish barrier: windows SETTLE (make their phantom-
+        # usage quarantine decision) in publish order, across workers.
+        self._published_seq = 0      # windows published so far
+        self._settled_seq = 0        # highest contiguously settled window
+        self._drained = threading.Event()  # pending == 0 (across all workers)
+        self._drained.set()
+
+    # ------------------------------------------------------------- leasing
+    def acquire(self, stop: Optional[threading.Event] = None,
+                holder: str = "", drain_timeout: float = 60.0) -> ChainLease:
+        """Take the window lease, waiting out any other worker's dispatch.
+
+        Rebase decisions (all previously per-worker, now global): a dirty
+        or depth-limited tail waits out EVERY in-flight window — any
+        worker's — before restarting from committed state; an epoch/shape
+        mismatch or a fully drained pipeline rebases immediately
+        (committed state is strictly fresher once everything landed).
+        The drain wait is bounded: a wedged window must not wedge every
+        worker, and rebasing onto committed state early is always safe —
+        the plan applier re-verifies every placement."""
+        nt = self.nt
+        with self._cond:
+            while self._holder is not None:
+                if stop is not None and stop.is_set():
+                    raise RuntimeError("chain arbiter: worker stopping")
+                self._cond.wait(0.1)
+            self._holder = holder or "window"
+            dirty = self._dirty
+            self._dirty = False
+            chain = self._tail
+            if chain is not None and dirty:
+                # Phantom usage baked into the tail: wait the in-flight
+                # windows out (their commits land in the host mirror),
+                # then restart from committed state.
+                self._wait_drained_locked(stop, drain_timeout)
+                chain = None
+            if chain is not None and (chain.shape[0] != nt.n_rows
+                                      or self._tail_epoch != nt.row_epoch):
+                # Table resized OR a row changed identity (node removed /
+                # freed row reused): the chain may carry a departed
+                # node's usage on a row that now belongs to someone else.
+                chain = None
+            if chain is not None \
+                    and self._windows_since_rebase >= self.rebase_windows:
+                # Bound chain drift: drain the pipeline, then restart.
+                self._wait_drained_locked(stop, drain_timeout)
+                chain = None
+            if chain is not None and self._pending == 0:
+                # Pipeline is empty: everything this chain carries has
+                # committed into the host mirror, so committed state is
+                # strictly fresher (it also includes slow-path/fallback
+                # commits the chain missed).
+                chain = None
+            rebased = self._tail is not None and chain is None
+            if chain is None:
+                self._tail = None
+                self._windows_since_rebase = 0
+            return ChainLease(chain=chain, taint_seq=self._taint_seq,
+                              epoch=nt.row_epoch, rebased=rebased)
+
+    def publish(self, lease: ChainLease, usage_after) -> None:
+        """Install the dispatched window's usage tail and count it in
+        flight; releases the dispatch lease."""
+        with self._cond:
+            if lease.released:
+                return
+            lease.released = True
+            self._published_seq += 1
+            lease.seq = self._published_seq
+            self._tail = usage_after
+            self._tail_epoch = lease.epoch
+            self._windows_since_rebase += 1
+            self._pending += 1
+            self._drained.clear()
+            self._holder = None
+            self._cond.notify_all()
+
+    def abort(self, lease: ChainLease) -> None:
+        """Release the dispatch lease without publishing (the window had
+        no fast evals, or dispatch failed before any kernel launched).
+        One-shot like publish: a double release must not free a lease
+        another worker has since acquired."""
+        with self._cond:
+            if lease.released:
+                return
+            lease.released = True
+            self._holder = None
+            self._cond.notify_all()
+
+    # ------------------------------------------------------ window lifetime
+    def finish_window(self) -> bool:
+        """A published window fully finished (built, acked or nacked).
+        Returns True when that drained the pipeline across ALL workers."""
+        with self._cond:
+            self._pending = max(0, self._pending - 1)
+            drained = self._pending == 0
+            if drained:
+                self._drained.set()
+                self._cond.notify_all()
+            return drained
+
+    def taint(self) -> None:
+        """A window ended with stale/fallback records: its chained kernel
+        placements never commit as dispatched. Windows in flight on the
+        tainted tail detect this via the sequence bump; the next acquire
+        sees the dirty flag and rebases."""
+        with self._cond:
+            self._taint_seq += 1
+            self._dirty = True
+
+    def taint_changed(self, seq: int) -> bool:
+        with self._cond:
+            return self._taint_seq != seq
+
+    def wait_turn(self, seq: int, stop: Optional[threading.Event] = None,
+                  timeout: float = 60.0) -> bool:
+        """Block until every window published BEFORE chain position `seq`
+        has SETTLED — made its phantom-usage quarantine decision and
+        raised any taint. One build thread per worker settles its own
+        windows in order, but with N workers a window chained on another
+        worker's tail can otherwise finish first and consult the taint
+        sequence before the tail owner raises it — parking squeezed evals
+        as blocked on capacity that was never really taken. Bounded: a
+        wedged predecessor must not wedge every worker, and proceeding
+        early only risks the (rare, logged) missed-quarantine the barrier
+        normally closes."""
+        deadline = time.monotonic() + timeout
+        with self._cond:
+            while self._settled_seq < seq - 1:
+                if stop is not None and stop.is_set():
+                    return False
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return False
+                self._cond.wait(min(remaining, 0.1))
+            return True
+
+    def mark_settled(self, seq: int) -> None:
+        """The window at chain position `seq` made its taint decision;
+        successors may now make theirs. Idempotent (the build loop's
+        finally re-marks windows _finish_fast already settled)."""
+        with self._cond:
+            if seq > self._settled_seq:
+                self._settled_seq = seq
+                self._cond.notify_all()
+
+    def wait_drained(self, timeout: Optional[float] = None) -> bool:
+        """Block until no window is in flight (quiesce for tests/bench)."""
+        return self._drained.wait(timeout)
+
+    def wait_dispatch_idle(self, timeout: float) -> bool:
+        """Park until no window is mid-dispatch (the lease is free),
+        WITHOUT acquiring: a worker waits its turn BEFORE dequeuing evals
+        it could not launch anyway. Dequeue-then-wait holds those evals
+        hostage through the other worker's dispatch — their deadlines
+        burn and the storm splinters into one-eval windows. The lease is
+        only held during dispatch, so a worker parked here still wakes in
+        time to dispatch while the previous window's drain/build (the
+        device RTT and plan-applier wait) run lease-free."""
+        with self._cond:
+            deadline = time.monotonic() + timeout
+            while self._holder is not None:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return False
+                self._cond.wait(min(remaining, 0.05))
+            return True
+
+    @property
+    def pending(self) -> int:
+        with self._cond:
+            return self._pending
+
+    @property
+    def dirty(self) -> bool:
+        with self._cond:
+            return self._dirty
+
+    def _wait_drained_locked(self, stop: Optional[threading.Event],
+                             timeout: float) -> None:
+        """Wait (bounded, stop-aware) for pending == 0 with _lock held.
+        Proceeding before fully drained is safe — it only rebases onto
+        committed state while windows are still landing, which the plan
+        applier's re-verification already tolerates."""
+        deadline = time.monotonic() + timeout
+        while self._pending > 0:
+            if stop is not None and stop.is_set():
+                return
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                return
+            self._cond.wait(min(remaining, 0.1))
 
 
 _BACKEND_CHECKED = False

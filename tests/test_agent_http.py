@@ -335,6 +335,14 @@ def test_debug_sched_stats_exports_worker_schema(dev_agent):
         stats = w["Stats"]
         for key in STATS_COUNTERS + STATS_TIMERS_MS:
             assert key in stats, f"schema key {key} missing from endpoint"
+    # Per-worker stats keyed by WORKER NAME (scaling regressions — one
+    # worker starved, one convoying on the chain lease — are invisible
+    # in the aggregate), names unique.
+    assert all(w["Name"] for w in workers)
+    assert len({w["Name"] for w in workers}) == len(workers)
+    by_worker = out["ByWorker"]
+    for w in pipelined:
+        assert by_worker[w["Name"]] == w["Stats"]
     totals = out["Totals"]
     assert totals["windows"] == sum(
         w["Stats"]["windows"] for w in pipelined)

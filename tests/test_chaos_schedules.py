@@ -152,6 +152,47 @@ class TestSnapshotPersistSchedule:
             cs.shutdown()
 
 
+class TestWindowDrainSchedule:
+    """ISSUE 5 site: the pipelined worker's window drain fetch. A worker
+    killed mid-window (the drain blows up under it) must nack the whole
+    window so the broker redelivers its evals EXACTLY ONCE — no lost
+    evals, no double-placed allocs — and the tainted chain must rebase
+    onto committed state before the redelivered window dispatches."""
+
+    def test_drain_kill_redelivers_window_exactly_once(self):
+        srv = Server(ServerConfig(num_schedulers=1, scheduler_window=8))
+        srv.establish_leadership()
+        try:
+            for _ in range(8):
+                srv.node_register(mock.node())
+            jobs = [make_job() for _ in range(6)]
+            eval_ids = []
+            with ChaosSchedule(name="window-drain") \
+                    .arm(0.0, "worker.window.drain=error:count=1") as sched:
+                sched.join(2.0)
+                for job in jobs:
+                    eval_ids.append(srv.job_register(job)[0])
+                assert wait_for(
+                    lambda: _all_terminal(srv.state, eval_ids),
+                    timeout=30, interval=0.05,
+                    msg="evals terminal after a window-drain kill")
+            snap = failpoints.snapshot()
+            assert snap["worker.window.drain"]["fired"] == 1, \
+                "the drain seam never fired — site renamed?"
+            # Exactly-once redelivery: every eval terminal, every job at
+            # exactly its asked-for live allocs (a double delivery would
+            # overshoot, a lost window would undershoot), no duplicate
+            # alloc IDs, no node oversubscribed.
+            assert_invariants(srv.state, jobs, per_job=PER_JOB,
+                              eval_ids=eval_ids)
+            # The killed window's chain was tainted; the redelivered
+            # window rebased onto committed usage instead of inheriting
+            # the dead window's phantom tail.
+            assert srv.workers[0].stats["rebases"] >= 1
+        finally:
+            srv.shutdown()
+
+
 class TestBlockedWakeupSchedule:
     """ROADMAP candidate site: the blocked-evals capacity wakeup. A lost
     wakeup event (dropped at the seam) strands parked evals ONLY until

@@ -197,19 +197,22 @@ class TestChainRebase:
             w = srv.workers[0]
             nt = srv.tindex.nt
 
-            # Simulate a live chain built against the current table.
+            # Simulate a live chain built against the current table: one
+            # published window in flight, tail validated at this epoch.
             chain = np.zeros((nt.n_rows, 5), dtype=np.float32)
-            w._chain = chain
-            w._chain_epoch = nt.row_epoch
-            w._chained_windows = 1
-            w._drained.clear()  # pipeline "in flight": chain would be kept
-            assert w._usage_chain(nt) is not None
+            arb = w._arbiter
+            lease = arb.acquire()
+            arb.publish(lease, chain)
+            lease = arb.acquire()
+            assert lease.chain is not None  # in flight: chain is kept
+            arb.publish(lease, chain)
 
             # Node leaves; its row goes to the free list (no resize).
             nt.remove_node(nodes[0].ID)
-            w._chain = chain
-            assert w._usage_chain(nt) is None, (
+            lease = arb.acquire()
+            assert lease.chain is None, (
                 "chain must rebase after a row identity change")
+            arb.abort(lease)
         finally:
             srv.shutdown()
 
@@ -266,6 +269,7 @@ class TestStalePhantomUsage:
             # A was abandoned (stale), not acked, not planned.
             assert rec_a.stale
             assert w.stats.get("stale", 0) == 1
+            assert work.published  # fast evals dispatched: window in flight
             # B must NOT be parked as a blocked eval on phantom usage: the
             # node really has 1000 cpu free, so the exact-path re-run
             # places it.
@@ -277,9 +281,14 @@ class TestStalePhantomUsage:
             assert not [e for e in srv.state.evals_by_job(job_b.ID)
                         if e.Status == EvalStatusBlocked]
             # The next window must rebase off committed state instead of
-            # inheriting A's phantom usage.
-            assert w._chain_dirty
-            assert w._usage_chain(srv.tindex.nt) is None
+            # inheriting A's phantom usage (the arbiter is marked dirty,
+            # and a fresh lease — what run()'s next dispatch takes after
+            # the build stage retires this window — carries no chain).
+            assert w._arbiter.dirty
+            w._arbiter.finish_window()  # what _build_loop's finally does
+            lease = w._arbiter.acquire()
+            assert lease.chain is None
+            w._arbiter.abort(lease)
         finally:
             srv.shutdown()
 
@@ -312,9 +321,7 @@ class TestStalePhantomUsage:
             batch1 = w._dequeue_window()
             work1 = w._dispatch_window(batch1)
             assert work1 is not None and len(work1.fast) == 1
-            with w._pending_lock:   # what run() does per dispatched window
-                w._pending_windows += 1
-                w._drained.clear()
+            assert work1.published  # dispatch published the window's tail
 
             # Window 2 dispatches on window 1's (soon-phantom) tail.
             job_b = simple_job(count=1, cpu=600, mem=100)
@@ -323,8 +330,6 @@ class TestStalePhantomUsage:
             work2 = w._dispatch_window(batch2)
             assert work2 is not None and len(work2.fast) == 1
             assert work2.chained
-            with w._pending_lock:
-                w._pending_windows += 1
 
             # Window 1's record goes stale (redelivered) before its build.
             rec_a = work1.fast[0]
@@ -337,6 +342,88 @@ class TestStalePhantomUsage:
             # on the exact path and places for real.
             work2.packed = w._drain_window(work2)
             w._finish_fast(work2)
+            e_b = srv.state.eval_by_id(eval_b)
+            assert e_b is not None and e_b.Status == EvalStatusComplete
+            assert len([a for a in srv.state.allocs_by_job(job_b.ID)
+                        if not a.terminal_status()]) == 1
+            assert not [e for e in srv.state.evals_by_job(job_b.ID)
+                        if e.Status == EvalStatusBlocked]
+        finally:
+            srv.shutdown()
+
+
+class TestCrossWorkerTaintBarrier:
+    def test_quarantine_waits_for_predecessor_taint(self):
+        """TWO workers share the chain arbiter: worker B's window rides
+        worker A's (soon-phantom) tail, and B's build races ahead of A's.
+        B must BLOCK at the chain-order barrier until A announces its
+        taint — otherwise B reads a stale taint sequence and parks its
+        squeezed eval as a blocked eval no capacity event will unblock."""
+        import threading
+
+        from nomad_tpu.server.pipelined_worker import PipelinedWorker
+        from nomad_tpu.structs.structs import EvalStatusBlocked
+        from nomad_tpu.tensor.node_table import ChainArbiter
+
+        srv = Server(ServerConfig(num_schedulers=0,
+                                  pipelined_scheduling=True,
+                                  scheduler_window=16))
+        srv.establish_leadership()
+        try:
+            node = mock.node()
+            node.Resources.CPU = 1000
+            node.Resources.MemoryMB = 4000
+            node.Reserved = None
+            srv.node_register(node)
+
+            arb = ChainArbiter(srv.tindex.nt)
+            wa = PipelinedWorker(srv.raft, srv.eval_broker, srv.plan_queue,
+                                 srv.blocked_evals, srv.tindex,
+                                 ["service", "batch", "system"], window=16,
+                                 chain_arbiter=arb)
+            wb = PipelinedWorker(srv.raft, srv.eval_broker, srv.plan_queue,
+                                 srv.blocked_evals, srv.tindex,
+                                 ["service", "batch", "system"], window=16,
+                                 chain_arbiter=arb)
+
+            job_a = simple_job(count=1, cpu=600, mem=100)
+            eval_a, _, _ = srv.job_register(job_a)
+            work_a = wa._dispatch_window(wa._dequeue_window())
+            assert work_a is not None and work_a.published
+
+            job_b = simple_job(count=1, cpu=600, mem=100)
+            eval_b, _, _ = srv.job_register(job_b)
+            work_b = wb._dispatch_window(wb._dequeue_window())
+            assert work_b is not None and work_b.chained
+            assert work_b.chain_seq == work_a.chain_seq + 1
+
+            # A's record goes stale (redelivered) before either builds.
+            rec_a = work_a.fast[0]
+            srv.eval_broker.nack(rec_a.ev.ID, rec_a.token)
+
+            # B's build runs FIRST — it must park at the barrier.
+            work_b.packed = wb._drain_window(work_b)
+            b_done = threading.Event()
+
+            def finish_b():
+                wb._finish_fast(work_b)
+                b_done.set()
+
+            t = threading.Thread(target=finish_b, daemon=True,
+                                 name="test-finish-b")
+            t.start()
+            assert not b_done.wait(0.5), \
+                "B settled before A announced its taint"
+
+            # A's build settles: stale record, taint raised, barrier opens.
+            work_a.packed = wa._drain_window(work_a)
+            wa._finish_fast(work_a)
+            assert rec_a.stale
+            assert b_done.wait(10), "B never unblocked from the barrier"
+            t.join(5)
+
+            # B detected the external taint and re-ran on the exact path:
+            # placed for real, not parked blocked on phantom usage.
             e_b = srv.state.eval_by_id(eval_b)
             assert e_b is not None and e_b.Status == EvalStatusComplete
             assert len([a for a in srv.state.allocs_by_job(job_b.ID)
@@ -456,7 +543,7 @@ class TestFastSlowEquivalence:
                         w._finish_fast(work2)
                         assert w.stats["fallback"] == 1, \
                             "the forced-fallback record never re-ran"
-                        assert w._chain_dirty, \
+                        assert w._arbiter.dirty, \
                             "fallback must taint the chain for rebase"
                     else:
                         for ev, token in batch2:
@@ -472,6 +559,72 @@ class TestFastSlowEquivalence:
         flat = [t for allocs in results["fast"].values() for t in allocs]
         assert any(score > 0 for _, _, score, _ in flat)
         assert any(ports == [("http", 12345)] for _, _, _, ports in flat)
+
+
+class TestWorkerScalingEquivalence:
+    """ISSUE 5 satellite: the SAME fixed storm run with 1 and with 2
+    pipelined workers (sharing one ChainArbiter via the server) must end
+    in the same place: no lost evals, no double-placed allocs, and an
+    IDENTICAL final placed count. The storm exhausts the fleet with
+    uniform demands, so the capacity-limited total is order-independent
+    — window splits between workers cannot change it, only break it."""
+
+    N_JOBS = 8
+    PER_JOB = 3
+    CPU = 100  # 4 nodes x 500 cpu / 100 = 20 slots for 24 asks
+
+    def _fleet(self):
+        nodes = []
+        for _ in range(4):
+            node = mock.node()
+            node.Resources.CPU = 500
+            node.Resources.MemoryMB = 2000
+            node.Reserved = None
+            nodes.append(node)
+        return nodes
+
+    def test_one_vs_two_workers_same_storm(self):
+        from nomad_tpu.structs.structs import EvalStatusBlocked
+
+        placed_totals = {}
+        for n_workers in (1, 2):
+            srv = Server(ServerConfig(num_schedulers=n_workers,
+                                      pipelined_scheduling=True,
+                                      scheduler_window=8))
+            srv.establish_leadership()
+            try:
+                for node in self._fleet():
+                    srv.node_register(node)
+                jobs = [simple_job(count=self.PER_JOB, cpu=self.CPU, mem=10)
+                        for _ in range(self.N_JOBS)]
+                eval_ids = [srv.job_register(j)[0] for j in jobs]
+                # No lost evals: every one of the storm's evals reaches a
+                # terminal status even though 4 of the 24 asks exhaust.
+                assert wait_for(lambda: all(
+                    (e := srv.state.eval_by_id(eid)) is not None
+                    and e.Status == EvalStatusComplete
+                    for eid in eval_ids), timeout=30)
+
+                live = [a for a in srv.state.allocs()
+                        if not a.terminal_status()]
+                # No double-placed allocs: unique IDs, nothing over any
+                # job's ask, nothing over any node's capacity.
+                assert len({a.ID for a in live}) == len(live)
+                for job in jobs:
+                    per_job = [a for a in live if a.JobID == job.ID]
+                    assert len(per_job) <= self.PER_JOB, job.ID
+                for node_id, used in total_usage_by_node(srv.state).items():
+                    assert used[0] <= 500 + 1e-6, (node_id, used)
+                # The overflow is parked blocked, not lost or failed.
+                blocked = [e for e in srv.state.evals()
+                           if e.Status == EvalStatusBlocked]
+                assert blocked, "exhausted asks must park as blocked evals"
+                placed_totals[n_workers] = len(live)
+            finally:
+                srv.shutdown()
+        # Identical final placed count, and exactly the capacity bound:
+        # 4 nodes x (500 cpu / 100 cpu-per-alloc) = 20.
+        assert placed_totals[1] == placed_totals[2] == 20, placed_totals
 
 
 class TestWindowFusion:

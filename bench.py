@@ -70,6 +70,12 @@ C5_PLACEMENTS = int(os.environ.get("BENCH_C5_PLACEMENTS", 20_000))
 RUN_C5 = os.environ.get("BENCH_C5", "1") != "0"
 RUN_C2 = os.environ.get("BENCH_C2", "1") != "0"
 RUN_C4 = os.environ.get("BENCH_C4", "1") != "0"
+# Config 4 (system scheduler) shape: 2 small warmups + one full-size warm
+# storm (C4_EVALS) + C4_REPS x C4_EVALS timed + 2 probes = 73 system jobs
+# at the defaults (BASELINE names the 50-job storm; the extra warm storm
+# is the same compile treatment every served config gets).
+C4_EVALS = int(os.environ.get("BENCH_C4_EVALS", 23))
+C4_REPS = 2
 # Placement-parity gate shape (bench_placement_parity).
 PARITY_NODES = 1000
 PARITY_EVALS = 40
@@ -83,12 +89,18 @@ def _apply_smoke():
     from a smoke run are NOT comparable to the headline shapes."""
     global N_NODES, N_PLACEMENTS, N_REPS, CPU_REF_EVALS
     global RUN_C2, RUN_C4, RUN_C5, PARITY_NODES, PARITY_EVALS
-    global SCALING_NODES, SCALING_EVALS
+    global SCALING_NODES, SCALING_EVALS, C4_EVALS
     N_NODES = min(N_NODES, 512)
     N_PLACEMENTS = min(N_PLACEMENTS, 2000)   # 40 evals @ PER_EVAL=50
     N_REPS = min(N_REPS, 3)
     CPU_REF_EVALS = min(CPU_REF_EVALS, 6)
-    RUN_C2 = RUN_C4 = RUN_C5 = False
+    RUN_C2 = RUN_C5 = False
+    # The system config STAYS on at smoke scale (512-node sweeps, 4
+    # timed evals): the tensor-sweep path has no other in-tree perf
+    # gate, so a system-path regression must surface in every smoke
+    # JSON, not just full runs. ~5s of the <60s budget.
+    RUN_C4 = True
+    C4_EVALS = min(C4_EVALS, 4)
     PARITY_NODES, PARITY_EVALS = 200, 10
     # The scaling sweep is already smoke-shaped; trim the node count and
     # rep length so the whole smoke run stays under its 60s budget. The
@@ -701,13 +713,15 @@ def main(argv=None):
         }
 
     if RUN_C4:
-        # Reuse the headline node set (same 10k-node shape). 2 warm + 2x23
-        # timed + 2 probes = 50 system jobs total, per BASELINE.
+        # Reuse the headline node set (same 10k-node shape; 512 at
+        # --smoke). 2 warm + 2x23 timed + 2 probes = 50 system jobs
+        # total at full shape, per BASELINE.
         rate, placed, p50, rep_rates, storm_pct = bench_served_config(
-            nodes, build_system_job, n_evals=23, reps=2, warm=1,
-            latency_probes=2)
+            nodes, build_system_job, n_evals=C4_EVALS, reps=C4_REPS,
+            warm=1, latency_probes=2)
         detail["config4_system"] = {
-            "path": "served", "nodes": N_NODES, "system_jobs": 50,
+            "path": "served", "nodes": N_NODES,
+            "system_jobs": 2 + C4_REPS * C4_EVALS + 2 + C4_EVALS,
             "evals_sec": round(rate, 2),
             "placements_sec": round(rate * N_NODES, 2),
             "placed_per_rep": placed,

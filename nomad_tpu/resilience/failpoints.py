@@ -61,6 +61,10 @@ KNOWN_SITES: Dict[str, str] = {
     "server.blocked.unblock": "server: blocked-evals capacity wakeup "
                               "(drop=lost wakeup event)",
     "rpc.pool.call": "rpc: pooled client call over the wire",
+    "sched.system.emit": "scheduler: system sweep's bulk placement emit "
+                         "(kill a sweep before anything is submitted; the "
+                         "worker must nack and the broker redeliver the "
+                         "eval exactly once with no duplicate allocs)",
     "rpc.server.handle": "rpc: server-side endpoint dispatch",
     "services.sync": "client: service-registry sync push to the servers "
                      "(drop=lost batch; retried next flush)",

@@ -316,6 +316,17 @@ class GenericStack:
         self._cand_mask = cand_mask
         self.elig = elig
 
+    def adopt_shared(self, job: Job, elig: ClassEligibility) -> None:
+        """Wire the stack for a tensor-sweep evaluation: the job plus the
+        table-wide shared eligibility (TensorIndex.shared_elig), WITHOUT
+        set_nodes/set_job's O(cluster) node walk. The candidate set is the
+        sweep's own ready/DC row mask, so _nodes_by_id/_cand_mask stay
+        empty — only the mask-based paths (sweep feasibility,
+        select_on_node for in-place updates) are valid on a stack wired
+        this way."""
+        self.job = job
+        self.elig = elig
+
     # ---------------------------------------------------------- selection
     def select(self, tg: TaskGroup) -> Tuple[Optional[SelectedOption], Resources]:
         opts = self.select_batch([tg])
@@ -1123,6 +1134,9 @@ class SystemStack:
 
     def set_job(self, job: Job) -> None:
         self.inner.set_job(job)
+
+    def adopt_shared(self, job: Job, elig) -> None:
+        self.inner.adopt_shared(job, elig)
 
     def select(self, tg: TaskGroup, node: Node) -> Optional[SelectedOption]:
         option = self.inner.select_on_node(tg, node)

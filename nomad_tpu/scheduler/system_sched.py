@@ -2,19 +2,22 @@
 scheduler/system_sched.go).
 
 System placement is per-specific-node (the diff pins each placement to its
-node), so it uses the host-side single-node fast path — class-memoized
-constraint checks plus a numpy fit — rather than the batched device scan;
-the candidate set per eval is exactly the node list, not a search.
+node), so no scan chain is needed: the whole evaluation is one fused
+feasibility/diff mask over the node axis plus a bulk columnar emit
+(system_sweep.py). The exact per-node path below — class-memoized
+constraint checks plus a numpy fit per pinned node — survives for
+network-ask groups (port bitmaps are host state), deregisters, and as the
+oracle side of the fixed-seed sweep-equivalence gate.
 """
 
 from __future__ import annotations
 
 import logging
 import random
+import time
 from typing import Dict, List, Optional
 
-import numpy as np
-
+from nomad_tpu.telemetry import metrics
 from nomad_tpu.structs import (
     Allocation,
     AllocMetric,
@@ -36,6 +39,7 @@ from nomad_tpu.structs.structs import (
 )
 from nomad_tpu.tensor import TensorIndex, alloc_vec
 
+from . import system_sweep
 from .context import EvalContext
 from .scheduler import Planner, SetStatusError, State
 from .stack import SystemStack
@@ -69,12 +73,16 @@ _HANDLED = (EvalTriggerJobRegister, EvalTriggerNodeUpdate,
 class SystemScheduler:
     def __init__(self, state: State, planner: Planner,
                  tindex: Optional[TensorIndex], logger: logging.Logger,
-                 rng: Optional[random.Random] = None):
+                 rng: Optional[random.Random] = None,
+                 vectorized: bool = True):
         self.state = state
         self.planner = planner
         self.tindex = tindex
         self.logger = logger
         self.rng = rng or random.Random()
+        # Tensor-sweep path switch; False forces the exact per-node path
+        # (the equivalence gate's oracle side).
+        self.vectorized = vectorized
 
         self.eval: Optional[Evaluation] = None
         self.job: Optional[Job] = None
@@ -85,6 +93,10 @@ class SystemScheduler:
         self.failed_tg_allocs: Dict[str, AllocMetric] = {}
         self.nodes = []
         self.node_by_dc: Dict[str, int] = {}
+        # Memoized ready_nodes_in_dcs result: (state, dcs, node_version,
+        # (nodes, dc_map)). Holding the state reference keeps identity
+        # comparison sound (no id() reuse).
+        self._ready_cache: Optional[tuple] = None
 
     def process(self, eval: Evaluation) -> None:
         """(reference: system_sched.go:54-102)"""
@@ -113,13 +125,22 @@ class SystemScheduler:
         if self.tindex is None:
             self.tindex = TensorIndex.from_state(self.state)
         self.stack = SystemStack(self.ctx, self.tindex)
+        use_sweep = (self.vectorized
+                     and system_sweep.sweep_applicable(self.job, self.tindex))
         if self.job is not None:
-            self.nodes, self.node_by_dc = ready_nodes_in_dcs(
-                self.state, self.job.Datacenters)
-            self.stack.set_nodes(self.nodes)
-            self.stack.set_job(self.job)
+            if use_sweep:
+                # Tensor-sweep wiring: the shared table-wide eligibility
+                # replaces set_nodes/set_job's O(cluster) walk; the node
+                # set IS the tensor's ready/DC mask.
+                self.stack.adopt_shared(
+                    self.job, self.tindex.shared_elig(self.state))
+            else:
+                self.nodes, self.node_by_dc = self._ready_nodes(
+                    self.job.Datacenters)
+                self.stack.set_nodes(self.nodes)
+                self.stack.set_job(self.job)
 
-        self._compute_job_allocs()
+        self._compute_job_allocs(use_sweep)
 
         if self.plan.is_no_op():
             return True
@@ -161,6 +182,33 @@ class SystemScheduler:
                 or plan.AllAtOnce:
             return self.planner.submit_plan(plan)
 
+        sweep = getattr(plan, "_sweep", None)
+        if (sweep is not None and not plan.NodeUpdate
+                and len(sweep.node_ids) == len(plan.NodeAllocation)):
+            # Columnar chunking: the sweep descriptor already lists every
+            # placed node in row order, so chunks slice it instead of
+            # re-walking the NodeAllocation dict — and each chunk carries
+            # its slice so the applier's one-vector-op verify survives
+            # chunking.
+            chunks = []
+            node_alloc = plan.NodeAllocation
+            ids = sweep.node_ids
+            i, total = 0, len(ids)
+            while i < total:
+                j, count = i, 0
+                while j < total and count < SYSTEM_PLAN_CHUNK:
+                    count += len(node_alloc[ids[j]])
+                    j += 1
+                chunk = Plan(EvalID=plan.EvalID, Priority=plan.Priority,
+                             Job=plan.Job, AllAtOnce=plan.AllAtOnce)
+                chunk.NodeAllocation = {nid: node_alloc[nid]
+                                        for nid in ids[i:j]}
+                chunk._sweep = sweep.slice(i, j)
+                chunks.append(chunk)
+                i = j
+            chunks[0].Annotations = plan.Annotations
+            return self._submit_chunks(chunks)
+
         chunks: List[Plan] = []
         current = None
         count = 0
@@ -189,7 +237,11 @@ class SystemScheduler:
                 current.NodeUpdate[node_id] = updates
                 count += len(updates)
         chunks[0].Annotations = plan.Annotations
+        return self._submit_chunks(chunks)
 
+    def _submit_chunks(self, chunks: List[Plan]):
+        """Submit a chunk sequence through the pipelined planner seam and
+        merge the per-chunk results."""
         submit = getattr(self.planner, "submit_plans", None)
         if submit is not None:
             results, new_state = submit(chunks)
@@ -211,8 +263,40 @@ class SystemScheduler:
             merged.AllocIndex = max(merged.AllocIndex, r.AllocIndex)
         return merged, new_state
 
-    def _compute_job_allocs(self) -> None:
-        """(reference: system_sched.go:165-216)"""
+    def _ready_nodes(self, dcs) -> tuple:
+        """ready_nodes_in_dcs, memoized per (state snapshot, DC list, node
+        population): the retry loop re-runs _process up to retry_max times
+        per eval, and each attempt re-walked every node in state — twice
+        the O(cluster) cost for zero new information. The tensor's
+        node_version invalidates the memo when the population actually
+        moves (covers live-store harnesses, where the state object is
+        mutable); only an attached index sees those moves, so unattached
+        ones skip the memo."""
+        if self.tindex is None or not self.tindex.attached:
+            return ready_nodes_in_dcs(self.state, dcs)
+        ver = self.tindex.nt.node_version
+        key = (self.state, tuple(dcs), ver)
+        cached = self._ready_cache
+        if cached is not None and cached[0] is key[0] \
+                and cached[1] == key[1] and cached[2] == key[2]:
+            return cached[3]
+        res = ready_nodes_in_dcs(self.state, dcs)
+        self._ready_cache = key + (res,)
+        return res
+
+    def _compute_job_allocs(self, use_sweep: bool = False) -> None:
+        """(reference: system_sched.go:165-216). The tensor-sweep path
+        (system_sweep.compute_job_allocs) computes the same diff + emit as
+        row math over the node tensor; the exact per-node path below is
+        kept for network-ask groups, deregisters, and as the equivalence
+        oracle."""
+        if use_sweep:
+            t0 = time.monotonic()
+            system_sweep.compute_job_allocs(self)
+            metrics.measure_since(("nomad", "sched", "system", "sweep"), t0)
+            metrics.incr_counter(("nomad", "sched", "system", "fast"))
+            return
+        metrics.incr_counter(("nomad", "sched", "system", "exact"))
         allocs = self.state.allocs_by_job(self.eval.JobID)
         allocs = [a for a in allocs if not a.terminal_status()]
         tainted = tainted_nodes(self.state, allocs)

@@ -1,0 +1,435 @@
+"""Vectorized system-job sweep: fused feasibility, tensor diff, bulk emit.
+
+A system evaluation places one allocation per feasible node — the most
+TPU-shaped workload in the repo (one fused mask over the whole node axis,
+no scan chain: each placement is pinned to its node, so no placement's
+decision depends on another's winner). The exact path walks Python per
+node: `diff_system_allocs` builds an AllocTuple + `Allocation(NodeID=...)`
+per node, `_compute_placements` runs a per-pair select and materializes a
+SelectedOption per node. At 10k nodes that is tens of thousands of object
+constructions per evaluation before a single allocation exists.
+
+This module computes the same decision as row math on the node tensor:
+
+  existing  [name -> rows]   bitmap of rows already carrying the instance
+  place     = eligible & feasible & ~existing      (per task-group instance)
+  stop      = existing & (tainted | ~required)     (classified per alloc)
+  update    = existing & version-changed           (exact in-place attempt)
+
+and emits the placements as a columnar batch — shared per-task-group
+task-resource templates, one shared metric snapshot, one shared resource
+vector — plus a :class:`SweepBatch` descriptor (node-row indices + per-row
+demand) that the plan applier verifies as ONE vectorized capacity check
+per chunk instead of a per-node Python walk.
+
+The exact per-node path survives in system_sched.py for network-ask
+groups (port bitmaps are host state) and as the oracle for the
+fixed-seed equivalence gate (tests/test_system_sweep_equivalence.py).
+
+Semantics contract: bug-for-bug parity with the exact path on a quiesced
+state — same stops (with the same descriptions), same placements, same
+in-place updates, same FailedTGAllocs metrics. The node set derives from
+the live tensor mirror rather than the snapshot's node walk; the mirror
+is updated synchronously at state commit, so it is at least as fresh as
+any snapshot and the plan applier's re-verification owns the outcome of
+any in-flight divergence (the same contract the windowed service path
+documents).
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+from typing import Dict, List
+
+import numpy as np
+
+from nomad_tpu.resilience import failpoints
+from nomad_tpu.structs import Allocation, Resources
+from nomad_tpu.structs.structs import (
+    AllocClientStatusPending,
+    AllocDesiredStatusRun,
+    AllocDesiredStatusStop,
+    JobTypeBatch,
+    generate_uuid,
+)
+from nomad_tpu.telemetry import metrics
+from nomad_tpu.tensor import alloc_vec, resources_vec
+from nomad_tpu.tensor.node_table import DIM_NAMES, RES_DIMS
+
+from .util import (
+    ALLOC_NODE_TAINTED,
+    ALLOC_NOT_NEEDED,
+    ALLOC_UPDATING,
+    AllocTuple,
+    attempt_inplace_updates,
+    materialize_task_groups,
+    tainted_nodes,
+    task_group_constraints,
+)
+
+
+@dataclass
+class SweepBatch:
+    """Columnar descriptor of a system sweep's placements, attached to the
+    plan as ``plan._sweep`` (an underscore attribute, like
+    ``alloc._resvec_cache``, so RPC serialization never sees it — a remote
+    applier simply falls back to the per-node verify).
+
+    One entry per UNIQUE placed node row, in row order; ``delta`` is the
+    summed demand of every alloc placed on that row (multi-instance task
+    groups fold together). Only rows whose node has NO eviction in the
+    same plan are included — eviction credit depends on verify-time
+    snapshot state, which the per-node path owns. ``epoch``/``n_rows``
+    pin the tensor generation: a row that changed identity between emit
+    and verify invalidates the whole descriptor (the applier falls back,
+    it never mis-verifies)."""
+
+    rows: np.ndarray        # [U] int64, sorted unique node rows
+    node_ids: List[str]     # [U] aligned node IDs
+    delta: np.ndarray       # [U, RES_DIMS] float32 summed placed demand
+    epoch: int              # nt.row_epoch at emit
+    n_rows: int             # nt.n_rows at emit
+
+    def slice(self, lo: int, hi: int) -> "SweepBatch":
+        """Chunk view for _submit_chunked: shares the backing arrays."""
+        return SweepBatch(rows=self.rows[lo:hi],
+                          node_ids=self.node_ids[lo:hi],
+                          delta=self.delta[lo:hi],
+                          epoch=self.epoch, n_rows=self.n_rows)
+
+
+# Escape hatch for A/B benchmarks and oracle runs: True routes every
+# system eval onto the exact per-node path regardless of applicability.
+FORCE_EXACT = False
+
+
+def sweep_applicable(job, tindex) -> bool:
+    """The tensor-sweep path serves every system eval except: no job (a
+    deregister's stop-all walk is O(allocs), not hot) and network asks
+    anywhere in the job (port bitmaps are sequential host state — the
+    exact per-node path is kept for those, reference: rank.go:150-240's
+    network-check-the-winners-only shape)."""
+    if FORCE_EXACT or job is None or tindex is None:
+        return False
+    for tg in job.TaskGroups:
+        for task in tg.Tasks:
+            if task.Resources is not None and task.Resources.Networks:
+                return False
+    return True
+
+
+def compute_job_allocs(sched) -> None:
+    """Vectorized body of SystemScheduler._compute_job_allocs for a
+    sweep-applicable eval. Mutates sched.plan / sched.failed_tg_allocs
+    exactly like the exact path; attaches the plan's SweepBatch. The
+    caller guarantees sweep_applicable() and a stack wired via
+    adopt_shared (in-place updates run through stack.select_on_node)."""
+    t0 = time.monotonic()
+    job = sched.job
+    state = sched.state
+    plan = sched.plan
+    ctx = sched.ctx
+    nt = sched.tindex.nt
+    elig = sched.stack.inner.elig
+    m = ctx.metrics
+
+    allocs = [a for a in state.allocs_by_job(sched.eval.JobID)
+              if not a.terminal_status()]
+    tainted = tainted_nodes(state, allocs)
+    required = materialize_task_groups(job)
+
+    # ---- tensor diff: classify existing allocs (O(allocations of this
+    # job), the one loop that inherently needs the alloc objects — stops
+    # and updates carry them into the plan).
+    row_of = nt.row_of
+    has_name_rows: Dict[str, List[int]] = {name: [] for name in required}
+    updates: List[AllocTuple] = []
+    job_mod = job.JobModifyIndex
+    for a in allocs:
+        name = a.Name
+        tg = required.get(name)
+        if tg is not None:
+            row = row_of.get(a.NodeID)
+            if row is not None:
+                # Every alloc of a required name marks the row existing —
+                # including stopped/updated ones (a tainted stop is not
+                # replaced on the same node; an update replaces in place
+                # or via the destructive stop+place below).
+                has_name_rows[name].append(row)
+        if tg is None:
+            desc = ALLOC_NODE_TAINTED if tainted.get(a.NodeID) \
+                else ALLOC_NOT_NEEDED
+            plan.append_update(a, AllocDesiredStatusStop, desc)
+            continue
+        if tainted.get(a.NodeID, False):
+            # Finished batch work stays finished even on a tainted node;
+            # system migrations are stops (diff_system_allocs).
+            if (a.Job is not None and a.Job.Type == JobTypeBatch
+                    and a.ran_successfully()):
+                continue
+            plan.append_update(a, AllocDesiredStatusStop, ALLOC_NODE_TAINTED)
+            continue
+        if a.Job is not None and job_mod != a.Job.JobModifyIndex:
+            updates.append(AllocTuple(name, tg, a))
+        # else: ignore
+
+    # In-place first (non-destructive changes keep the running alloc);
+    # the rest stop + replace on the same node (exact per-alloc path —
+    # updates are O(existing allocs) and need object-level TG diffs).
+    destructive: List[AllocTuple] = []
+    if updates:
+        destructive, _ = attempt_inplace_updates(
+            state, plan, sched.stack.inner, sched.eval.ID, ctx, updates)
+        for tup in destructive:
+            plan.append_update(tup.Alloc, AllocDesiredStatusStop,
+                               ALLOC_UPDATING)
+
+    # ---- fused eligibility: ready & DC membership as one row mask (the
+    # sweep's replacement for the ready_nodes_in_dcs state walk).
+    dcs = job.Datacenters
+    dc_ids = [nt.dc_vocab[d] for d in dcs if d in nt.dc_vocab]
+    elig_mask = nt.eligibility_mask(dc_ids, None)
+
+    # One consistent usage snapshot for the whole sweep (alloc commits
+    # mutate rows in place; same torn-row hazard snapshot_rows documents).
+    with nt._lock:
+        usage0 = nt.usage.astype(np.float64, copy=True)
+        capacity = nt.capacity.astype(np.float64, copy=True)
+    n_snap = usage0.shape[0]
+    job_mask, _, _ = elig.job_mask(job.ID, job.Constraints)
+    # The table can GROW mid-eval (a node registering crosses a
+    # power-of-two boundary), so arrays snapshotted at different moments
+    # may disagree on length. Row indices are stable across growth, so
+    # clamping to the shortest view just defers newly-grown rows to the
+    # next eval (which sees a fresh node_version) — stale-but-safe, never
+    # an out-of-bounds gather.
+    n0 = min(len(elig_mask), n_snap, len(job_mask))
+    if len(elig_mask) > n0:
+        elig_mask = elig_mask[:n0]
+
+    # Destructive replacements re-place on their own node even though the
+    # name is still "existing" there; dropped when the node is no longer
+    # eligible (the exact path's node_by_id miss).
+    destructive_rows: Dict[str, List[int]] = {}
+    for tup in destructive:
+        row = row_of.get(tup.Alloc.NodeID)
+        if row is not None and row < n0 and elig_mask[row]:
+            destructive_rows.setdefault(tup.Name, []).append(row)
+
+    metrics.measure_since(("nomad", "sched", "system", "diff"), t0)
+
+    # ---- per-TG fused feasibility + bulk emit.
+    # No drop semantics at the emit seam: a triggered failpoint always
+    # surfaces as a failed sweep (the worker nacks; the broker redelivers
+    # the eval exactly once — nothing was submitted).
+    if failpoints.fire("sched.system.emit") == "drop":
+        raise failpoints.FailpointError("sched.system.emit")
+    t1 = time.monotonic()
+
+    # In-plan deltas, whole-table: stops subtract, placements (in-place
+    # updates so far, then each TG's winners) add — the batched mirror of
+    # select_on_node's per-node plan walk.
+    eff_delta = np.zeros((n_snap, RES_DIMS), dtype=np.float64)
+    for nid, ups in plan.NodeUpdate.items():
+        row = row_of.get(nid)
+        if row is None or row >= n_snap:
+            continue
+        for u in ups:
+            full = state.alloc_by_id(u.ID) or u
+            eff_delta[row] -= alloc_vec(full)
+    for nid, placed in plan.NodeAllocation.items():
+        row = row_of.get(nid)
+        if row is None or row >= n_snap:
+            continue
+        for a in placed:
+            eff_delta[row] += alloc_vec(a)
+
+    # Group instance names by task group, preserving job.TaskGroups order
+    # (the exact path's by_tg first-appearance order).
+    by_tg: Dict[str, List[str]] = {}
+    tg_obj: Dict[str, object] = {}
+    for name, tg in required.items():
+        by_tg.setdefault(tg.Name, []).append(name)
+        tg_obj[tg.Name] = tg
+
+    any_candidates = bool(destructive_rows) or elig_mask.any()
+    if any_candidates and required:
+        # NodesAvailable: ready-node count per asked datacenter (the
+        # ready_nodes_in_dcs dc_map, computed as one reduction per DC).
+        node_by_dc = {dc: 0 for dc in dcs}
+        for dc in dcs:
+            did = nt.dc_vocab.get(dc)
+            if did is not None:
+                node_by_dc[dc] = int((nt.ready & (nt.dc_ids == did)).sum())
+        m.NodesAvailable = node_by_dc
+
+    node_id_arr = nt.node_id_array()
+    nodes_by_row = elig.nodes_by_row
+    sweep_rows: List[np.ndarray] = []
+    sweep_vecs: List[np.ndarray] = []
+    n_emitted = 0
+
+    for tg_name, names in by_tg.items():
+        tg = tg_obj[tg_name]
+        cons = task_group_constraints(tg)
+        tg_mask, _, _ = elig.tg_mask(job.ID, tg.Name, cons.constraints,
+                                     cons.drivers)
+        # A cached TG mask may predate a table grow; clamp this group's
+        # candidate space to the shortest consistent view (see n0 above).
+        n_eff = min(n0, len(tg_mask))
+        em = elig_mask if n_eff == n0 else elig_mask[:n_eff]
+        demand = resources_vec(cons.size).astype(np.float64)
+        # Per-dimension exhaustion over the whole axis, float64 like the
+        # exact path's fit_lacking; instances of one TG check the same
+        # usage (the exact path computes all of a TG's options before
+        # appending its allocs), while the NEXT TG sees this one's.
+        lacking = (capacity - (usage0 + eff_delta)) < demand[None, :]
+        fits = ~lacking.any(axis=1)
+
+        placed_per_name: List[tuple] = []  # (name, ok_rows ndarray)
+        n_failed = 0
+        for name in names:
+            extra = [r for r in destructive_rows.get(name, ()) if r < n_eff]
+            named = has_name_rows[name]
+            if named or extra:
+                cand_mask = em.copy()
+                if named:
+                    named_arr = np.asarray(named, dtype=np.int64)
+                    cand_mask[named_arr[named_arr < n_eff]] = False
+                rows = np.flatnonzero(cand_mask)
+                if extra:
+                    rows = np.concatenate(
+                        [rows, np.asarray(extra, dtype=np.int64)])
+            else:
+                rows = np.flatnonzero(em)
+            if not len(rows):
+                continue
+            # Metrics: the exact counters select_batch_on_nodes
+            # accumulates over this instance's candidate pairs.
+            m.NodesEvaluated += len(rows)
+            job_ok = job_mask[rows]
+            tg_ok = tg_mask[rows]
+            for sel, label in ((~job_ok, "job constraints"),
+                               ((job_ok & ~tg_ok), "group constraints")):
+                if sel.any():
+                    for r in rows[sel].tolist():
+                        m.filter_node(nodes_by_row.get(r), label)
+            eligible = job_ok & tg_ok
+            ok = eligible & fits[rows]
+            exhausted = eligible & ~fits[rows]
+            n_ex = int(exhausted.sum())
+            if n_ex:
+                m.NodesExhausted += n_ex
+                per_dim = (lacking[rows] & exhausted[:, None]).sum(axis=0)
+                for d, count in enumerate(per_dim.tolist()):
+                    if count:
+                        dim = DIM_NAMES[d]
+                        m.DimensionExhausted[dim] = (
+                            m.DimensionExhausted.get(dim, 0) + count)
+            ok_rows = rows[ok]
+            n_failed += len(rows) - len(ok_rows)
+            if len(ok_rows):
+                placed_per_name.append((name, ok_rows))
+
+        if n_failed:
+            metric = sched.failed_tg_allocs.get(tg.Name)
+            if metric is None:
+                metric = sched.failed_tg_allocs[tg.Name] = m.copy()
+                n_failed -= 1
+            metric.CoalescedFailures += n_failed
+        if not placed_per_name:
+            continue
+
+        # Bulk emit: one frozen task-resources template + one metric
+        # snapshot + one resource vector shared by every alloc of the TG
+        # (the shared_vec/shared_metric trick extended to the whole
+        # sweep; the value-frozen contract is alloc._resvec_cache's).
+        tr_template: Dict[str, Resources] = {}
+        shared_vec = np.zeros(RES_DIMS, dtype=np.float32)
+        for task in tg.Tasks:
+            r = (task.Resources.copy() if task.Resources is not None
+                 else Resources())
+            tr_template[task.Name] = r
+            shared_vec += resources_vec(r)
+        shared_metric = m.copy()
+        node_alloc = plan.NodeAllocation
+        # Template stamping: the dataclass constructor runs ~20 field
+        # assignments + default factories per call, which at 10k
+        # placements is a visible slice of the sweep. One fully-formed
+        # template per TG is cloned by __dict__ copy; only the per-alloc
+        # identity fields (ID, Name, NodeID) and the mutable per-alloc
+        # containers (Services/TaskStates — the client writes into
+        # those) are re-set per clone.
+        template = Allocation(
+            EvalID=sched.eval.ID,
+            JobID=job.ID,
+            TaskGroup=tg.Name,
+            Metrics=shared_metric,
+            TaskResources=tr_template,
+            DesiredStatus=AllocDesiredStatusRun,
+            ClientStatus=AllocClientStatusPending,
+        )
+        template._resvec_cache = shared_vec
+        tmpl_dict = template.__dict__
+        new = object.__new__
+        cls = Allocation
+        for name, ok_rows in placed_per_name:
+            ids = node_id_arr[ok_rows]
+            kept: List[int] = []
+            for k, nid in enumerate(ids.tolist()):
+                if nid is None:
+                    continue  # row freed mid-sweep: exact path skips too
+                alloc = new(cls)
+                alloc.__dict__ = dict(tmpl_dict)
+                alloc.ID = generate_uuid()
+                alloc.Name = name
+                alloc.NodeID = nid
+                alloc.Services = {}
+                alloc.TaskStates = {}
+                bucket = node_alloc.get(nid)
+                if bucket is None:
+                    node_alloc[nid] = [alloc]
+                else:
+                    bucket.append(alloc)
+                kept.append(k)
+            rows_kept = (ok_rows if len(kept) == len(ids)
+                         else ok_rows[kept])
+            if len(rows_kept):
+                n_emitted += len(rows_kept)
+                sweep_rows.append(rows_kept.astype(np.int64, copy=False))
+                sweep_vecs.append(
+                    np.broadcast_to(shared_vec,
+                                    (len(rows_kept), RES_DIMS)))
+                # The next TG's fit sees this one's placements.
+                np.add.at(eff_delta, rows_kept,
+                          shared_vec.astype(np.float64))
+
+    if n_emitted:
+        rows_all = np.concatenate(sweep_rows)
+        vecs_all = np.concatenate(sweep_vecs)
+        ur, inv = np.unique(rows_all, return_inverse=True)
+        delta = np.zeros((len(ur), RES_DIMS), dtype=np.float32)
+        np.add.at(delta, inv, vecs_all)
+        ids = node_id_arr[ur]
+        ids_list = ids.tolist()
+        emitted_per_row = np.bincount(inv, minlength=len(ur))
+        # Descriptor coverage: only rows whose plan state the delta FULLY
+        # describes. Rows with stops stay on the per-node verify path
+        # (eviction credit is verify-time snapshot state), as do rows
+        # whose NodeAllocation carries allocs the sweep didn't emit —
+        # in-place updates on a node that also received a fresh instance
+        # need the exact remove-then-add accounting.
+        keep = np.asarray(
+            [nid not in plan.NodeUpdate
+             and len(plan.NodeAllocation[nid]) == emitted_per_row[k]
+             for k, nid in enumerate(ids_list)], dtype=bool)
+        if not keep.all():
+            ur, delta = ur[keep], delta[keep]
+            ids_list = [nid for nid, k in zip(ids_list, keep.tolist()) if k]
+        plan._sweep = SweepBatch(rows=ur, node_ids=ids_list,
+                                 delta=delta, epoch=nt.row_epoch,
+                                 n_rows=nt.n_rows)
+        metrics.incr_counter(("nomad", "sched", "system", "placed"),
+                             n_emitted)
+    metrics.measure_since(("nomad", "sched", "system", "emit"), t1)

@@ -84,11 +84,38 @@ class OptimisticSnapshot:
         self._added: Dict[str, List[Allocation]] = {}
         self._removed: Set[str] = set()
         self.row_delta: Dict[int, np.ndarray] = {}
+        # Dense in-flight usage overlay, allocated lazily by the first
+        # SWEEP result (a system sweep's 10k placements would otherwise
+        # become 10k per-row dict entries built one _overlay call at a
+        # time). Readers treat it as an additive sibling of row_delta.
+        self.row_dense: Optional[np.ndarray] = None
 
     def apply_result(self, result: PlanResult) -> None:
         for updates in result.NodeUpdate.values():
             for a in updates:
                 self._removed.add(a.ID)
+        sweep = getattr(result, "_sweep", None)
+        if (sweep is not None and self.nt is not None
+                and sweep.n_rows == self.nt.n_rows
+                and sweep.epoch == self.nt.row_epoch):
+            # Columnar sweep result: ONE scatter-add replaces the
+            # per-alloc row overlay. The descriptor covers every
+            # NodeAllocation key (evaluate_plan only attaches it then),
+            # so nothing is missed; _added is still filled per node — the
+            # exact verify path of a LATER plan in the group reads it.
+            if self.row_dense is None:
+                self.row_dense = np.zeros((self.nt.n_rows, RES_DIMS),
+                                          dtype=np.float32)
+            elif self.row_dense.shape[0] < sweep.n_rows:
+                # Table grew since the overlay was allocated; row indices
+                # are stable across growth, so zero-extend.
+                grown = np.zeros((sweep.n_rows, RES_DIMS), dtype=np.float32)
+                grown[:self.row_dense.shape[0]] = self.row_dense
+                self.row_dense = grown
+            np.add.at(self.row_dense, sweep.rows, sweep.delta)
+            for node_id, placed in result.NodeAllocation.items():
+                self._added.setdefault(node_id, []).extend(placed)
+            return
         for node_id, placed in result.NodeAllocation.items():
             self._added.setdefault(node_id, []).extend(placed)
             for a in placed:
@@ -155,7 +182,46 @@ def _vector_fit(snap, plan: Plan, nt, node_ids: List[str]
     row_ids: List[str] = []
     deltas: List[np.ndarray] = []
     overlay = getattr(snap, "row_delta", None) or {}
+    dense = getattr(snap, "row_dense", None)
+    # Row indices are STABLE across table growth (_grow only extends), so
+    # a dense overlay allocated before a grow stays valid for its rows;
+    # rows beyond its bound were grown later and legitimately carry zero
+    # in-flight delta. Reads below bound-check instead of assuming the
+    # shapes match.
+    n_dense = dense.shape[0] if dense is not None else 0
+
+    sweep = getattr(plan, "_sweep", None)
+    if (sweep is not None and len(sweep.rows)
+            and sweep.epoch == nt.row_epoch and sweep.n_rows == nt.n_rows):
+        # Columnar sweep verify: the whole batch is ONE vectorized
+        # capacity check — fresh-UUID, no-network placements with their
+        # per-row demand precomputed at emit, so the per-node delta
+        # assembly loop below has nothing left to derive. Readiness comes
+        # from the tensor mirror, which is updated synchronously at state
+        # commit and therefore at least as fresh as any snapshot; a row
+        # whose identity moved since emit invalidates the descriptor
+        # (epoch guard) and falls back to the per-node walk.
+        srows = sweep.rows
+        d = sweep.delta.astype(np.float32, copy=True)
+        if dense is not None:
+            in_bound = srows < n_dense
+            if in_bound.all():
+                d += dense[srows]
+            elif in_bound.any():
+                d[in_bound] += dense[srows[in_bound]]
+        for row, vec in overlay.items():
+            i = int(np.searchsorted(srows, row))
+            if i < len(srows) and srows[i] == row:
+                d[i] += vec
+        usage, capacity = nt.snapshot_rows(srows)
+        ok = nt.ready[srows] & np.all(usage + d <= capacity, axis=1)
+        for nid, fit in zip(sweep.node_ids, ok.tolist()):
+            fits[nid] = fit
+        metrics.incr_counter(("nomad", "sched", "system", "bulk_verify"))
+
     for nid in node_ids:
+        if nid in fits:
+            continue
         placed = plan.NodeAllocation.get(nid)
         if not placed:
             fits[nid] = True  # evict-only always fits
@@ -191,6 +257,8 @@ def _vector_fit(snap, plan: Plan, nt, node_ids: List[str]
         ov = overlay.get(row)
         if ov is not None:
             delta += ov
+        if dense is not None and row < n_dense:
+            delta += dense[row]
         rows.append(row)
         row_ids.append(nid)
         deltas.append(delta)
@@ -235,6 +303,20 @@ def evaluate_plan(snap, plan: Plan,
     else:
         for nid in exact_ids:
             decided[nid] = _evaluate_node_plan(snap, plan, nid)
+
+    if decided and len(decided) == len(node_ids) \
+            and all(decided.values()):
+        # Everything fits (the healthy-sweep common case): admit the plan
+        # wholesale instead of re-walking 10k node ids to copy dict
+        # entries one at a time. A full-coverage sweep descriptor rides
+        # the result so the optimistic overlay applies it as one scatter.
+        result.NodeUpdate = dict(plan.NodeUpdate)
+        result.NodeAllocation = dict(plan.NodeAllocation)
+        sweep = getattr(plan, "_sweep", None)
+        if sweep is not None \
+                and len(sweep.node_ids) == len(plan.NodeAllocation):
+            result._sweep = sweep
+        return result
 
     partial_commit = False
     for node_id in node_ids:

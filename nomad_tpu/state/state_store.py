@@ -496,8 +496,18 @@ class StateStore(_ReadAPI):
             evals: set = set()
             nodes: set = set()
             nonterminal_jobs: set = set()
+            # Hot loop: a system sweep commits one alloc per node, so the
+            # per-alloc work below runs 10k times per chunk; the table and
+            # member-set lookups are hoisted out of it.
+            alloc_table = self._tables["allocs"]
+            alloc_current = alloc_table.current.get
+            alloc_write = alloc_table.write
+            add_item = watch_items.add
+            members_node = self._members_sets("alloc_node")
+            members_job = self._members_sets("alloc_job")
+            members_eval = self._members_sets("alloc_eval")
             for alloc in allocs:
-                existing = self._get("allocs", alloc.ID)
+                existing = alloc_current(alloc.ID)
                 if existing is None:
                     alloc.CreateIndex = index
                     alloc.ModifyIndex = index
@@ -511,11 +521,11 @@ class StateStore(_ReadAPI):
                     alloc.ClientStatus = existing.ClientStatus
                     alloc.ClientDescription = existing.ClientDescription
                     alloc.TaskStates = existing.TaskStates
-                self._tables["allocs"].write(index, alloc.ID, alloc)
-                self._member_add("alloc_node", alloc.NodeID, alloc.ID)
-                self._member_add("alloc_job", alloc.JobID, alloc.ID)
-                self._member_add("alloc_eval", alloc.EvalID, alloc.ID)
-                watch_items.add(Item(alloc=alloc.ID))
+                add_item(Item(alloc=alloc.ID))
+                alloc_write(index, alloc.ID, alloc)
+                members_node.setdefault(alloc.NodeID, set()).add(alloc.ID)
+                members_job.setdefault(alloc.JobID, set()).add(alloc.ID)
+                members_eval.setdefault(alloc.EvalID, set()).add(alloc.ID)
                 evals.add(alloc.EvalID)
                 nodes.add(alloc.NodeID)
                 jobs.setdefault(alloc.JobID, "")

@@ -11,6 +11,7 @@ An alloc contributes usage while non-terminal; transitions are derived from
 
 from __future__ import annotations
 
+import threading
 from typing import Optional
 
 from nomad_tpu.state.state_store import StateStore
@@ -19,6 +20,11 @@ from nomad_tpu.structs import Allocation, Node
 import numpy as np
 
 from .node_table import NodeTensor, alloc_vec
+
+# shared_elig's per-job view caches are unbounded across a long-lived
+# server (one entry per job id ever swept); past this many entries the
+# views are dropped and rebuilt lazily from the signature cache.
+_ELIG_JOB_CACHE_CAP = 8192
 
 
 class TensorIndex:
@@ -31,6 +37,43 @@ class TensorIndex:
         # sharing this index onto the device kernels, including the
         # per-eval slow path (the multichip dry run relies on it).
         self.allow_host_select = True
+        # System-sweep eligibility: ONE ClassEligibility over the whole
+        # node table, shared by every system evaluation until the node
+        # population changes (nt.node_version). Building it walks every
+        # node once; without the cache a 50-job system storm pays that
+        # O(cluster) walk 50 times.
+        self._elig_lock = threading.Lock()
+        self._elig_cache: Optional[tuple] = None  # (node_version, elig)
+
+    def shared_elig(self, state):
+        """Shared, node-version-keyed ClassEligibility over ALL table rows.
+
+        Safe to share across jobs and DCs: the datacenter is part of the
+        computed class (structs/node_class.py), so any class representative
+        is exact for every member, and per-job masks AND against the
+        caller's ready/DC row mask. Concurrent workers may race to build
+        one — the loser's copy is simply dropped (values are identical)."""
+        from .constraints import ClassEligibility
+
+        with self._elig_lock:
+            ver = self.nt.node_version
+            cached = self._elig_cache
+            if cached is not None and cached[0] == ver:
+                elig = cached[1]
+                if len(elig._job_cache) > _ELIG_JOB_CACHE_CAP:
+                    # The signature cache holds the actual [n_rows] mask
+                    # arrays — clearing only the per-job views would keep
+                    # every mask alive; all three regenerate on demand.
+                    elig._job_cache.clear()
+                    elig._tg_cache.clear()
+                    elig._sig_cache.clear()
+                return elig
+        elig = ClassEligibility(self.nt, list(state.nodes()))
+        with self._elig_lock:
+            # Re-check: the population may have moved while we built.
+            if self.nt.node_version == ver:
+                self._elig_cache = (ver, elig)
+        return elig
 
     @staticmethod
     def attach(store: StateStore) -> "TensorIndex":

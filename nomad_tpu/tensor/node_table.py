@@ -95,6 +95,12 @@ class NodeTensor:
         # older epoch may carry a departed node's usage on a reused row and
         # must rebase (shape checks alone miss free-list reuse).
         self.row_epoch = 0
+        # Bumped on ANY node-set change (upsert, readiness flip, removal):
+        # the invalidation key for caches derived from the node population —
+        # the shared sweep eligibility (TensorIndex.shared_elig) and the
+        # system scheduler's memoized ready-node list. Coarser than
+        # row_epoch, which only tracks identity changes.
+        self.node_version = 0
 
         # Vocabularies
         self.class_vocab: Dict[str, int] = {}
@@ -198,6 +204,7 @@ class NodeTensor:
             self.class_ids[row] = self.class_id(node.ComputedClass)
             self.dc_ids[row] = self.dc_id(node.Datacenter)
             self._dirty_rows.add(row)
+            self.node_version += 1
 
     def _reserved_of(self, node_id: str) -> np.ndarray:
         return self._reserved_cache.get(node_id, np.zeros(RES_DIMS, dtype=np.float32))
@@ -209,6 +216,7 @@ class NodeTensor:
                 return
             self.ready[row] = ready
             self._dirty_rows.add(row)
+            self.node_version += 1
 
     def remove_node(self, node_id: str) -> None:
         with self._lock:
@@ -226,6 +234,7 @@ class NodeTensor:
             self._dirty_rows.add(row)
             self._reserved_cache.pop(node_id, None)
             self.row_epoch += 1
+            self.node_version += 1
 
     def add_alloc_usage(self, alloc: Allocation) -> None:
         self._apply_usage(alloc, +1.0)

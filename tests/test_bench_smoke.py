@@ -38,6 +38,12 @@ def test_bench_smoke_completes_with_parity():
     for key in ("t_dispatch_ms", "t_collect_ms", "t_drain_fetch_ms",
                 "t_build_ms", "t_planwait_ms", "t_lease_ms"):
         assert key in stats
+    # The system-sweep config runs at smoke scale too (ISSUE 6): the
+    # tensor-sweep path must place one alloc per node per eval, so a
+    # system-path regression surfaces in every smoke JSON.
+    c4 = detail["config4_system"]
+    assert c4["evals_sec"] > 0
+    assert c4["placed_per_rep"] == c4["nodes"] * 4, c4
     # The worker-scaling sweep ran and recorded the 1-vs-2 ratio: two
     # workers must not COLLAPSE against one. The pre-arbiter state was
     # ~0.2x and parity-or-better is the expectation (measured ~0.96-1.13

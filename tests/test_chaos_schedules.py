@@ -193,6 +193,66 @@ class TestWindowDrainSchedule:
             srv.shutdown()
 
 
+class TestSystemEmitSchedule:
+    """ISSUE 6 site: the system sweep's bulk placement emit
+    (`sched.system.emit`, scheduler/system_sweep.py). A sweep killed at
+    the emit seam dies BEFORE anything is submitted, so the worker nacks
+    and the broker must redeliver the eval exactly once — the re-run
+    places one alloc per node with no duplicates and no lost nodes."""
+
+    N_NODES = 6
+
+    def _system_job(self):
+        job = mock.system_job()
+        t = job.TaskGroups[0].Tasks[0]
+        t.Resources.CPU = 20
+        t.Resources.MemoryMB = 16
+        t.Resources.DiskMB = 150
+        t.Resources.Networks = []
+        t.Services = []
+        if t.LogConfig is not None:
+            t.LogConfig.MaxFiles = 1
+            t.LogConfig.MaxFileSizeMB = 1
+        job.init_fields()
+        return job
+
+    def test_emit_kill_redelivers_sweep_exactly_once(self):
+        srv = Server(ServerConfig(num_schedulers=1, scheduler_window=8))
+        srv.establish_leadership()
+        try:
+            for _ in range(self.N_NODES):
+                srv.node_register(mock.node())
+            jobs = [self._system_job() for _ in range(3)]
+            eval_ids = []
+            with ChaosSchedule(name="system-emit") \
+                    .arm(0.0, "sched.system.emit=error:count=1") as sched:
+                sched.join(2.0)
+                for job in jobs:
+                    eval_ids.append(srv.job_register(job)[0])
+                assert wait_for(
+                    lambda: _all_terminal(srv.state, eval_ids),
+                    timeout=30, interval=0.05,
+                    msg="evals terminal after an emit-seam kill")
+            snap = failpoints.snapshot()
+            assert snap["sched.system.emit"]["fired"] == 1, \
+                "the emit seam never fired — site renamed?"
+            # Exactly-once redelivery: every job at exactly one live
+            # alloc per node, no duplicate alloc IDs, no node carrying
+            # the same job twice, every eval terminal.
+            assert_invariants(srv.state, jobs, per_job=self.N_NODES,
+                              eval_ids=eval_ids)
+            for job in jobs:
+                live = [a for a in srv.state.allocs_by_job(job.ID)
+                        if not a.terminal_status()]
+                per_node = {}
+                for a in live:
+                    per_node[a.NodeID] = per_node.get(a.NodeID, 0) + 1
+                assert len(live) == self.N_NODES
+                assert all(c == 1 for c in per_node.values()), per_node
+        finally:
+            srv.shutdown()
+
+
 class TestBlockedWakeupSchedule:
     """ROADMAP candidate site: the blocked-evals capacity wakeup. A lost
     wakeup event (dropped at the seam) strands parked evals ONLY until

@@ -21,8 +21,14 @@ per run.
 import dataclasses
 import string
 
-from hypothesis import HealthCheck, given, settings
-from hypothesis import strategies as st
+import pytest
+
+# The property suite is hypothesis-driven; without the library the module
+# must SKIP cleanly, not error the whole collection run.
+pytest.importorskip("hypothesis")
+
+from hypothesis import HealthCheck, given, settings  # noqa: E402
+from hypothesis import strategies as st  # noqa: E402
 
 from nomad_tpu import mock
 from nomad_tpu.structs import (

@@ -79,6 +79,18 @@ C4_REPS = 2
 # Placement-parity gate shape (bench_placement_parity).
 PARITY_NODES = 1000
 PARITY_EVALS = 40
+# QoS slo_storm shape (bench_slo_storm): a saturating LOW-tier storm with
+# sparse HIGH-tier arrivals, run interleaved A/B qos-off vs qos-on, per-tier
+# latency percentiles recorded. The acceptance frame (ISSUE 8): a high-tier
+# eval's storm p99 should be bounded near the idle p50 instead of riding the
+# whole low-tier backlog.
+SLO_NODES = int(os.environ.get("BENCH_SLO_NODES", 2000))
+# Enough low-tier submissions that a real backlog exists when the high
+# arrivals land behind it (the tail being measured IS queue wait).
+SLO_LOW = int(os.environ.get("BENCH_SLO_LOW", 400))
+SLO_HIGH = int(os.environ.get("BENCH_SLO_HIGH", 12))
+SLO_REPS = int(os.environ.get("BENCH_SLO_REPS", 3))
+RUN_SLO = os.environ.get("BENCH_SLO", "1") != "0"
 
 
 def _apply_smoke():
@@ -90,6 +102,7 @@ def _apply_smoke():
     global N_NODES, N_PLACEMENTS, N_REPS, CPU_REF_EVALS
     global RUN_C2, RUN_C4, RUN_C5, PARITY_NODES, PARITY_EVALS
     global SCALING_NODES, SCALING_EVALS, C4_EVALS
+    global SLO_NODES, SLO_LOW, SLO_HIGH, SLO_REPS
     N_NODES = min(N_NODES, 512)
     N_PLACEMENTS = min(N_PLACEMENTS, 2000)   # 40 evals @ PER_EVAL=50
     N_REPS = min(N_REPS, 3)
@@ -108,6 +121,13 @@ def _apply_smoke():
     # more than the budget needs the ~2s back.
     SCALING_NODES = min(SCALING_NODES, 256)
     SCALING_EVALS = min(SCALING_EVALS, 40)
+    # The QoS storm STAYS on at smoke scale (parity-gated: qos-off and
+    # qos-on must place identically): the tiered broker / deadline-window
+    # path has no other in-tree perf gate. A few seconds of budget.
+    SLO_NODES = min(SLO_NODES, 256)
+    SLO_LOW = min(SLO_LOW, 24)
+    SLO_HIGH = min(SLO_HIGH, 6)
+    SLO_REPS = min(SLO_REPS, 2)
 
 
 def _freeze_heap():
@@ -465,6 +485,248 @@ def bench_worker_scaling():
             srv.shutdown()
 
 
+def build_slo_job(priority, per_eval=8):
+    """slo_storm job shape: small placement count so the storm is
+    QUEUE-bound (the tails under test come from broker wait, not device
+    compute), with an explicit priority tier."""
+    job = build_job(per_eval)
+    job.Priority = priority
+    return job
+
+
+def bench_slo_storm():
+    """QoS mixed-priority storm: a saturating LOW-tier burst with sparse
+    HIGH-tier arrivals behind it, measured twice — qos-off (today's FIFO
+    path) and qos-on (tiered lanes + deadline windows) — with the timed
+    reps INTERLEAVED on live servers like the worker-scaling sweep, so
+    both sides see the same machine drift. Records per-tier e2e latency
+    percentiles, the qos-on/off throughput ratio (the overhead bound),
+    admission + preemption probe counts, and a PARITY gate: with ample
+    capacity both modes must place every storm alloc.
+
+    The acceptance frame (ISSUE 8): qos-on high-tier storm p99 bounded
+    near the idle e2e p50 instead of riding the whole low-tier backlog —
+    reported as high_p99_vs_idle_p50 for trajectory review."""
+    from nomad_tpu.qos import QoSConfig
+    from nomad_tpu.server import Server, ServerConfig
+    from nomad_tpu.structs.structs import EvalStatusComplete
+
+    per_eval = 8
+    expect_allocs = (SLO_LOW + SLO_HIGH) * per_eval
+
+    def run_mixed(srv, lats=None):
+        """One mixed rep: low burst, then the high arrivals it buries."""
+        tiers = {}
+        t_submit = {}
+        for _ in range(SLO_LOW):
+            eid = srv.job_register(build_slo_job(10, per_eval))[0]
+            tiers[eid] = "low"
+            t_submit[eid] = time.monotonic()
+        for _ in range(SLO_HIGH):
+            eid = srv.job_register(build_slo_job(90, per_eval))[0]
+            tiers[eid] = "high"
+            t_submit[eid] = time.monotonic()
+        pending = set(tiers)
+        deadline = time.monotonic() + 600
+        while pending and time.monotonic() < deadline:
+            now = time.monotonic()
+            done = {eid for eid in pending
+                    if (e := srv.state.eval_by_id(eid)) is not None
+                    and e.Status == EvalStatusComplete}
+            if lats is not None:
+                for eid in done:
+                    lats[tiers[eid]].append(now - t_submit[eid])
+            pending -= done
+            if pending:
+                # Finer poll than the throughput storms: high-tier
+                # latencies are the measurement and can sit near 10ms.
+                time.sleep(0.005)
+        if pending:
+            raise RuntimeError(f"{len(pending)} slo evals never completed")
+        return list(tiers)
+
+    nodes = build_nodes(SLO_NODES)
+    out = {"nodes": SLO_NODES, "low_jobs": SLO_LOW, "high_jobs": SLO_HIGH,
+           "placements_per_eval": per_eval}
+    servers = {}
+    try:
+        for mode in ("qos_off", "qos_on"):
+            # burn_shed > 1 disables SLO-burn shedding for the PARITY
+            # storm: the gate asserts identical placed counts, so
+            # admission must not shed mid-rep on a slow box. The
+            # admission probe below exercises shedding deterministically.
+            qos = QoSConfig(enabled=mode == "qos_on", burn_shed=2.0)
+            srv = Server(ServerConfig(num_schedulers=N_WORKERS,
+                                      pipelined_scheduling=True,
+                                      scheduler_window=WINDOW,
+                                      qos=qos,
+                                      min_heartbeat_ttl=24 * 3600.0,
+                                      heartbeat_grace=24 * 3600.0))
+            srv.establish_leadership()
+            for node in nodes:
+                srv.node_register(node)
+            run_mixed(srv)  # warm (compiles, first snapshots)
+            srv.tindex.nt.warm_device()
+            servers[mode] = srv
+        _tune_gc()
+        rates = {"qos_off": [], "qos_on": []}
+        lats = {"qos_off": {"high": [], "low": []},
+                "qos_on": {"high": [], "low": []}}
+        placed = {}
+        for _ in range(SLO_REPS):
+            for mode in ("qos_off", "qos_on"):  # interleaved A/B pair
+                srv = servers[mode]
+                for w in srv.workers:
+                    if hasattr(w, "quiesce"):
+                        w.quiesce(30.0)
+                t0 = time.perf_counter()
+                eval_ids = run_mixed(srv, lats=lats[mode])
+                rates[mode].append(
+                    (SLO_LOW + SLO_HIGH) / (time.perf_counter() - t0))
+                placed.setdefault(mode, []).append(sum(
+                    1 for eid in eval_ids
+                    for _ in srv.state.allocs_by_eval(eid)))
+                _freeze_heap()
+        for mode in ("qos_off", "qos_on"):
+            out[mode] = {
+                "evals_sec": round(max(rates[mode]), 2),
+                "rep_rates": [round(r, 2) for r in rates[mode]],
+                "high_ms": _pctiles_ms(lats[mode]["high"]),
+                "low_ms": _pctiles_ms(lats[mode]["low"]),
+                "placed_per_rep": placed[mode],
+            }
+        on = servers["qos_on"]
+        out["qos_on"]["window_cuts"] = sum(
+            w.stats.get("qos_cut", 0) for w in on.workers)
+        out["qos_on"]["promoted"] = on.eval_broker.tier_promotions()
+        out["throughput_ratio"] = round(
+            max(rates["qos_on"]) / max(rates["qos_off"]), 3) \
+            if rates["qos_off"] else None
+        # Idle-broker single-eval p50 on the qos-on server — the
+        # denominator of the tail bound.
+        idle = []
+        for _ in range(5):
+            t0 = time.perf_counter()
+            run_mixed_single(on, per_eval)
+            idle.append(time.perf_counter() - t0)
+        out["idle_p50_ms"] = round(
+            float(np.percentile(idle, 50)) * 1e3, 2)
+        high_p99 = out["qos_on"]["high_ms"].get("p99")
+        out["high_p99_vs_idle_p50"] = round(
+            high_p99 / out["idle_p50_ms"], 2) \
+            if high_p99 and out["idle_p50_ms"] else None
+        off_p99 = out["qos_off"]["high_ms"].get("p99")
+        out["high_p99_improvement"] = round(off_p99 / high_p99, 2) \
+            if high_p99 and off_p99 else None
+        # Parity gate: ample capacity, so BOTH modes must place the full
+        # storm every rep — QoS reorders, it must never drop placements.
+        out["parity_ok"] = all(
+            p == expect_allocs for mode in placed for p in placed[mode])
+        out["expected_allocs"] = expect_allocs
+    finally:
+        for srv in servers.values():
+            srv.shutdown()
+
+    out["admission_probe"] = _slo_admission_probe()
+    out["preempt_probe"] = _slo_preempt_probe()
+    return out
+
+
+def run_mixed_single(srv, per_eval):
+    """One high-tier eval against an idle broker (idle-p50 probe)."""
+    from nomad_tpu.structs.structs import EvalStatusComplete
+
+    eid = srv.job_register(build_slo_job(90, per_eval))[0]
+    deadline = time.monotonic() + 60
+    while time.monotonic() < deadline:
+        e = srv.state.eval_by_id(eid)
+        if e is not None and e.Status == EvalStatusComplete:
+            return [eid]
+        time.sleep(0.002)
+    raise RuntimeError("idle probe eval never completed")
+
+
+def _slo_admission_probe():
+    """Deterministic admission exercise: a workerless leader (queue depth
+    can't drain) with a low-tier depth limit of 1 — the second low-tier
+    submission must shed with the typed backpressure error."""
+    from nomad_tpu.qos import QoSBackpressureError, QoSConfig
+    from nomad_tpu.server import Server, ServerConfig
+
+    srv = Server(ServerConfig(num_schedulers=0,
+                              qos=QoSConfig(enabled=True,
+                                            admit_depth=(0, 8192, 1)),
+                              min_heartbeat_ttl=24 * 3600.0,
+                              heartbeat_grace=24 * 3600.0))
+    srv.establish_leadership()
+    try:
+        for node in build_nodes(2):
+            srv.node_register(node)
+        srv.job_register(build_slo_job(10, 1))
+        shed = 0
+        try:
+            srv.job_register(build_slo_job(10, 1))
+        except QoSBackpressureError:
+            shed = 1
+        counters = srv.qos_counters.snapshot()
+        return {"shed": shed, "admitted": counters["admitted"],
+                "ok": shed == 1}
+    finally:
+        srv.shutdown()
+
+
+def _slo_preempt_probe():
+    """Deterministic preemption exercise: two nearly-full nodes of
+    low-tier load, then a high-tier job that fits nowhere — it must evict
+    exactly one victim and place, atomically."""
+    from nomad_tpu.qos import QoSConfig
+    from nomad_tpu.server import Server, ServerConfig
+    from nomad_tpu.structs.structs import (
+        AllocDesiredStatusEvict,
+        EvalStatusComplete,
+    )
+
+    srv = Server(ServerConfig(num_schedulers=1,
+                              qos=QoSConfig(enabled=True),
+                              min_heartbeat_ttl=24 * 3600.0,
+                              heartbeat_grace=24 * 3600.0))
+    srv.establish_leadership()
+    try:
+        for node in build_nodes(2):
+            node.Resources.CPU = 1000
+            node.Reserved = None
+            srv.node_register(node)
+
+        def fat_job(prio, cpu):
+            job = build_slo_job(prio, 1)
+            job.TaskGroups[0].Tasks[0].Resources.CPU = cpu
+            return job
+
+        def wait(eid):
+            deadline = time.monotonic() + 60
+            while time.monotonic() < deadline:
+                e = srv.state.eval_by_id(eid)
+                if e is not None and e.Status == EvalStatusComplete:
+                    return True
+                time.sleep(0.01)
+            return False
+
+        for _ in range(2):
+            assert wait(srv.job_register(fat_job(10, 800))[0])
+        heid = srv.job_register(fat_job(90, 600))[0]
+        ok = wait(heid)
+        placed = len(list(srv.state.allocs_by_eval(heid)))
+        evicted = sum(1 for a in srv.state.allocs()
+                      if a.DesiredStatus == AllocDesiredStatusEvict)
+        counters = srv.qos_counters.snapshot()
+        return {"placed": placed, "evicted": evicted,
+                "preempt_placed": counters["preempt_placed"],
+                "preempt_evictions": counters["preempt_evictions"],
+                "ok": bool(ok and placed == 1 and evicted >= 1)}
+    finally:
+        srv.shutdown()
+
+
 def build_plain_job(per_eval=PER_EVAL):
     """BASELINE config 2's shape: resource-only bin-packing, no constraint
     checkers at all."""
@@ -752,6 +1014,12 @@ def main(argv=None):
     # BENCH file carries the 1-vs-2 ratio next to the single-worker rate.
     detail["worker_scaling"] = bench_worker_scaling()
 
+    # QoS slo_storm: per-tier latency tails under mixed-priority load,
+    # qos-on vs qos-off interleaved, + admission/preemption probes.
+    slo = None
+    if RUN_SLO:
+        detail["slo_storm"] = (slo := bench_slo_storm())
+
     detail["placement_parity"] = (parity := bench_placement_parity())
 
     result = {
@@ -772,6 +1040,14 @@ def main(argv=None):
         # still recorded alongside the failure.
         sys.stderr.write(
             f"PLACEMENT PARITY FAILED: {json.dumps(parity)}\n")
+        sys.exit(2)
+    if slo is not None and not (slo["parity_ok"]
+                                and slo["admission_probe"]["ok"]
+                                and slo["preempt_probe"]["ok"]):
+        # QoS gate: qos-on must place the full storm (reordering never
+        # drops work), admission must shed when told to, preemption must
+        # place atomically. Same fail-after-emit contract as above.
+        sys.stderr.write(f"QOS SLO GATE FAILED: {json.dumps(slo)}\n")
         sys.exit(2)
 
 

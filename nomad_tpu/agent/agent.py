@@ -13,7 +13,7 @@ import os
 import socket
 import tempfile
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional
+from typing import Any, Dict, List, Optional
 
 from nomad_tpu.client import Client, ClientConfig, InProcServerChannel
 from nomad_tpu.server import Server, ServerConfig
@@ -53,6 +53,9 @@ class AgentConfig:
     scheduler_window: int = 32
     pipelined_scheduling: bool = True
     scheduler_mesh: str = ""
+    # QoS knobs (server { qos { ... } }), materialized into a QoSConfig
+    # at server boot; {} / enabled=false leaves QoS off.
+    qos: Dict[str, Any] = field(default_factory=dict)
     node_class: str = ""
     meta: Dict[str, str] = field(default_factory=dict)
     options: Dict[str, str] = field(default_factory=dict)
@@ -87,6 +90,26 @@ class AgentConfig:
             enable_debug=True,
             options={"driver.raw_exec.enable": "true"},
         )
+
+
+def _qos_from_config(raw: Dict[str, Any]):
+    """Materialize the server{qos{...}} dict into a QoSConfig (None when
+    absent/disabled is fine — ServerConfig treats both as QoS off).
+    Unknown keys fail loudly at boot instead of silently configuring
+    nothing."""
+    if not raw:
+        return None
+    from nomad_tpu.qos import QoSConfig
+
+    known = {f for f in QoSConfig.__dataclass_fields__}
+    unknown = set(raw) - known
+    if unknown:
+        raise ValueError(f"unknown qos config keys: {sorted(unknown)}")
+    kwargs = dict(raw)
+    for tuple_key in ("deadlines_s", "admit_depth"):
+        if tuple_key in kwargs:
+            kwargs[tuple_key] = tuple(kwargs[tuple_key])
+    return QoSConfig(**kwargs)
 
 
 class LogRing(logging.Handler):
@@ -237,6 +260,7 @@ class Agent:
             scheduler_window=self.config.scheduler_window,
             pipelined_scheduling=self.config.pipelined_scheduling,
             scheduler_mesh=self.config.scheduler_mesh,
+            qos=_qos_from_config(self.config.qos),
             dev_mode=True,
         )
         self.server = Server(sconf)
@@ -257,6 +281,7 @@ class Agent:
             scheduler_window=self.config.scheduler_window,
             pipelined_scheduling=self.config.pipelined_scheduling,
             scheduler_mesh=self.config.scheduler_mesh,
+            qos=_qos_from_config(self.config.qos),
             bootstrap_expect=self.config.bootstrap_expect,
         )
         self.cluster = ClusterServer(sconf, bind_addr=self.config.bind_addr,

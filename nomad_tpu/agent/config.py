@@ -84,6 +84,10 @@ def config_from_dict(data: dict) -> AgentConfig:
     cfg.pipelined_scheduling = bool(server.get("pipelined_scheduling",
                                                cfg.pipelined_scheduling))
     cfg.scheduler_mesh = server.get("scheduler_mesh", cfg.scheduler_mesh)
+    # QoS knobs (server { qos { enabled = true high_floor = 70 ... } });
+    # passed through as a plain dict and materialized into a QoSConfig by
+    # the agent (README "QoS & SLO serving" documents each knob).
+    cfg.qos = dict(server.get("qos") or {})
 
     telemetry = data.get("telemetry") or {}
     cfg.statsd_addr = telemetry.get("statsd_address", cfg.statsd_addr)

@@ -20,6 +20,8 @@ import urllib.parse
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
+from nomad_tpu.qos import QoSBackpressureError
+from nomad_tpu.rpc.pool import RPCError
 from nomad_tpu.state.watch import Item
 from nomad_tpu.structs import Job, from_dict, job_stub, to_dict
 
@@ -140,8 +142,24 @@ def _make_handler(agent):
             except CodedError as e:
                 self._error(e.code, str(e))
                 return
+            except QoSBackpressureError as e:
+                # Admission shed: 429 so clients back off and retry
+                # (api/client.py maps this to BackpressureAPIError and
+                # re-sends with RetryPolicy — nothing was written).
+                self._error(429, str(e))
+                return
             except KeyError as e:
                 self._error(404, str(e))
+                return
+            except RPCError as e:
+                # A shed raised on a REMOTE server (client-only agent /
+                # leader forward) arrives as an RPCError carrying the
+                # exception class name; keep the 429 contract.
+                if e.remote_type == "QoSBackpressureError":
+                    self._error(429, str(e))
+                    return
+                logger.exception("http: request failed")
+                self._error(500, str(e))
                 return
             except ValueError as e:
                 self._error(400, str(e))
@@ -799,8 +817,18 @@ def route(agent, method: str, path: str, query, get_body):
                 for k, v in snap.items():
                     if isinstance(v, (int, float)):
                         totals[k] = totals.get(k, 0) + v
+        qos_out: Dict[str, Any] = {"Enabled": False}
+        srv_qos = getattr(srv, "qos", None)
+        if srv_qos is not None and srv_qos.enabled:
+            # Per-tier queue depth / SLO burn / promotions from the
+            # broker, plus admission + preemption flow counters — the
+            # operator's view of whether tiers are actually being served
+            # within their deadlines (README "QoS & SLO serving").
+            qos_out = {"Enabled": True,
+                       **srv.eval_broker.qos_stats(),
+                       "Counters": srv.qos_counters.snapshot()}
         return {"Workers": workers, "ByWorker": by_worker,
-                "Totals": totals}, None
+                "Totals": totals, "QoS": qos_out}, None
 
     if path == "/v1/agent/metrics":
         # In-memory telemetry snapshot (reference shape: go-metrics

@@ -25,6 +25,13 @@ class APIError(Exception):
         self.code = code
 
 
+class BackpressureAPIError(APIError):
+    """HTTP 429: the submission was shed by QoS admission control
+    (server-side QoSBackpressureError). Safe to retry — the server
+    rejected BEFORE writing anything — and the client does so
+    automatically with RetryPolicy (``backpressure_retries``)."""
+
+
 @dataclass
 class QueryOptions:
     region: str = ""
@@ -46,7 +53,8 @@ class QueryMeta:
 
 class Client:
     def __init__(self, address: str = "http://127.0.0.1:4646",
-                 region: str = "", retries: int = 3):
+                 region: str = "", retries: int = 3,
+                 backpressure_retries: int = 4):
         self.address = address.rstrip("/")
         self.region = region
         # Transient-transport retry budget for idempotent reads (an agent
@@ -54,6 +62,11 @@ class Client:
         # retry automatically: re-sending a register is not idempotent
         # from the caller's perspective (duplicate evals).
         self.retries = max(1, retries)
+        # QoS backpressure (HTTP 429) retry budget — applies to writes
+        # too: a shed submission was rejected BEFORE any server write, so
+        # re-sending cannot duplicate anything. 1 disables (the 429
+        # surfaces as BackpressureAPIError).
+        self.backpressure_retries = max(1, backpressure_retries)
         self.jobs = Jobs(self)
         self.nodes = Nodes(self)
         self.allocations = Allocations(self)
@@ -109,11 +122,21 @@ class Client:
                             "X-Nomad-KnownLeader", "") == "true")
                     return (json.loads(raw) if raw else None), meta
             except urllib.error.HTTPError as e:
-                raise APIError(e.code,
-                               e.read().decode(errors="replace")) from e
+                body_text = e.read().decode(errors="replace")
+                if e.code == 429:
+                    raise BackpressureAPIError(e.code, body_text) from e
+                raise APIError(e.code, body_text) from e
 
         if method != "GET" or self.retries <= 1:
-            return once()
+            if self.backpressure_retries <= 1:
+                return once()
+            # Writes retry ONLY typed backpressure (QoS admission shed):
+            # nothing was written server-side, so a jittered re-send is
+            # safe where a blind transport retry would not be.
+            policy = RetryPolicy(max_attempts=self.backpressure_retries,
+                                 backoff=Backoff(base=0.25, cap=3.0),
+                                 retry_on=(BackpressureAPIError,))
+            return policy.call(once)
 
         def transient(exc: BaseException) -> bool:
             # A timed-out request already waited the full budget; against

@@ -826,6 +826,21 @@ def cmd_sched_stats(args) -> int:
     if args.json:
         print(json.dumps(out, indent=2))
         return 0
+    qos = out.get("QoS") or {}
+    if qos.get("Enabled"):
+        # Per-tier lane health first: queue depth + SLO burn is the
+        # "are high-tier deadlines holding" answer an operator wants
+        # before any per-worker stage timer.
+        depths = qos.get("TierDepths") or {}
+        burn = qos.get("SLOBurn") or {}
+        print("QoS tiers (ready depth / SLO burn):")
+        for name in ("high", "normal", "low"):
+            print(f"  {name:<8} {depths.get(name, 0):>6} / "
+                  f"{burn.get(name, 0.0):.0%}")
+        print(f"  aged-up pops: {qos.get('Promoted', 0)}")
+        counters = qos.get("Counters") or {}
+        print("  " + "  ".join(f"{k}={v}" for k, v in
+                               sorted(counters.items())))
     workers = out.get("Workers") or []
     if not workers:
         print("No scheduling workers running (agent is not the leader?)")

@@ -1,0 +1,42 @@
+"""QoS subsystem: priority lanes, deadline-aware windows, admission
+control, and alloc preemption for the served scheduling path.
+
+See README "QoS & SLO serving" for the operator view. Everything here is
+behind ``QoSConfig.enabled`` — disabled (the default), the served path is
+bit-identical to the pre-QoS FIFO behavior.
+"""
+
+from .admission import AdmissionController, QoSBackpressureError
+from .preemption import (
+    ALLOC_PREEMPTED,
+    PreemptedOption,
+    attempt_preemption,
+    find_preemption,
+)
+from .tiers import (
+    N_TIERS,
+    TIER_HIGH,
+    TIER_LOW,
+    TIER_NAMES,
+    TIER_NORMAL,
+    QoSConfig,
+    QoSCounters,
+    qos_enabled,
+)
+
+__all__ = [
+    "ALLOC_PREEMPTED",
+    "AdmissionController",
+    "N_TIERS",
+    "PreemptedOption",
+    "QoSBackpressureError",
+    "QoSConfig",
+    "QoSCounters",
+    "TIER_HIGH",
+    "TIER_LOW",
+    "TIER_NAMES",
+    "TIER_NORMAL",
+    "attempt_preemption",
+    "find_preemption",
+    "qos_enabled",
+]

@@ -1,0 +1,91 @@
+"""Admission control at submission ingress (the Eval.enqueue seam).
+
+When the broker's backlog for a tier crosses its configured depth, or a
+HIGHER tier is burning its latency SLO, new low-tier submissions are shed
+*before* they cost a raft write, an eval, and a window slot. The shed
+surfaces to the submitter as :class:`QoSBackpressureError` — typed, so it
+crosses the RPC wire with ``remote_type`` intact and maps to HTTP 429 —
+and the API client retries it with the shared RetryPolicy (api/client.py).
+
+Design notes:
+
+- The controller is STATELESS policy over broker introspection
+  (``tier_depths`` / ``slo_burn``): the broker already knows queue depth
+  and deadline misses, so admission adds no bookkeeping to the hot path.
+- Only *submission* ingress is gated (Job.Register / Job.Evaluate with a
+  user trigger). Internally generated evals — node updates, deregisters,
+  blocked-eval requeues, periodic launches — always pass: shedding a
+  deregister or a capacity-retry would wedge cluster reconciliation.
+- High tier is never shed by the burn rule (there is no higher tier to
+  protect) and by default has unlimited depth.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from nomad_tpu.resilience import failpoints
+from nomad_tpu.telemetry import metrics
+
+from .tiers import TIER_NAMES, QoSConfig, QoSCounters, qos_enabled
+
+
+class QoSBackpressureError(Exception):
+    """A submission was shed by admission control. Retryable: nothing was
+    written, so the submitter backs off and re-sends (the API client does
+    this automatically with RetryPolicy). ``retry_after`` is an advisory
+    backoff hint in seconds."""
+
+    def __init__(self, tier: str, reason: str, retry_after: float = 0.5):
+        super().__init__(
+            f"submission shed ({tier} tier): {reason}; "
+            f"retry after {retry_after:g}s")
+        self.tier = tier
+        self.reason = reason
+        self.retry_after = retry_after
+
+
+class AdmissionController:
+    """Backlog/SLO-burn admission policy over the broker's tier state."""
+
+    def __init__(self, qos: Optional[QoSConfig], broker,
+                 counters: Optional[QoSCounters] = None):
+        self.qos = qos
+        self.broker = broker
+        self.counters = counters or QoSCounters()
+
+    def _shed(self, tier: int, reason: str,
+              retry_after: float) -> "QoSBackpressureError":
+        self.counters.incr("shed")
+        metrics.incr_counter(("nomad", "qos", "admission", "shed"))
+        return QoSBackpressureError(TIER_NAMES[tier], reason, retry_after)
+
+    def admit(self, priority: int) -> None:
+        """Gate one submission; raises :class:`QoSBackpressureError` to
+        shed it. A no-op unless QoS is enabled."""
+        if not qos_enabled(self.qos):
+            return
+        qos = self.qos
+        tier = qos.tier_of(priority)
+        # Failure seam: "drop" forces a shed (the backpressure path under
+        # test), "error" surfaces as a failed submission, "delay" models a
+        # slow admission check (the "delays" half of shed-or-delay).
+        if failpoints.fire("broker.admission") == "drop":
+            raise self._shed(tier, "admission failpoint", 0.5)
+        depths = self.broker.tier_depths()
+        limit = qos.admit_depth[tier]
+        if limit and depths[tier] >= limit:
+            raise self._shed(
+                tier, f"tier backlog {depths[tier]} >= {limit}",
+                min(5.0, 0.25 * (1 + depths[tier] / max(1, limit))))
+        if tier > 0:
+            burn = self.broker.slo_burn()
+            for higher in range(tier):
+                if burn[higher] > qos.burn_shed and depths[higher]:
+                    raise self._shed(
+                        tier,
+                        f"{TIER_NAMES[higher]} tier burning SLO "
+                        f"({burn[higher]:.0%} of recent completions over "
+                        f"deadline)", 1.0)
+        self.counters.incr("admitted")
+        metrics.incr_counter(("nomad", "qos", "admission", "admit"))

@@ -1,0 +1,274 @@
+"""Alloc preemption: high-tier placements may evict lower-tier allocs.
+
+A capability extension beyond the reference (Nomad v0.4 stops at
+priority-ordered dequeue): when a HIGH-tier placement finds no feasible
+capacity, the scheduler looks for a node where evicting strictly
+lower-tier allocations frees enough room, ranks eviction candidates by
+(victim job priority ascending, then youngest first — least work lost),
+and emits evictions + the placement in ONE plan. Atomicity is the plan
+applier's per-node verify: a node's evictions and placements commit
+together or not at all (plan_apply.evaluate_plan skips BOTH sides of a
+node that fails its fit re-check), and the whole group lands as one raft
+entry — there is no window where a victim was stopped but the
+high-priority alloc never arrived. The FSM applies NodeUpdate (evictions)
+before NodeAllocation (placements), so the state store observes
+stop-then-place in order; evicted allocs are terminal immediately
+(DesiredStatus=evict), which is what frees the tensor-usage row at commit.
+
+Plans that preempt carry a ``_preempt`` descriptor
+(``{node_id: [victim alloc ids]}``) so the applier's
+``plan.preempt.commit`` failpoint and the chaos/overlap tests can see
+them; a worker killed mid-commit nacks, the broker redelivers the eval,
+and the retry re-plans against committed state — exactly-once, no lost
+evictions, no duplicate allocs. Like the system sweep's ``_sweep``, the
+descriptor is an IN-PROCESS annotation (it does not cross the Plan.Submit
+wire from remote workers) — atomicity never depends on it: the applier's
+per-node verify drops a node's evictions and placements together with or
+without the marker.
+
+Scope guards (all conservative, all fall back to the blocked-eval path):
+
+- only service/batch jobs preempt, and only allocs whose job maps to a
+  strictly LOWER tier (never high-on-high churn);
+- task groups asking network resources never preempt (port offers are
+  per-node host state the freed capacity math can't model);
+- at most ``qos.max_victims`` evictions per placed instance.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Dict, List, Optional, Sequence
+
+from nomad_tpu.structs import Allocation, Job, Resources
+from nomad_tpu.structs.funcs import allocs_fit
+from nomad_tpu.structs.structs import (
+    AllocDesiredStatusEvict,
+    JobDefaultPriority,
+    NodeStatusReady,
+    TaskGroup,
+)
+from nomad_tpu.telemetry import metrics, trace
+
+from .tiers import TIER_HIGH, QoSConfig, QoSCounters
+
+logger = logging.getLogger("nomad.qos.preempt")
+
+ALLOC_PREEMPTED = "alloc preempted by higher-priority job"
+
+# Cap on nodes *with evictable load* fully costed per failed instance:
+# preemption runs on the rare exhausted-capacity slow path, but a 50k-node
+# sweep of per-alloc fit math would still be a tail stall.
+_MAX_CANDIDATES = 64
+
+
+class PreemptedOption:
+    """Duck-type of scheduler SelectedOption for a preempted placement
+    (build_placement_allocs only reads ``node`` and ``task_resources``)."""
+
+    __slots__ = ("node", "score", "task_resources", "victims")
+
+    def __init__(self, node, task_resources, victims):
+        self.node = node
+        self.score = 0.0
+        self.task_resources = task_resources
+        self.victims = victims
+
+
+def _tg_asks_network(tg: TaskGroup) -> bool:
+    for task in tg.Tasks:
+        r = task.Resources
+        if r is not None and r.Networks:
+            return True
+    return False
+
+
+_probe_seq = 0
+
+
+def _probe_alloc(tg: TaskGroup) -> Allocation:
+    """A throwaway alloc carrying the TG's per-task resources, so the
+    eviction fit check runs the SAME accounting (structs.allocs_fit) the
+    plan applier re-verifies with. Unique IDs: probes standing in for a
+    window's earlier placements coexist in one live list."""
+    global _probe_seq
+    _probe_seq += 1
+    probe = Allocation(
+        ID=f"_preempt_probe_{_probe_seq}",
+        TaskResources={t.Name: (t.Resources if t.Resources is not None
+                                else Resources()) for t in tg.Tasks},
+    )
+    # Probes occupy capacity in the fit math but must never be CHOSEN as
+    # victims (they stand in for this very eval's placements).
+    probe._qos_probe = True
+    return probe
+
+
+def find_preemption(state, plan, job: Job, tg: TaskGroup,
+                    nodes: Sequence, qos: QoSConfig,
+                    job_prio_cache: Optional[Dict[str, int]] = None,
+                    pending: Optional[Dict[str, List[Allocation]]] = None
+                    ) -> Optional[PreemptedOption]:
+    """Pick (node, minimal victim set) for one failed TG instance, or
+    None. ``plan`` is consulted so victims already claimed by this eval
+    are accounted; ``pending`` carries per-node probe allocs for
+    placements this eval has CHOSEN but not yet written into the plan
+    (stack selections and earlier preemption picks — without them,
+    sibling instances of a Count>=2 job double-book one node's freed
+    capacity and the applier bounces the whole node every retry).
+    Neither input is mutated here."""
+    from nomad_tpu.scheduler.util import task_group_constraints
+    from nomad_tpu.tensor.constraints import (
+        node_has_drivers,
+        node_meets_constraints,
+    )
+
+    if _tg_asks_network(tg):
+        return None
+    placing_tier = qos.tier_of(job.Priority)
+    cons = task_group_constraints(tg)
+    probe = _probe_alloc(tg)
+    prio_of = job_prio_cache if job_prio_cache is not None else {}
+
+    def victim_priority(alloc: Allocation) -> int:
+        prio = prio_of.get(alloc.JobID)
+        if prio is None:
+            victim_job = state.job_by_id(alloc.JobID)
+            prio = (victim_job.Priority if victim_job is not None
+                    else JobDefaultPriority)
+            prio_of[alloc.JobID] = prio
+        return prio
+
+    best: Optional[PreemptedOption] = None
+    costed = 0
+    for node in nodes:
+        if node.Status != NodeStatusReady or node.Drain:
+            continue
+        # In-plan bookkeeping: allocs this eval already placed here count
+        # as live (both plan entries and not-yet-planned `pending`
+        # probes); allocs it already evicts are gone.
+        evicting = {a.ID for a in plan.NodeUpdate.get(node.ID, ())}
+        live = [a for a in state.allocs_by_node_terminal(node.ID, False)
+                if a.ID not in evicting]
+        live.extend(plan.NodeAllocation.get(node.ID, ()))
+        if pending:
+            live.extend(pending.get(node.ID, ()))
+        evictable = [
+            a for a in live
+            if a.JobID != job.ID
+            and not getattr(a, "_qos_probe", False)
+            and qos.tier_of(victim_priority(a)) > placing_tier
+        ]
+        if not evictable:
+            continue
+        # Constraint feasibility first — evicting from a node the TG can
+        # never run on frees nothing. (Capacity was the reason placement
+        # failed, but constraints decide which nodes are candidates.)
+        if not (node_meets_constraints(node, job.Constraints)
+                and node_meets_constraints(node, cons.constraints)
+                and node_has_drivers(node, cons.drivers)):
+            continue
+        costed += 1
+        evict_ids = {v.ID for v in evictable}
+        keep = [a for a in live if a.ID not in evict_ids]
+        try:
+            fit, _, _ = allocs_fit(node, keep + [probe])
+        except ValueError:
+            continue
+        if not fit:
+            continue  # even a full sweep of the tier can't make room
+        # Minimal victim set: lowest-priority first; among equals the
+        # YOUNGEST (highest CreateIndex) — least completed work lost.
+        ranked = sorted(evictable,
+                        key=lambda a: (victim_priority(a), -a.CreateIndex))
+        victims: List[Allocation] = []
+        remaining = list(live)
+        for victim in ranked:
+            if len(victims) >= qos.max_victims:
+                victims = []
+                break
+            victims.append(victim)
+            remaining = [a for a in remaining if a.ID != victim.ID]
+            try:
+                fit, _, _ = allocs_fit(node, remaining + [probe])
+            except ValueError:
+                fit = False
+            if fit:
+                break
+        else:
+            victims = []
+        if not victims:
+            continue
+        if best is None or len(victims) < len(best.victims):
+            best = PreemptedOption(
+                node=node,
+                task_resources={t.Name: (t.Resources.copy()
+                                         if t.Resources is not None
+                                         else Resources())
+                                for t in tg.Tasks},
+                victims=victims)
+            if len(victims) == 1:
+                break  # cannot do better
+        if costed >= _MAX_CANDIDATES:
+            break
+    return best
+
+
+def attempt_preemption(state, plan, eval_id: str, job: Job, place,
+                       options: List, nodes: Sequence, qos: QoSConfig,
+                       counters: Optional[QoSCounters] = None,
+                       log: Optional[logging.Logger] = None) -> List:
+    """Fill failed slots in ``options`` by preempting lower-tier allocs.
+    Mutates ``plan`` (victim evictions + ``_preempt`` descriptor) and
+    returns the patched options list; build_placement_allocs then emits
+    the placements exactly as if the stack had selected them."""
+    log = log or logger
+    if qos.tier_of(job.Priority) != TIER_HIGH:
+        return options
+    out = list(options)
+    prio_cache: Dict[str, int] = {}
+    # Placements this eval has already CHOSEN but not yet written into
+    # the plan: the stack's successful selections, plus each preemption
+    # pick as it lands. Without these, sibling instances of a Count>=2
+    # job all "find" the same freed capacity and the applier bounces the
+    # node on every retry.
+    pending: Dict[str, List[Allocation]] = {}
+    for tup, option in zip(place, options):
+        if option is not None:
+            pending.setdefault(option.node.ID, []).append(
+                _probe_alloc(tup.TaskGroup))
+    for i, (tup, option) in enumerate(zip(place, options)):
+        if option is not None:
+            continue
+        if counters is not None:
+            counters.incr("preempt_attempts")
+        metrics.incr_counter(("nomad", "qos", "preempt", "attempts"))
+        pick = find_preemption(state, plan, job, tup.TaskGroup, nodes, qos,
+                               job_prio_cache=prio_cache, pending=pending)
+        if pick is None:
+            continue
+        for victim in pick.victims:
+            plan.append_update(victim, AllocDesiredStatusEvict,
+                               ALLOC_PREEMPTED)
+        descriptor = getattr(plan, "_preempt", None)
+        if descriptor is None:
+            descriptor = plan._preempt = {}
+            plan._preempt_counts = {}
+        descriptor.setdefault(pick.node.ID, []).extend(
+            v.ID for v in pick.victims)
+        # Instances placed VIA preemption per node: a node can also carry
+        # this plan's normally-selected placements, and the commit-side
+        # counters must not claim those as preemptions.
+        plan._preempt_counts[pick.node.ID] = \
+            plan._preempt_counts.get(pick.node.ID, 0) + 1
+        out[i] = pick
+        pending.setdefault(pick.node.ID, []).append(
+            _probe_alloc(tup.TaskGroup))
+        # placed/evictions counters are COMMIT-side (plan_apply counts
+        # them when the verified plan lands): a rejected preemption plan
+        # must not inflate "landed" numbers.
+        trace.add_event("qos.preempt", eval=eval_id, node=pick.node.ID,
+                        victims=len(pick.victims))
+        log.debug("eval %s: preempting %d alloc(s) on node %s for job %s",
+                  eval_id, len(pick.victims), pick.node.ID, job.ID)
+    return out

@@ -46,6 +46,10 @@ ENV_VAR = "NOMAD_TPU_FAILPOINTS"
 # Sites threaded through the codebase, so the faults endpoint can list
 # what is armable even before any site has fired. Keep alphabetical.
 KNOWN_SITES: Dict[str, str] = {
+    "broker.admission": "server: QoS admission check at submission "
+                        "ingress (drop=forced shed -> typed backpressure "
+                        "to the submitter; error=failed submission; "
+                        "delay=slow admission)",
     "client.alloc_sync": "client: batched alloc status push to servers",
     "client.heartbeat": "client: node heartbeat to the leader",
     "client.register": "client: node registration RPC",
@@ -53,6 +57,11 @@ KNOWN_SITES: Dict[str, str] = {
     "gossip.probe": "gossip: direct ping of the probe target",
     "gossip.send": "gossip: outbound UDP datagram (drop=lost packet)",
     "plan.apply.commit": "server: plan applier's consensus commit",
+    "plan.preempt.commit": "server: consensus commit of a plan group "
+                           "carrying alloc preemptions (kill the applier "
+                           "mid-preemption; workers must nack, the broker "
+                           "redeliver exactly once, and evictions never "
+                           "commit without their placement)",
     "raft.append_entries": "raft: leader->peer AppendEntries send",
     "raft.fsync": "raft: durable log append fsync",
     "raft.request_vote": "raft: candidate->peer RequestVote send",

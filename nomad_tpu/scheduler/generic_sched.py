@@ -350,5 +350,21 @@ class GenericScheduler:
         options = self.stack.select_batch([t.TaskGroup for t in place])
         self.ctx.metrics.NodesAvailable = by_dc
 
+        # QoS preemption (capability beyond reference v0.4): a HIGH-tier
+        # placement that found no feasible capacity may evict lower-tier
+        # allocs; the plan applier re-verifies evictions + placement
+        # atomically per node. The planner (Worker) carries the config;
+        # no-op when QoS is off or nothing failed.
+        qos = getattr(self.planner, "qos", None)
+        if (qos is not None and qos.enabled and qos.preemption
+                and any(o is None for o in options)):
+            from nomad_tpu.qos import attempt_preemption
+
+            options = attempt_preemption(
+                self.state, self.plan, self.eval.ID, self.job, place,
+                options, nodes, qos,
+                counters=getattr(self.planner, "qos_counters", None),
+                log=self.logger)
+
         build_placement_allocs(self.eval, self.job, self.ctx, place, options,
                                self.plan, self.failed_tg_allocs)

@@ -28,6 +28,11 @@ from .eval_broker import EvalBroker
 class _Wrapped:
     eval: Evaluation
     token: str
+    # Original FIRST-enqueue monotonic timestamp, captured from the broker
+    # at block time (falling back to the parent eval's for a fresh blocked
+    # eval) and handed back on requeue — a capacity-unblocked eval must
+    # keep its queue age instead of resetting behind fresh arrivals.
+    age: float = 0.0
 
 
 @dataclass
@@ -96,6 +101,13 @@ class BlockedEvals:
         self._process_block(ev, token)
 
     def _process_block(self, ev: Evaluation, token: str) -> None:
+        # Queue-age carry: read BEFORE taking our lock (consistent
+        # blocked->broker lock order everywhere else in this file). A
+        # fresh blocked eval (new ID) inherits its parent's first-enqueue
+        # time; a reblocked eval still owns its own entry.
+        age = (self.eval_broker.queue_age(ev.ID)
+               or (self.eval_broker.queue_age(ev.PreviousEval)
+                   if ev.PreviousEval else None) or 0.0)
         with self._lock:
             if not self._enabled:
                 return
@@ -106,11 +118,13 @@ class BlockedEvals:
                 self._dup_cond.notify_all()
                 return
             if self._missed_unblock(ev):
-                self.eval_broker.enqueue_all({ev.ID: (ev, token)})
+                self.eval_broker.enqueue_all(
+                    {ev.ID: (ev, token)},
+                    ages={ev.ID: age} if age else None)
                 return
             self.stats.TotalBlocked += 1
             self._jobs.add(ev.JobID)
-            wrapped = _Wrapped(ev, token)
+            wrapped = _Wrapped(ev, token, age=age)
             if ev.EscapedComputedClass:
                 self._escaped[ev.ID] = wrapped
                 self.stats.TotalEscaped += 1
@@ -167,8 +181,11 @@ class BlockedEvals:
             if not self._enabled:
                 return
             unblocked: Dict[str, Tuple[Evaluation, str]] = {}
+            ages: Dict[str, float] = {}
             for eid, wrapped in list(self._escaped.items()):
                 unblocked[eid] = (wrapped.eval, wrapped.token)
+                if wrapped.age:
+                    ages[eid] = wrapped.age
                 del self._escaped[eid]
                 self._jobs.discard(wrapped.eval.JobID)
             for eid, wrapped in list(self._captured.items()):
@@ -176,12 +193,14 @@ class BlockedEvals:
                 if elig is False:
                     continue  # explicitly ineligible for this class
                 unblocked[eid] = (wrapped.eval, wrapped.token)
+                if wrapped.age:
+                    ages[eid] = wrapped.age
                 self._jobs.discard(wrapped.eval.JobID)
                 del self._captured[eid]
             if unblocked:
                 self.stats.TotalEscaped = 0
                 self.stats.TotalBlocked -= len(unblocked)
-                self.eval_broker.enqueue_all(unblocked)
+                self.eval_broker.enqueue_all(unblocked, ages=ages)
 
     def unblock_failed(self) -> None:
         """Periodic retry of evals blocked by plan failures
@@ -190,17 +209,20 @@ class BlockedEvals:
             if not self._enabled:
                 return
             unblocked: Dict[str, Tuple[Evaluation, str]] = {}
+            ages: Dict[str, float] = {}
             for source in (self._captured, self._escaped):
                 for eid, wrapped in list(source.items()):
                     if wrapped.eval.TriggeredBy == EvalTriggerMaxPlans:
                         unblocked[eid] = (wrapped.eval, wrapped.token)
+                        if wrapped.age:
+                            ages[eid] = wrapped.age
                         del source[eid]
                         self._jobs.discard(wrapped.eval.JobID)
                         if source is self._escaped:
                             self.stats.TotalEscaped -= 1
             if unblocked:
                 self.stats.TotalBlocked -= len(unblocked)
-                self.eval_broker.enqueue_all(unblocked)
+                self.eval_broker.enqueue_all(unblocked, ages=ages)
 
     def get_duplicates(self, timeout: float) -> List[Evaluation]:
         """Blocking fetch of duplicate blocked evals for cancellation

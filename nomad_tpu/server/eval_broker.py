@@ -6,6 +6,17 @@ serialization (one in-flight eval per job, rest held "blocked"); Ack/Nack
 with nack-timeout redelivery; delivery-limit overflow into the `_failed`
 queue; wait-time deferral; token-gated requeue (a scheduler reblocking its
 own eval defers until the outstanding one is Ack'd/Nack'd).
+
+QoS extension (beyond the reference — see README "QoS & SLO serving"):
+with a ``QoSConfig``, each ready queue splits into priority TIERS. High
+tier drains first; a lower tier's head is promoted one effective tier per
+``aging_s`` seconds queued, so saturating high-tier load can delay but
+never permanently starve it. The broker also remembers each eval's FIRST
+enqueue time across Nack redeliveries and blocked-eval requeues (a
+requeued eval must not reset behind fresh arrivals), and converts
+(first-enqueue -> ack) wait against the tier deadline into the per-tier
+SLO-burn signal admission control sheds on. QoS disabled (the default)
+keeps the single-heap path bit-identical to the reference behavior.
 """
 
 from __future__ import annotations
@@ -14,10 +25,13 @@ import heapq
 import itertools
 import random
 import threading
+import time
+from collections import deque
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional, Tuple
+from typing import Deque, Dict, List, Optional, Tuple
 
 from nomad_tpu.analysis import guarded_by, requires_lock
+from nomad_tpu.qos.tiers import N_TIERS, TIER_NAMES, QoSConfig, qos_enabled
 from nomad_tpu.structs import Evaluation, generate_uuid
 from nomad_tpu.telemetry import trace
 from nomad_tpu.timerwheel import TimerHandle, wheel
@@ -34,29 +48,100 @@ class TokenMismatchError(Exception):
 
 
 class _PriorityQueue:
-    """Max-priority heap of evaluations, FIFO within a priority."""
+    """Max-priority heap of evaluations, FIFO within a priority.
+
+    With an enabled QoS config the queue becomes TIERED: one heap per QoS
+    tier, served high-first with aging-based promotion (the head of a
+    lower tier gains one effective tier per ``aging_s`` waited; effective
+    ties go to the longer-waiting head, so progress is guaranteed even
+    under a saturating high-tier storm). Without one — the default — the
+    single-heap branch is byte-identical to the pre-QoS ordering."""
 
     _seq = itertools.count()
 
-    def __init__(self) -> None:
+    def __init__(self, qos: Optional[QoSConfig] = None) -> None:
         self._heap: List[Tuple[int, int, int, Evaluation]] = []
+        self._qos = qos if qos_enabled(qos) else None
+        self._tiers: Optional[List[list]] = (
+            [[] for _ in range(N_TIERS)] if self._qos is not None else None)
+        self.promoted = 0  # pops served from an aged-up tier
 
-    def push(self, ev: Evaluation) -> None:
-        heapq.heappush(self._heap,
-                       (-ev.Priority, ev.CreateIndex, next(self._seq), ev))
+    def push(self, ev: Evaluation, enq_time: float = 0.0) -> None:
+        if self._qos is None:
+            heapq.heappush(
+                self._heap,
+                (-ev.Priority, ev.CreateIndex, next(self._seq), ev))
+            return
+        tier = self._qos.tier_of(ev.Priority)
+        # enq_time rides the entry (never compared: seq is unique) so the
+        # aging check reads the head's ORIGINAL enqueue time — preserved
+        # across Nack/blocked requeues by the broker's age map.
+        heapq.heappush(
+            self._tiers[tier],
+            (-ev.Priority, ev.CreateIndex, next(self._seq), ev, enq_time))
 
-    def pop(self) -> Optional[Evaluation]:
-        if not self._heap:
+    def _best_tier(self, now: float) -> Optional[Tuple[int, tuple]]:
+        """(tier, sort key) of the entry pop would serve: minimize
+        (effective tier, head enqueue time). Aging promotes a head one
+        tier per aging_s waited; equal effective tiers go to the OLDER
+        head — the anti-starvation guarantee."""
+        best = None
+        for tier in range(N_TIERS):
+            heap = self._tiers[tier]
+            if not heap:
+                continue
+            enq = heap[0][4] or now
+            eff = tier
+            if self._qos.aging_s > 0:
+                eff = max(0, tier - int((now - enq) / self._qos.aging_s))
+            key = (eff, enq)
+            if best is None or key < best[1]:
+                best = (tier, key)
+        return best
+
+    def pop(self, now: Optional[float] = None) -> Optional[Evaluation]:
+        if self._qos is None:
+            if not self._heap:
+                return None
+            return heapq.heappop(self._heap)[3]
+        best = self._best_tier(now if now is not None else time.monotonic())
+        if best is None:
             return None
-        return heapq.heappop(self._heap)[3]
+        tier, (eff, _) = best
+        if eff < tier:
+            self.promoted += 1
+        return heapq.heappop(self._tiers[tier])[3]
 
-    def peek(self) -> Optional[Evaluation]:
-        if not self._heap:
+    def peek(self, now: Optional[float] = None) -> Optional[Evaluation]:
+        if self._qos is None:
+            if not self._heap:
+                return None
+            return self._heap[0][3]
+        best = self._best_tier(now if now is not None else time.monotonic())
+        if best is None:
             return None
-        return self._heap[0][3]
+        return self._tiers[best[0]][0][3]
+
+    def peek_key(self, now: float) -> Optional[tuple]:
+        """Cross-scheduler comparison key for _scan: lower sorts first."""
+        if self._qos is None:
+            head = self.peek()
+            return None if head is None else (-head.Priority,)
+        best = self._best_tier(now)
+        if best is None:
+            return None
+        tier, key = best
+        return key + (-self._tiers[tier][0][3].Priority,)
+
+    def tier_depths(self) -> List[int]:
+        if self._tiers is None:
+            return [len(self._heap), 0, 0]
+        return [len(h) for h in self._tiers]
 
     def __len__(self) -> int:
-        return len(self._heap)
+        if self._qos is None:
+            return len(self._heap)
+        return sum(len(h) for h in self._tiers)
 
 
 @dataclass
@@ -78,13 +163,15 @@ class BrokerStats:
 class EvalBroker:
     _concurrency = guarded_by(
         "_lock", "_enabled", "_evals", "_job_evals", "_blocked", "_ready",
-        "_unack", "_requeue", "_time_wait", "stats")
+        "_unack", "_requeue", "_time_wait", "stats", "_ages", "_slo")
 
-    def __init__(self, nack_timeout: float = 60.0, delivery_limit: int = 3):
+    def __init__(self, nack_timeout: float = 60.0, delivery_limit: int = 3,
+                 qos: Optional[QoSConfig] = None):
         if nack_timeout < 0:
             raise ValueError("timeout cannot be negative")
         self.nack_timeout = nack_timeout
         self.delivery_limit = delivery_limit
+        self.qos = qos
         self._enabled = False
         self._lock = threading.Lock()
         self._cond = threading.Condition(self._lock)
@@ -96,7 +183,20 @@ class EvalBroker:
         self._unack: Dict[str, _Unack] = {}
         self._requeue: Dict[str, Evaluation] = {}  # token -> eval
         self._time_wait: Dict[str, TimerHandle] = {}
+        # Queue-age memory: eval id -> FIRST enqueue (monotonic). Kept
+        # across Nack redeliveries and seeded by blocked-eval requeues
+        # (enqueue_all ages=), dropped at Ack/flush — so an aged eval is
+        # never reset behind fresh arrivals, and ack-time wait vs the tier
+        # deadline feeds the SLO-burn rings below.
+        self._ages: Dict[str, float] = {}
+        # Per-tier ring of recent completions: True = blew its deadline.
+        self._slo: List[Deque[bool]] = [
+            deque(maxlen=(qos.burn_window if qos_enabled(qos) else 1))
+            for _ in range(N_TIERS)]
         self.stats = BrokerStats()
+
+    def _queue(self) -> _PriorityQueue:
+        return _PriorityQueue(self.qos)
 
     # ------------------------------------------------------------- lifecycle
     def enabled(self) -> bool:
@@ -123,6 +223,7 @@ class EvalBroker:
             self._unack.clear()
             self._requeue.clear()
             self._time_wait.clear()
+            self._ages.clear()
             self.stats = BrokerStats()
             self._cond.notify_all()
 
@@ -131,9 +232,18 @@ class EvalBroker:
         with self._lock:
             self._process_enqueue(ev, "")
 
-    def enqueue_all(self, evals: Dict[str, Tuple[Evaluation, str]]) -> None:
-        """evals: eval.ID -> (eval, token) for token-gated requeues."""
+    def enqueue_all(self, evals: Dict[str, Tuple[Evaluation, str]],
+                    ages: Optional[Dict[str, float]] = None) -> None:
+        """evals: eval.ID -> (eval, token) for token-gated requeues.
+        ``ages`` seeds original first-enqueue times (monotonic) for evals
+        re-entering from outside the broker — BlockedEvals carries them so
+        a capacity-requeued eval keeps its queue age instead of resetting
+        behind fresh arrivals."""
         with self._lock:
+            if ages:
+                for eid, ts in ages.items():
+                    if ts:
+                        self._ages.setdefault(eid, ts)
             for ev, token in evals.values():
                 self._process_enqueue(ev, token)
 
@@ -171,6 +281,10 @@ class EvalBroker:
     def _enqueue_locked(self, ev: Evaluation, queue: str) -> None:
         if not self._enabled:
             return
+        # First-enqueue memory: a Nack redelivery or blocked requeue keeps
+        # the original timestamp (setdefault), so tier aging and SLO burn
+        # see the eval's TRUE queue age, not its latest re-entry.
+        enq_time = self._ages.setdefault(ev.ID, time.monotonic())
         pending = self._job_evals.get(ev.JobID, "")
         if pending == "":
             self._job_evals[ev.JobID] = ev.ID
@@ -178,7 +292,7 @@ class EvalBroker:
             self._blocked.setdefault(ev.JobID, _PriorityQueue()).push(ev)
             self.stats.TotalBlocked += 1
             return
-        self._ready.setdefault(queue, _PriorityQueue()).push(ev)
+        self._ready.setdefault(queue, self._queue()).push(ev, enq_time)
         self.stats.TotalReady += 1
         sched = self.stats.ByScheduler.setdefault(
             queue, {"Ready": 0, "Unacked": 0})
@@ -261,7 +375,29 @@ class EvalBroker:
     @requires_lock("_lock")
     def _scan(self, schedulers: List[str]
               ) -> Optional[Tuple[Evaluation, str]]:
-        eligible: List[str] = []
+        if qos_enabled(self.qos):
+            # Tier-aware scan: pick the scheduler whose head has the best
+            # (effective tier, queue age, priority) key — high tier drains
+            # first, aged lower tiers promote, ties go to the oldest.
+            now = time.monotonic()
+            best_key = None
+            eligible: List[str] = []
+            for sched in schedulers:
+                pending = self._ready.get(sched)
+                if pending is None:
+                    continue
+                key = pending.peek_key(now)
+                if key is None:
+                    continue
+                if best_key is None or key < best_key:
+                    best_key = key
+                    eligible = [sched]
+                elif key == best_key:
+                    eligible.append(sched)
+            if not eligible:
+                return None
+            return self._dequeue_for_sched(random.choice(eligible), now=now)
+        eligible = []
         eligible_priority = 0
         for sched in schedulers:
             pending = self._ready.get(sched)
@@ -280,8 +416,10 @@ class EvalBroker:
         return self._dequeue_for_sched(random.choice(eligible))
 
     @requires_lock("_lock")
-    def _dequeue_for_sched(self, sched: str) -> Tuple[Evaluation, str]:
-        ev = self._ready[sched].pop()
+    def _dequeue_for_sched(self, sched: str,
+                           now: Optional[float] = None
+                           ) -> Tuple[Evaluation, str]:
+        ev = self._ready[sched].pop(now)
         entry = trace.linked_entry("eval", ev.ID)
         if entry is not None:
             # Synthesized queue-wait span: enqueue-link time -> now.
@@ -368,6 +506,14 @@ class EvalBroker:
             raise TokenMismatchError(eval_id)
         unack.nack_timer.cancel()
         job_id = unack.eval.JobID
+        enq_time = self._ages.pop(eval_id, 0.0)
+        if qos_enabled(self.qos) and enq_time:
+            # SLO burn: did this eval's whole broker residency (first
+            # enqueue -> ack, spanning redeliveries) blow its tier
+            # deadline? Admission control sheds lower tiers on this.
+            tier = self.qos.tier_of(unack.eval.Priority)
+            waited = time.monotonic() - enq_time
+            self._slo[tier].append(waited > self.qos.deadlines_s[tier])
 
         self.stats.TotalUnacked -= 1
         queue = unack.eval.Type
@@ -390,6 +536,13 @@ class EvalBroker:
             self._enqueue_locked(ev, ev.Type)
 
         if requeued is not None:
+            # Token-gated deferred requeue: the SAME logical eval keeps
+            # waiting, so it keeps its original queue age (the pop above
+            # closed the SLO measurement for the delivery that just
+            # acked; without re-seeding, the requeue would reset the
+            # aging clock behind fresh arrivals).
+            if enq_time:
+                self._ages.setdefault(eval_id, enq_time)
             self._process_enqueue(requeued, "")
 
     def nack(self, eval_id: str, token: str) -> None:
@@ -411,3 +564,45 @@ class EvalBroker:
                 self._enqueue_locked(unack.eval, FAILED_QUEUE)
             else:
                 self._enqueue_locked(unack.eval, unack.eval.Type)
+
+    # ------------------------------------------------------ QoS introspection
+    def queue_age(self, eval_id: str) -> Optional[float]:
+        """Monotonic timestamp of the eval's FIRST enqueue (preserved
+        across Nack redeliveries), or None once acked/unknown."""
+        with self._lock:
+            return self._ages.get(eval_id)
+
+    def tier_depths(self) -> List[int]:
+        """Ready-queue depth per QoS tier, summed over scheduler types
+        (all zeros except tier 0 totals when QoS is disabled)."""
+        with self._lock:
+            out = [0] * N_TIERS
+            for sched, pending in self._ready.items():
+                if sched == FAILED_QUEUE:
+                    continue
+                for tier, n in enumerate(pending.tier_depths()):
+                    out[tier] += n
+            return out
+
+    def tier_promotions(self) -> int:
+        """Total aged-up pops (anti-starvation promotions served)."""
+        with self._lock:
+            return sum(q.promoted for q in self._ready.values())
+
+    def slo_burn(self) -> List[float]:
+        """Per-tier fraction of recent completions that blew their tier
+        deadline (first enqueue -> ack), over the burn_window ring."""
+        with self._lock:
+            return [(sum(ring) / len(ring)) if ring else 0.0
+                    for ring in self._slo]
+
+    def qos_stats(self) -> Dict[str, Dict[str, float]]:
+        """Named-tier snapshot for the sched-stats surface."""
+        depths = self.tier_depths()
+        burn = self.slo_burn()
+        return {
+            "TierDepths": dict(zip(TIER_NAMES, depths)),
+            "SLOBurn": {name: round(b, 4)
+                        for name, b in zip(TIER_NAMES, burn)},
+            "Promoted": self.tier_promotions(),
+        }

@@ -117,6 +117,7 @@ STATS_COUNTERS = (
     "multi",      # fused place_batch_multi launches
     "windows",    # dispatched windows
     "rebases",    # chain rebases onto committed usage
+    "qos_cut",    # windows cut short by a tier's deadline budget (QoS)
 )
 STATS_TIMERS_MS = (
     "t_lease_ms",        # waiting for the shared chain-lease (ChainArbiter)
@@ -319,7 +320,7 @@ class PipelinedWorker(Worker):
                 if lease.rebased:
                     self.stats["rebases"] += 1
                 try:
-                    batch.extend(self._fill_window())
+                    batch.extend(self._fill_window(got[0]))
                     work = self._dispatch_window(batch, lease)
                 except Exception:
                     # Broker/plan-queue teardown on leadership loss: drop
@@ -462,17 +463,39 @@ class PipelinedWorker(Worker):
         self._window_wait_index = wait_index
         return ev, token
 
-    def _fill_window(self) -> List[Tuple[Evaluation, str]]:
+    def _fill_window(self, first: Optional[Evaluation] = None
+                     ) -> List[Tuple[Evaluation, str]]:
         """Fill the rest of the window in ONE broker lock round
         (EvalBroker.dequeue_window), AFTER the chain lease is in hand:
         with N workers, per-eval fill loops interleave-steal each other's
         windows and convoy on the broker lock — the batch hands this
         worker a disjoint, contiguous set, including everything that
-        arrived while another worker's dispatch held the lease."""
+        arrived while another worker's dispatch held the lease.
+
+        With QoS enabled the window carries a LATENCY BUDGET derived from
+        the first (oldest) eval's tier deadline and its true queue age
+        (preserved across redeliveries): a budget-tight window takes fewer
+        evals and lingers less for stragglers — it dispatches short rather
+        than blowing the tier's deadline on batch efficiency."""
+        count = self.window - 1
+        if count <= 0:
+            return []  # window=1 never batch-fills, QoS or not
+        fill = FILL_TIMEOUT
+        qos = self.qos
+        if qos is not None and qos.enabled and first is not None:
+            enq_ts = self.eval_broker.queue_age(first.ID)
+            if enq_ts is not None:
+                count, fill = qos.window_fill(
+                    time.monotonic() - enq_ts, first.Priority,
+                    count, FILL_TIMEOUT)
+                if count < self.window - 1:
+                    self.stats["qos_cut"] += 1
+                    if self.qos_counters is not None:
+                        self.qos_counters.incr("window_cuts")
         try:
             return self.eval_broker.dequeue_window(
-                self.schedulers, self.window - 1, FILL_TIMEOUT,
-                fill_timeout=FILL_TIMEOUT)
+                self.schedulers, count, FILL_TIMEOUT,
+                fill_timeout=fill)
         except RuntimeError:
             return []
 
@@ -932,6 +955,21 @@ class PipelinedWorker(Worker):
                     logger.debug(
                         "eval %s failed placements behind phantom window "
                         "usage; re-running per-eval", rec.ev.ID)
+                    rec.fallback = True
+
+        # QoS preemption routing: a HIGH-tier eval that could not fully
+        # place must not quietly park as a blocked eval — it re-runs on
+        # the exact per-eval path, where the scheduler may evict
+        # lower-tier allocs to make room (qos/preemption.py). Lower tiers
+        # keep the normal blocked-eval flow.
+        qos = self.qos
+        if qos is not None and qos.enabled and qos.preemption:
+            from nomad_tpu.qos.tiers import TIER_HIGH
+
+            for rec in fast:
+                if (not rec.fallback and not rec.stale
+                        and rec.failed_tg_allocs
+                        and qos.tier_of(rec.ev.Priority) == TIER_HIGH):
                     rec.fallback = True
 
         eval_updates: List[Evaluation] = []

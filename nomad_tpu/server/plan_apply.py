@@ -60,12 +60,26 @@ _APPLY_BATCH = 16
 
 
 def _result_allocs(result: "PlanResult") -> List[Allocation]:
+    # NodeUpdate (evictions/stops) precede NodeAllocation deliberately:
+    # the FSM upserts in list order, so within one commit the state store
+    # observes stop-then-place — a preemption's victims are terminal
+    # before its placement lands.
     allocs: List[Allocation] = []
     for updates in result.NodeUpdate.values():
         allocs.extend(updates)
     for placed in result.NodeAllocation.values():
         allocs.extend(placed)
     return allocs
+
+
+def _fire_preempt_commit(plans) -> None:
+    """Failure seam: a consensus commit carrying alloc preemptions. Like
+    plan.apply.commit, drop degrades to a failed apply — the waiting
+    workers nack, the broker redelivers, and because evictions and their
+    placement ride ONE entry, a killed commit loses both or neither."""
+    if any(getattr(p, "_preempt", None) for p in plans):
+        if failpoints.fire("plan.preempt.commit") == "drop":
+            raise failpoints.FailpointError("plan.preempt.commit")
 
 
 class OptimisticSnapshot:
@@ -304,6 +318,18 @@ def evaluate_plan(snap, plan: Plan,
         for nid in exact_ids:
             decided[nid] = _evaluate_node_plan(snap, plan, nid)
 
+    preempt = getattr(plan, "_preempt", None)
+    if preempt:
+        # Preemption atomicity, belt-and-braces: a preempting node's
+        # evictions must NEVER commit without their placement. The
+        # per-node verify already drops both sides of a node together;
+        # this guards a malformed plan (evictions recorded, placement
+        # stripped) from riding the evict-only-always-fits rule — on
+        # BOTH the wholesale-admit and the partial paths below.
+        for nid in preempt:
+            if decided.get(nid) and not plan.NodeAllocation.get(nid):
+                decided[nid] = False
+
     if decided and len(decided) == len(node_ids) \
             and all(decided.values()):
         # Everything fits (the healthy-sweep common case): admit the plan
@@ -371,11 +397,16 @@ class PlanApplier:
 
     def __init__(self, plan_queue: PlanQueue, raft: DevRaft,
                  eval_broker: Optional[EvalBroker] = None,
-                 pool_size: Optional[int] = None, tindex=None):
+                 pool_size: Optional[int] = None, tindex=None,
+                 qos_counters=None):
         self.plan_queue = plan_queue
         self.raft = raft
         self.eval_broker = eval_broker
         self.tindex = tindex
+        # QoS flow counters (qos/tiers.py QoSCounters): preempt_placed /
+        # preempt_evictions are counted HERE, at commit, so rejected
+        # preemption plans never inflate the "landed" numbers.
+        self.qos_counters = qos_counters
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._retired: List[threading.Thread] = []
@@ -389,6 +420,34 @@ class PlanApplier:
 
     def _nt(self):
         return self.tindex.nt if self.tindex is not None else None
+
+    def _count_preempt(self, plan: Plan, result: PlanResult) -> None:
+        """Count preemption outcomes that actually COMMITTED: placements
+        on preempting nodes that survived verification, and the victim
+        evictions that rode them."""
+        descriptor = getattr(plan, "_preempt", None)
+        if not descriptor:
+            return
+        counts = getattr(plan, "_preempt_counts", None) or {}
+        placed = evicted = 0
+        for node_id, victim_ids in descriptor.items():
+            landed = result.NodeAllocation.get(node_id)
+            if landed:
+                # Only the instances placed VIA preemption count — the
+                # node may also carry the plan's normal placements.
+                placed += min(counts.get(node_id, len(landed)),
+                              len(landed))
+                committed = {a.ID for a in result.NodeUpdate.get(node_id,
+                                                                 ())}
+                evicted += sum(1 for v in victim_ids if v in committed)
+        if not placed:
+            return
+        if self.qos_counters is not None:
+            self.qos_counters.incr("preempt_placed", placed)
+            self.qos_counters.incr("preempt_evictions", evicted)
+        metrics.incr_counter(("nomad", "qos", "preempt", "placed"), placed)
+        metrics.incr_counter(("nomad", "qos", "preempt", "evictions"),
+                             evicted)
 
     def start(self) -> None:
         """Each run gets its OWN stop event, handed to the thread — a
@@ -658,6 +717,8 @@ class PlanApplier:
                         if failpoints.fire("plan.apply.commit") == "drop":
                             raise failpoints.FailpointError(
                                 "plan.apply.commit")
+                        _fire_preempt_commit(
+                            p.plan for p, _ in group)
                         index = self.raft.apply(MessageType.AllocUpdate, {
                             "Batch": [{"Job": pending.plan.Job,
                                        "Alloc": _result_allocs(result)}
@@ -670,6 +731,7 @@ class PlanApplier:
             for pending, result in group:
                 result.AllocIndex = index
                 self.stats["applied"] += 1
+                self._count_preempt(pending.plan, result)
                 pending.respond(result, None)
         # lint: allow(swallow, error is delivered to every plan's waiter)
         except Exception as e:
@@ -692,6 +754,7 @@ class PlanApplier:
                               "plan.apply", eval=pending.plan.EvalID,
                               batch=1):
                 result.AllocIndex = self._apply(pending.plan, result)
+            self._count_preempt(pending.plan, result)
         pending.respond(result, None)
 
     def _apply(self, plan: Plan, result: PlanResult) -> int:
@@ -701,6 +764,7 @@ class PlanApplier:
         # always surfaces as a failed apply (workers nack + re-evaluate).
         if failpoints.fire("plan.apply.commit") == "drop":
             raise failpoints.FailpointError("plan.apply.commit")
+        _fire_preempt_commit((plan,))
         return self.raft.apply(MessageType.AllocUpdate, {
             "Job": plan.Job,
             "Alloc": _result_allocs(result),

@@ -47,6 +47,12 @@ from nomad_tpu.structs.structs import (
     NodeStatusReady,
     valid_node_status,
 )
+from nomad_tpu.qos import (
+    AdmissionController,
+    QoSConfig,
+    QoSCounters,
+    qos_enabled,
+)
 from nomad_tpu.telemetry import metrics
 from nomad_tpu.tensor import TensorIndex
 from nomad_tpu.raft import NotLeaderError
@@ -114,6 +120,13 @@ class ServerConfig:
     # consensus-throughput wall. 0 disables (one apply per RPC).
     alloc_update_batch_interval: float = 0.05
     dev_mode: bool = False
+    # QoS subsystem (nomad_tpu/qos/): priority-tiered broker lanes,
+    # deadline-aware worker windows, admission control at submission
+    # ingress, and alloc preemption for high-tier placements. None (the
+    # default) keeps the served path bit-identical to pre-QoS behavior;
+    # pass QoSConfig(enabled=True, ...) to opt in (README "QoS & SLO
+    # serving" documents every knob).
+    qos: Optional["QoSConfig"] = None
     # Replicated deployment (reference: nomad/config.go RaftConfig +
     # BootstrapExpect). node_id doubles as the raft/transport address.
     node_id: str = ""
@@ -178,12 +191,20 @@ class Server:
             self.tindex.nt.set_mesh(
                 scheduling_mesh(pow2_prefix(jax.devices())))
 
+        # QoS: tiered broker lanes + admission at ingress + preemption in
+        # the scheduler, all sharing one config and one counter block.
+        self.qos = self.config.qos or QoSConfig()
+        self.qos_counters = QoSCounters()
         self.eval_broker = EvalBroker(self.config.eval_nack_timeout,
-                                      self.config.eval_delivery_limit)
+                                      self.config.eval_delivery_limit,
+                                      qos=self.qos)
+        self.admission = AdmissionController(self.qos, self.eval_broker,
+                                             self.qos_counters)
         self.blocked_evals = BlockedEvals(self.eval_broker)
         self.plan_queue = PlanQueue()
         self.plan_applier = PlanApplier(self.plan_queue, self.raft,
-                                        self.eval_broker, tindex=self.tindex)
+                                        self.eval_broker, tindex=self.tindex,
+                                        qos_counters=self.qos_counters)
         # Owned by the FSM so it is persisted in snapshots and rebuilt from
         # apply on every replica (survives leader failover).
         self.timetable = self.fsm.timetable
@@ -243,6 +264,8 @@ class Server:
             w = Worker(self.raft, None, None, None, self.tindex,
                        schedulers=list(self.config.enabled_schedulers),
                        backend=backend)
+            w.qos = self.qos
+            w.qos_counters = self.qos_counters
             # Register under the leadership lock: an election landing here
             # must either see the worker (establish pauses it) or have
             # already set _leader (we pause it ourselves).
@@ -323,6 +346,8 @@ class Server:
                            self.blocked_evals, self.tindex, schedulers)
             w.scheduler_impl = self.config.scheduler_impl
             w.core_scheduler = self.core_sched
+            w.qos = self.qos
+            w.qos_counters = self.qos_counters
             w.start(name=f"worker-{i}")
             self.workers.append(w)
 
@@ -426,6 +451,18 @@ class Server:
                           self.plan_queue.stats["Depth"])
         metrics.set_gauge(("nomad", "heartbeat", "active"),
                           len(self.heartbeats))
+        if qos_enabled(self.qos):
+            from nomad_tpu.qos import TIER_NAMES
+
+            depths = self.eval_broker.tier_depths()
+            burn = self.eval_broker.slo_burn()
+            for tier, name in enumerate(TIER_NAMES):
+                metrics.set_gauge(("nomad", "qos", "tier", name, "ready"),
+                                  depths[tier])
+                metrics.set_gauge(("nomad", "qos", "tier", name, "burn"),
+                                  burn[tier])
+            metrics.set_gauge(("nomad", "qos", "tier", "promoted"),
+                              self.eval_broker.tier_promotions())
 
     def _start_loop(self, fn, interval: float) -> None:
         def loop():
@@ -565,6 +602,12 @@ class Server:
         errs = job.validate()
         if errs:
             raise ValueError("; ".join(errs))
+        if trigger == EvalTriggerJobRegister:
+            # Admission control gates USER submissions only, before any
+            # raft write — internal triggers (periodic launches, node
+            # evals, requeues) always pass. Raises QoSBackpressureError
+            # (typed; RPC remote_type / HTTP 429) to shed.
+            self.admission.admit(job.Priority)
         if enforce_index is not None:
             existing = self.state.job_by_id(job.ID)
             cur = existing.JobModifyIndex if existing is not None else 0
@@ -703,6 +746,8 @@ class Server:
             raise KeyError(f"job not found: {job_id}")
         if job.is_periodic():
             raise ValueError("can't evaluate periodic job")
+        # Forced re-evaluation is user ingress like register: gated.
+        self.admission.admit(job.Priority)
         ev = Evaluation(
             ID=generate_uuid(),
             Priority=job.Priority,

@@ -265,6 +265,12 @@ class Worker:
         # its report by this) and stage-thread names; start() overwrites
         # it with the server-assigned name.
         self.name = "worker"
+        # QoS wiring (set by the Server like core_scheduler below): the
+        # scheduler reads these off its Planner for preemption decisions,
+        # and the pipelined worker for deadline-aware window sizing.
+        # None = QoS disabled (the default, pre-QoS behavior).
+        self.qos = None
+        self.qos_counters = None
         self._stop = threading.Event()
         # Share our stop event with a backend that paces on one (the
         # RemoteBackend's leaderless/error backoffs), so stop() wakes a
@@ -346,6 +352,10 @@ class Worker:
         if got is None:
             return False
         ev, token, wait_index = got
+        # Same Planner-seam state as run(): update_eval/create_eval read
+        # self._token — without this, a second process_one call would
+        # submit its eval updates under the PREVIOUS eval's token.
+        self._eval, self._token = ev, token
         try:
             with trace.resume(trace.linked("eval", ev.ID),
                               "worker.process_eval",

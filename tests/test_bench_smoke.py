@@ -54,3 +54,12 @@ def test_bench_smoke_completes_with_parity():
         assert key in scaling
     assert scaling["workers_1"] > 0 and scaling["workers_2"] > 0
     assert scaling["ratio"] >= 0.6, scaling
+    # The QoS slo_storm ran parity-gated (ISSUE 8): both modes placed the
+    # full mixed-priority storm, per-tier percentiles recorded, and the
+    # deterministic admission/preemption probes shed and preempted.
+    slo = detail["slo_storm"]
+    assert slo["parity_ok"] is True, slo
+    assert slo["admission_probe"]["ok"] is True, slo
+    assert slo["preempt_probe"]["ok"] is True, slo
+    for mode in ("qos_off", "qos_on"):
+        assert slo[mode]["high_ms"].get("p99", 0) > 0, slo

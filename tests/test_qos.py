@@ -1,0 +1,559 @@
+"""QoS subsystem: tiered broker lanes, queue-age carry, deadline-aware
+window sizing, admission control, and the disabled-mode equivalence gate
+(ISSUE 8). Preemption has its own file (test_qos_preemption.py)."""
+
+import io
+import threading
+import time
+import urllib.error
+
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.qos import (
+    AdmissionController,
+    QoSBackpressureError,
+    QoSConfig,
+    QoSCounters,
+    TIER_HIGH,
+    TIER_LOW,
+    TIER_NORMAL,
+)
+from nomad_tpu.resilience import failpoints
+from nomad_tpu.server.blocked_evals import BlockedEvals
+from nomad_tpu.server.eval_broker import EvalBroker
+from nomad_tpu.server.server import Server, ServerConfig
+from nomad_tpu.structs import Evaluation, compute_node_class
+from nomad_tpu.structs.structs import (
+    EvalStatusComplete,
+    EvalStatusPending,
+    JobTypeService,
+)
+
+from helpers import wait_for  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _heal_failpoints():
+    failpoints.disarm_all()
+    yield
+    failpoints.disarm_all()
+
+
+def make_eval(eid, priority, job_id=None, create_index=0):
+    return Evaluation(ID=eid, Priority=priority, Type=JobTypeService,
+                      TriggeredBy="job-register", JobID=job_id or eid,
+                      Status=EvalStatusPending, CreateIndex=create_index)
+
+
+def enabled_broker(**kw):
+    qos = QoSConfig(enabled=True, **kw)
+    broker = EvalBroker(qos=qos)
+    broker.set_enabled(True)
+    return broker, qos
+
+
+class TestTierModel:
+    def test_tier_mapping(self):
+        qos = QoSConfig(enabled=True)
+        assert qos.tier_of(100) == TIER_HIGH
+        assert qos.tier_of(70) == TIER_HIGH
+        assert qos.tier_of(50) == TIER_NORMAL
+        assert qos.tier_of(30) == TIER_LOW
+        assert qos.tier_of(1) == TIER_LOW
+        # Core evals (priority 200) land high.
+        assert qos.tier_of(200) == TIER_HIGH
+
+    def test_window_fill_scales_with_budget(self):
+        qos = QoSConfig(enabled=True, deadlines_s=(1.0, 4.0, 16.0))
+        # Fresh eval: full window, full linger.
+        count, fill = qos.window_fill(0.0, 90, 31, 0.002)
+        assert count == 31 and fill == 0.002
+        # Half the budget gone: window shrinks.
+        count, fill = qos.window_fill(0.5, 90, 31, 0.002)
+        assert 1 <= count <= 16
+        # Budget blown: smallest useful window, no linger.
+        count, fill = qos.window_fill(2.0, 90, 31, 0.002)
+        assert count == max(1, 31 // 8) and fill == 0.0
+
+    def test_window_one_never_batch_fills_under_qos(self):
+        # Review regression: scheduler_window=1 means "no batch fill";
+        # window_fill's max(1, ...) floor must not resurrect a fill of 1.
+        from nomad_tpu.server.pipelined_worker import PipelinedWorker
+        from nomad_tpu.server.fsm import FSM, DevRaft
+        from nomad_tpu.tensor import TensorIndex
+
+        broker = EvalBroker(qos=QoSConfig(enabled=True))
+        broker.set_enabled(True)
+        fsm = FSM()
+        w = PipelinedWorker(DevRaft(fsm), broker, None, None,
+                            TensorIndex(), window=1)
+        w.qos = QoSConfig(enabled=True)
+        broker.enqueue(make_eval("extra", 90))
+        assert w._fill_window(make_eval("first", 90)) == []
+        assert broker.stats.TotalReady == 1  # the extra eval stayed queued
+
+    def test_window_fill_near_fresh_keeps_full_window(self):
+        # Regression: int() flooring reported a 1-eval "cut" on every
+        # healthy window (age of a few ms), poisoning window_cuts.
+        qos = QoSConfig(enabled=True)
+        count, _ = qos.window_fill(0.003, 90, 31, 0.002)
+        assert count == 31
+
+
+class TestTieredBroker:
+    def test_high_tier_drains_first(self):
+        broker, _ = enabled_broker()
+        for i in range(10):
+            broker.enqueue(make_eval(f"low{i}", 10, create_index=i))
+        for i in range(3):
+            broker.enqueue(make_eval(f"high{i}", 90, create_index=100 + i))
+        got = [broker.dequeue(["service"], timeout=1)[0].ID
+               for _ in range(5)]
+        assert got[:3] == ["high0", "high1", "high2"], got
+        assert all(g.startswith("low") for g in got[3:])
+
+    def test_saturating_high_cannot_starve_low(self):
+        # Continuous high-tier arrivals; an aged low eval must still be
+        # served (promotion one tier per aging_s).
+        broker, _ = enabled_broker(aging_s=0.05)
+        broker.enqueue(make_eval("low", 10))
+        time.sleep(0.16)  # ages past 2 * aging_s -> effective high tier
+        served_low_at = None
+        for i in range(20):
+            broker.enqueue(make_eval(f"high{i}", 90, create_index=i))
+            ev, token = broker.dequeue(["service"], timeout=1)
+            broker.ack(ev.ID, token)
+            if ev.ID == "low":
+                served_low_at = i
+                break
+        assert served_low_at is not None, "aged low eval never served"
+        assert broker.tier_promotions() >= 1
+
+    def test_window_dequeue_orders_tiers(self):
+        broker, _ = enabled_broker()
+        for i in range(4):
+            broker.enqueue(make_eval(f"low{i}", 10, create_index=i))
+        broker.enqueue(make_eval("high", 90, create_index=50))
+        window = broker.dequeue_window(["service"], 5, timeout=1,
+                                       fill_timeout=0.01)
+        assert window[0][0].ID == "high"
+        assert len(window) == 5
+
+    def test_disabled_broker_matches_legacy_ordering(self):
+        # qos=None and QoSConfig(enabled=False) must produce the exact
+        # pre-QoS ordering: priority desc, then CreateIndex asc.
+        for qos in (None, QoSConfig(enabled=False)):
+            broker = EvalBroker(qos=qos)
+            broker.set_enabled(True)
+            broker.enqueue(make_eval("b", 50, create_index=2))
+            broker.enqueue(make_eval("a", 50, create_index=1))
+            broker.enqueue(make_eval("c", 80, create_index=3))
+            got = [broker.dequeue(["service"], timeout=1)[0].ID
+                   for _ in range(3)]
+            assert got == ["c", "a", "b"], (qos, got)
+
+    def test_tier_depths_and_qos_stats(self):
+        broker, _ = enabled_broker()
+        broker.enqueue(make_eval("l", 10))
+        broker.enqueue(make_eval("n", 50))
+        broker.enqueue(make_eval("h", 90))
+        assert broker.tier_depths() == [1, 1, 1]
+        stats = broker.qos_stats()
+        assert stats["TierDepths"] == {"high": 1, "normal": 1, "low": 1}
+        assert set(stats["SLOBurn"]) == {"high", "normal", "low"}
+
+    def test_slo_burn_records_deadline_misses(self):
+        broker, qos = enabled_broker(deadlines_s=(0.0, 0.0, 0.0))
+        broker.enqueue(make_eval("h", 90))
+        ev, token = broker.dequeue(["service"], timeout=1)
+        time.sleep(0.01)  # any wait > 0.0 deadline = a miss
+        broker.ack(ev.ID, token)
+        assert broker.slo_burn()[TIER_HIGH] == 1.0
+
+
+class TestQueueAgeCarry:
+    """Satellite regression (ISSUE 8): Nack and blocked-eval requeues
+    must carry the ORIGINAL enqueue time — a requeued eval re-entering
+    with a reset age would park behind fresh arrivals forever."""
+
+    def test_nack_preserves_first_enqueue_time(self):
+        broker, _ = enabled_broker(aging_s=1000.0)
+        broker.enqueue(make_eval("old", 10))
+        first = broker.queue_age("old")
+        assert first is not None
+        ev, token = broker.dequeue(["service"], timeout=1)
+        time.sleep(0.02)
+        broker.nack(ev.ID, token)
+        # Age survives the redelivery cycle bit-identical.
+        assert broker.queue_age("old") == first
+
+    def test_requeued_eval_outranks_fresh_same_tier_arrival(self):
+        broker, _ = enabled_broker(aging_s=1000.0)
+        broker.enqueue(make_eval("old", 10, create_index=1))
+        ev, token = broker.dequeue(["service"], timeout=1)
+        broker.nack(ev.ID, token)
+        broker.enqueue(make_eval("fresh", 10, create_index=2))
+        got, _ = broker.dequeue(["service"], timeout=1)
+        assert got.ID == "old"
+
+    def test_aged_nacked_eval_promotes_from_original_time(self):
+        # The aging clock must run from FIRST enqueue, not the requeue:
+        # after a nack the low eval still outranks a fresh high arrival
+        # once its total queue time crosses the promotion threshold.
+        broker, _ = enabled_broker(aging_s=0.04)
+        broker.enqueue(make_eval("low", 10))
+        ev, token = broker.dequeue(["service"], timeout=1)
+        time.sleep(0.13)  # > 3 * aging_s, all spent before the requeue
+        broker.nack(ev.ID, token)
+        broker.enqueue(make_eval("high", 90))
+        got, _ = broker.dequeue(["service"], timeout=1)
+        assert got.ID == "low", "requeue reset the aging clock"
+
+    def test_token_gated_deferred_requeue_keeps_age(self):
+        # Review regression: a scheduler reblocking its own eval defers
+        # it behind the outstanding token (_requeue); the ack that then
+        # re-enqueues it must hand back the ORIGINAL first-enqueue time,
+        # not a fresh one.
+        broker, _ = enabled_broker(aging_s=1000.0)
+        broker.enqueue(make_eval("e", 50))
+        first = broker.queue_age("e")
+        ev, token = broker.dequeue(["service"], timeout=1)
+        time.sleep(0.02)
+        # Token-gated requeue while still outstanding -> deferred.
+        broker.enqueue_all({"e": (ev, token)}, ages={"e": first})
+        broker.ack(ev.ID, token)  # releases the deferred requeue
+        assert broker.stats.TotalReady == 1
+        assert broker.queue_age("e") == first, \
+            "deferred requeue reset the aging clock"
+
+    def test_ack_drops_age_entry(self):
+        broker, _ = enabled_broker()
+        broker.enqueue(make_eval("e", 50))
+        ev, token = broker.dequeue(["service"], timeout=1)
+        broker.ack(ev.ID, token)
+        assert broker.queue_age("e") is None
+
+    def test_blocked_requeue_carries_age(self):
+        broker, _ = enabled_broker(aging_s=1000.0)
+        blocked = BlockedEvals(broker)
+        blocked.set_enabled(True)
+        try:
+            broker.enqueue(make_eval("e", 50))
+            first = broker.queue_age("e")
+            ev, token = broker.dequeue(["service"], timeout=1)
+            # Scheduler couldn't place: the eval blocks (same ID, token),
+            # then capacity arrives and it requeues.
+            reblocked = ev.copy()
+            reblocked.Status = "blocked"
+            reblocked.SnapshotIndex = 100
+            blocked.reblock(reblocked, token)
+            broker.ack(ev.ID, token)  # original delivery resolved
+            blocked.unblock("class-a", 200)
+            assert wait_for(lambda: broker.stats.TotalReady >= 1,
+                            timeout=5, interval=0.01)
+            assert broker.queue_age("e") == pytest.approx(first)
+        finally:
+            blocked.set_enabled(False)
+
+    def test_new_blocked_eval_inherits_parent_age(self):
+        broker, _ = enabled_broker(aging_s=1000.0)
+        blocked = BlockedEvals(broker)
+        blocked.set_enabled(True)
+        try:
+            broker.enqueue(make_eval("parent", 50))
+            first = broker.queue_age("parent")
+            ev, token = broker.dequeue(["service"], timeout=1)
+            child = ev.create_blocked_eval({}, False)
+            child.SnapshotIndex = 100
+            blocked.block(child)
+            broker.ack(ev.ID, token)
+            blocked.unblock("class-a", 200)
+            assert wait_for(lambda: broker.stats.TotalReady >= 1,
+                            timeout=5, interval=0.01)
+            assert broker.queue_age(child.ID) == pytest.approx(first)
+        finally:
+            blocked.set_enabled(False)
+
+
+class TestAdmission:
+    def _controller(self, broker, qos):
+        return AdmissionController(qos, broker, QoSCounters())
+
+    def test_depth_shed_low_tier_only(self):
+        broker, qos = enabled_broker(admit_depth=(0, 8192, 1))
+        ctl = self._controller(broker, qos)
+        broker.enqueue(make_eval("l", 10))
+        with pytest.raises(QoSBackpressureError):
+            ctl.admit(10)
+        # High tier is unlimited by default.
+        ctl.admit(90)
+        assert ctl.counters.snapshot()["shed"] == 1
+        assert ctl.counters.snapshot()["admitted"] == 1
+
+    def test_burn_shed_protects_higher_tier(self):
+        broker, qos = enabled_broker(deadlines_s=(0.0, 0.0, 0.0),
+                                     burn_shed=0.5)
+        ctl = self._controller(broker, qos)
+        # Make the high tier burn: one completion over deadline...
+        broker.enqueue(make_eval("h", 90))
+        ev, token = broker.dequeue(["service"], timeout=1)
+        time.sleep(0.01)
+        broker.ack(ev.ID, token)
+        # ...and keep high backlog non-empty (burn only sheds while the
+        # protected tier actually has queued work).
+        broker.enqueue(make_eval("h2", 90))
+        with pytest.raises(QoSBackpressureError):
+            ctl.admit(10)
+        ctl.admit(90)  # high itself never burn-shed
+
+    def test_disabled_is_noop(self):
+        broker = EvalBroker()
+        ctl = AdmissionController(None, broker, QoSCounters())
+        ctl.admit(1)  # no broker introspection, no error
+        ctl2 = AdmissionController(QoSConfig(enabled=False), broker,
+                                   QoSCounters())
+        ctl2.admit(1)
+
+    def test_admission_failpoint_drop_forces_shed(self):
+        broker, qos = enabled_broker()
+        ctl = self._controller(broker, qos)
+        failpoints.arm_from_spec("broker.admission=drop:count=1")
+        with pytest.raises(QoSBackpressureError):
+            ctl.admit(90)
+        ctl.admit(90)  # healed after count
+
+    def test_admission_failpoint_error_surfaces(self):
+        broker, qos = enabled_broker()
+        ctl = self._controller(broker, qos)
+        failpoints.arm_from_spec("broker.admission=error:count=1")
+        with pytest.raises(failpoints.FailpointError):
+            ctl.admit(90)
+
+
+class TestServerIngress:
+    def _server(self, **qos_kw):
+        srv = Server(ServerConfig(num_schedulers=0,
+                                  qos=QoSConfig(enabled=True, **qos_kw),
+                                  min_heartbeat_ttl=24 * 3600.0,
+                                  heartbeat_grace=24 * 3600.0))
+        srv.establish_leadership()
+        return srv
+
+    @staticmethod
+    def _job(priority):
+        job = mock.job()
+        job.Priority = priority
+        task = job.TaskGroups[0].Tasks[0]
+        task.Resources.Networks = []
+        task.Services = []
+        if task.LogConfig is not None:
+            task.LogConfig.MaxFiles = 1
+            task.LogConfig.MaxFileSizeMB = 1
+        return job
+
+    def test_register_shed_is_typed_and_preserves_state(self):
+        srv = self._server(admit_depth=(0, 8192, 1))
+        try:
+            node = mock.node()
+            compute_node_class(node)
+            srv.node_register(node)
+            srv.job_register(self._job(10))  # fills the low lane
+            jobs_index = srv.state.get_index("jobs")
+            with pytest.raises(QoSBackpressureError):
+                srv.job_register(self._job(10))
+            # Shed BEFORE any write: no job, no eval landed.
+            assert srv.state.get_index("jobs") == jobs_index
+        finally:
+            srv.shutdown()
+
+    def test_shed_crosses_rpc_with_remote_type(self):
+        # Over the wire the typed error must keep its class name so
+        # clients/forwarders can react (rpc _err_string contract).
+        from nomad_tpu.rpc.pool import RPCError
+        srv = self._server(admit_depth=(0, 8192, 1))
+        try:
+            srv.job_register(self._job(10))
+            try:
+                srv.job_register(self._job(10))
+            except QoSBackpressureError as exc:
+                wire = f"{type(exc).__name__}: {exc}"
+                assert RPCError(wire).remote_type == "QoSBackpressureError"
+        finally:
+            srv.shutdown()
+
+    def test_internal_triggers_bypass_admission(self):
+        from nomad_tpu.structs.structs import EvalTriggerPeriodicJob
+        srv = self._server(admit_depth=(0, 8192, 1))
+        try:
+            srv.job_register(self._job(10))
+            # A periodic child launch must never shed.
+            srv.job_register(self._job(10),
+                             trigger=EvalTriggerPeriodicJob)
+        finally:
+            srv.shutdown()
+
+
+class TestClientBackpressureRetry:
+    def test_client_retries_429_with_policy(self, monkeypatch):
+        from nomad_tpu.api import client as api_client
+
+        calls = {"n": 0}
+
+        class _Resp:
+            headers = {"X-Nomad-Index": "7"}
+
+            def read(self):
+                return b'{"EvalID": "ok"}'
+
+            def __enter__(self):
+                return self
+
+            def __exit__(self, *exc):
+                return False
+
+        def fake_urlopen(req, timeout=None):
+            calls["n"] += 1
+            if calls["n"] <= 2:
+                raise urllib.error.HTTPError(
+                    "http://x/v1/jobs", 429, "Too Many Requests", {},
+                    io.BytesIO(b"submission shed (low tier)"))
+            return _Resp()
+
+        monkeypatch.setattr(api_client.urllib.request, "urlopen",
+                            fake_urlopen)
+        c = api_client.Client(backpressure_retries=4)
+        out, meta = c.put("/v1/jobs", {"Job": {}})
+        assert out["EvalID"] == "ok"
+        assert calls["n"] == 3  # two sheds retried, third landed
+
+    def test_client_backpressure_budget_exhausts_typed(self, monkeypatch):
+        from nomad_tpu.api import client as api_client
+
+        def always_429(req, timeout=None):
+            raise urllib.error.HTTPError(
+                "http://x/v1/jobs", 429, "Too Many Requests", {},
+                io.BytesIO(b"shed"))
+
+        monkeypatch.setattr(api_client.urllib.request, "urlopen",
+                            always_429)
+        c = api_client.Client(backpressure_retries=2)
+        with pytest.raises(api_client.BackpressureAPIError):
+            c.put("/v1/jobs", {"Job": {}})
+
+
+def _build_fleet(n):
+    """Deterministic fleet: stable IDs and strictly distinct capacities so
+    binpack scores differ by far more than the tie-break noise (<=1e-3)
+    and placement argmaxes are reproducible run-to-run."""
+    nodes = []
+    for i in range(n):
+        node = mock.node()
+        node.ID = f"node-{i:03d}"
+        node.Name = f"node-{i:03d}"
+        node.Resources.CPU = 4000 + 100 * i
+        node.Reserved = None
+        compute_node_class(node)
+        nodes.append(node)
+    return nodes
+
+
+def _storm_job(prio, jid):
+    job = mock.job()
+    job.ID = jid
+    job.Name = jid
+    job.Priority = prio
+    tg = job.TaskGroups[0]
+    tg.Count = 3
+    task = tg.Tasks[0]
+    task.Resources.CPU = 100
+    task.Resources.MemoryMB = 32
+    task.Resources.DiskMB = 10
+    task.Resources.Networks = []
+    task.Services = []
+    if task.LogConfig is not None:
+        task.LogConfig.MaxFiles = 1
+        task.LogConfig.MaxFileSizeMB = 1
+    return job
+
+
+def _run_storm_sync(qos):
+    """Fixed-order mixed-priority storm, processed SYNCHRONOUSLY by one
+    worker (no live worker threads -> no timing nondeterminism): register
+    everything, then drain the broker one eval at a time. Returns
+    {alloc.Name: NodeID} plus the completion order of eval job ids."""
+    from nomad_tpu.server.worker import Worker
+
+    srv = Server(ServerConfig(num_schedulers=0, qos=qos,
+                              min_heartbeat_ttl=24 * 3600.0,
+                              heartbeat_grace=24 * 3600.0))
+    srv.establish_leadership()
+    try:
+        for node in _build_fleet(12):
+            srv.node_register(node)
+        eval_of = {}
+        for i in range(8):
+            eval_of[srv.job_register(_storm_job(10, f"low-{i}"))[0]] = \
+                f"low-{i}"
+        for i in range(2):
+            eval_of[srv.job_register(_storm_job(90, f"high-{i}"))[0]] = \
+                f"high-{i}"
+        w = Worker(srv.raft, srv.eval_broker, srv.plan_queue,
+                   srv.blocked_evals, srv.tindex)
+        w.qos = srv.qos
+        w.qos_counters = srv.qos_counters
+        order = []
+        before = {eid: (srv.state.eval_by_id(eid) or Evaluation()).Status
+                  for eid in eval_of}
+        for _ in range(len(eval_of) * 3):
+            if not w.process_one(timeout=0.05):
+                break
+            for eid, jid in eval_of.items():
+                e = srv.state.eval_by_id(eid)
+                if (e is not None and e.Status == EvalStatusComplete
+                        and before[eid] != EvalStatusComplete):
+                    before[eid] = EvalStatusComplete
+                    order.append(jid)
+        placements = {}
+        for eid in eval_of:
+            e = srv.state.eval_by_id(eid)
+            assert e is not None and e.Status == EvalStatusComplete, \
+                (eval_of[eid], e)
+            for a in srv.state.allocs_by_eval(eid):
+                placements[a.Name] = a.NodeID
+        return placements, order
+    finally:
+        srv.shutdown()
+
+
+class TestEquivalenceGate:
+    """ISSUE 8 satellite: fixed-seed mixed-priority storm — identical
+    placements run-to-run, QoS-disabled mode placement-identical to the
+    FIFO path, high tier first under QoS."""
+
+    def test_disabled_mode_identical_to_fifo_path(self):
+        fifo, order_fifo = _run_storm_sync(None)
+        off, order_off = _run_storm_sync(QoSConfig(enabled=False))
+        assert fifo == off
+        assert order_fifo == order_off
+
+    def test_identical_placements_run_to_run(self):
+        a, order_a = _run_storm_sync(QoSConfig(enabled=True,
+                                               aging_s=1000.0))
+        b, order_b = _run_storm_sync(QoSConfig(enabled=True,
+                                               aging_s=1000.0))
+        assert a == b
+        assert order_a == order_b
+
+    def test_qos_serves_high_tier_first(self):
+        # (The legacy heap is already priority-ordered — reference v0.4
+        # semantics — so this holds in both modes; what QoS adds on top
+        # is aging, deadline windows, admission, and preemption.)
+        _, order = _run_storm_sync(QoSConfig(enabled=True,
+                                             aging_s=1000.0))
+        assert order[0].startswith("high-") \
+            and order[1].startswith("high-"), order
+
+    def test_qos_enabled_places_full_storm(self):
+        placements, _ = _run_storm_sync(QoSConfig(enabled=True))
+        assert len(placements) == 10 * 3  # every instance of every job

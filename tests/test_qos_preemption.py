@@ -1,0 +1,457 @@
+"""QoS alloc preemption (ISSUE 8): victim selection/ranking, the plan
+applier's atomic evict+place guarantee, the two-submitter overlap race,
+and the plan.preempt.commit chaos schedule (a worker killed mid-preemption
+redelivers exactly once — no lost evictions, no duplicate allocs)."""
+
+import time
+
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.qos import QoSConfig, find_preemption
+from nomad_tpu.resilience import failpoints
+from nomad_tpu.server.eval_broker import EvalBroker
+from nomad_tpu.server.fsm import FSM, DevRaft, MessageType
+from nomad_tpu.server.plan_apply import PlanApplier, evaluate_plan
+from nomad_tpu.server.plan_queue import PlanQueue
+from nomad_tpu.server.server import Server, ServerConfig
+from nomad_tpu.structs import Plan, compute_node_class
+from nomad_tpu.structs.structs import (
+    AllocDesiredStatusEvict,
+    AllocDesiredStatusRun,
+    EvalStatusComplete,
+)
+
+from helpers import wait_for  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _heal_failpoints():
+    failpoints.disarm_all()
+    yield
+    failpoints.disarm_all()
+
+
+def _node(raft, cpu=1000):
+    node = mock.node()
+    node.Resources.CPU = cpu
+    node.Reserved = None
+    compute_node_class(node)
+    raft.apply(MessageType.NodeRegister, {"Node": node})
+    return node
+
+
+def _victim(raft, node, job, cpu):
+    """A committed low-priority alloc occupying `cpu` on `node`."""
+    alloc = mock.alloc()
+    alloc.NodeID = node.ID
+    alloc.JobID = job.ID
+    alloc.Job = None
+    alloc.Resources.CPU = cpu
+    alloc.Resources.Networks = []
+    alloc.TaskResources = {}
+    raft.apply(MessageType.AllocUpdate, {"Alloc": [alloc], "Job": job})
+    return raft.fsm.state.alloc_by_id(alloc.ID)
+
+
+def _register_job(raft, priority):
+    job = mock.job()
+    job.Priority = priority
+    raft.apply(MessageType.JobRegister, {"Job": job})
+    return raft.fsm.state.job_by_id(job.ID)
+
+
+def _high_tg(cpu):
+    job = mock.job()
+    job.Priority = 90
+    tg = job.TaskGroups[0]
+    task = tg.Tasks[0]
+    task.Resources.CPU = cpu
+    task.Resources.MemoryMB = 0
+    task.Resources.DiskMB = 0
+    task.Resources.IOPS = 0
+    task.Resources.Networks = []
+    return job, tg
+
+
+class TestFindPreemption:
+    def test_ranks_lowest_priority_youngest_first(self):
+        fsm = FSM()
+        raft = DevRaft(fsm)
+        node = _node(raft, cpu=1000)
+        low = _register_job(raft, 10)
+        mid = _register_job(raft, 50)
+        v_old = _victim(raft, node, low, 300)
+        v_young = _victim(raft, node, low, 300)
+        v_mid = _victim(raft, node, mid, 300)
+        job, tg = _high_tg(250)
+        qos = QoSConfig(enabled=True)
+        pick = find_preemption(fsm.state.snapshot(), Plan(), job, tg,
+                               [node], qos)
+        assert pick is not None
+        # One eviction suffices; lowest priority + youngest wins.
+        assert [v.ID for v in pick.victims] == [v_young.ID]
+        assert v_old.ID != v_young.ID and v_mid.ID not in {v_young.ID}
+
+    def test_never_evicts_equal_or_higher_tier(self):
+        fsm = FSM()
+        raft = DevRaft(fsm)
+        node = _node(raft, cpu=1000)
+        high_job = _register_job(raft, 90)
+        normal_job = _register_job(raft, 50)
+        _victim(raft, node, high_job, 500)
+        _victim(raft, node, normal_job, 400)
+        job, tg = _high_tg(600)
+        qos = QoSConfig(enabled=True)
+        pick = find_preemption(fsm.state.snapshot(), Plan(), job, tg,
+                               [node], qos)
+        # Evicting the normal-tier 400 leaves 500 high-tier in place:
+        # 500 + 600 > 1000, and the high-tier alloc is untouchable.
+        assert pick is None
+
+    def test_max_victims_bounds_blast_radius(self):
+        fsm = FSM()
+        raft = DevRaft(fsm)
+        node = _node(raft, cpu=1000)
+        low = _register_job(raft, 10)
+        for _ in range(5):
+            _victim(raft, node, low, 190)
+        job, tg = _high_tg(800)  # needs 4+ evictions
+        pick = find_preemption(fsm.state.snapshot(), Plan(), job, tg,
+                               [node], QoSConfig(enabled=True,
+                                                 max_victims=2))
+        assert pick is None
+        pick = find_preemption(fsm.state.snapshot(), Plan(), job, tg,
+                               [node], QoSConfig(enabled=True,
+                                                 max_victims=5))
+        assert pick is not None and len(pick.victims) == 4
+
+    def test_network_asks_never_preempt(self):
+        from nomad_tpu.structs import NetworkResource
+        fsm = FSM()
+        raft = DevRaft(fsm)
+        node = _node(raft, cpu=1000)
+        low = _register_job(raft, 10)
+        _victim(raft, node, low, 900)
+        job, tg = _high_tg(500)
+        tg.Tasks[0].Resources.Networks = [
+            NetworkResource(MBits=10, DynamicPorts=["http"])]
+        pick = find_preemption(fsm.state.snapshot(), Plan(), job, tg,
+                               [node], QoSConfig(enabled=True))
+        assert pick is None
+
+    def test_sibling_instances_never_double_book_one_node(self):
+        """Review regression: a Count>=2 high-tier job whose instances
+        each need a preemption must spread across nodes — without
+        pending-placement accounting both instances 'find' the same
+        node's freed capacity, the applier bounces it every retry, and
+        the eval fails although a one-victim-per-node plan exists."""
+        from nomad_tpu.qos import attempt_preemption
+        fsm = FSM()
+        raft = DevRaft(fsm)
+        node_a = _node(raft, cpu=1000)
+        node_b = _node(raft, cpu=1000)
+        low = _register_job(raft, 10)
+        for node in (node_a, node_b):
+            _victim(raft, node, low, 400)
+            _victim(raft, node, low, 400)
+        job, tg = _high_tg(600)
+        plan = Plan(EvalID="ev-sibling", Priority=90)
+
+        class _Tup:
+            TaskGroup = tg
+
+        options = attempt_preemption(
+            fsm.state.snapshot(), plan, "ev-sibling", job,
+            [_Tup(), _Tup()], [None, None], [node_a, node_b],
+            QoSConfig(enabled=True))
+        assert all(o is not None for o in options), options
+        chosen = {o.node.ID for o in options}
+        assert chosen == {node_a.ID, node_b.ID}, \
+            "both instances double-booked one node"
+        # And the combined plan verifies cleanly — nothing bounces.
+        for tup, o in zip([_Tup(), _Tup()], options):
+            placed = mock.alloc()
+            placed.NodeID = o.node.ID
+            placed.Resources.CPU = 600
+            placed.Resources.Networks = []
+            placed.TaskResources = {}
+            plan.append_alloc(placed)
+        result = evaluate_plan(fsm.state.snapshot(), plan)
+        assert len(result.NodeAllocation) == 2
+        assert result.RefreshIndex == 0  # full commit, no partial
+
+    def test_accounts_in_plan_placements_and_evictions(self):
+        # A plan that already placed 500 on the node leaves no room even
+        # after evicting the victim: find_preemption must see it.
+        fsm = FSM()
+        raft = DevRaft(fsm)
+        node = _node(raft, cpu=1000)
+        low = _register_job(raft, 10)
+        _victim(raft, node, low, 600)
+        job, tg = _high_tg(600)
+        plan = Plan()
+        planned = mock.alloc()
+        planned.NodeID = node.ID
+        planned.Resources.CPU = 500
+        planned.Resources.Networks = []
+        planned.TaskResources = {}
+        planned.JobID = job.ID
+        plan.append_alloc(planned)
+        pick = find_preemption(fsm.state.snapshot(), plan, job, tg,
+                               [node], QoSConfig(enabled=True))
+        assert pick is None  # 500 (in-plan) + 600 (ask) > 1000 even evicted
+
+
+class TestApplierAtomicity:
+    """Never an eviction without its placement committing."""
+
+    def _preempt_plan(self, node, victim, cpu, include_placement=True):
+        plan = Plan(EvalID=f"ev-{time.monotonic_ns()}", Priority=90)
+        plan.append_update(victim, AllocDesiredStatusEvict, "preempted")
+        placed = None
+        if include_placement:
+            placed = mock.alloc()
+            placed.NodeID = node.ID
+            placed.Resources.CPU = cpu
+            placed.Resources.Networks = []
+            placed.TaskResources = {}
+            plan.append_alloc(placed)
+        plan._preempt = {node.ID: [victim.ID]}
+        return plan, placed
+
+    def test_placement_unfit_drops_evictions_too(self):
+        fsm = FSM()
+        raft = DevRaft(fsm)
+        node = _node(raft, cpu=1000)
+        low = _register_job(raft, 10)
+        victim = _victim(raft, node, low, 300)
+        _victim(raft, node, low, 600)
+        # Evicting the 300 leaves 600: a 900 ask still cannot fit.
+        plan, _ = self._preempt_plan(node, victim, cpu=900)
+        result = evaluate_plan(fsm.state.snapshot(), plan)
+        assert result.NodeAllocation == {} and result.NodeUpdate == {}
+        assert result.RefreshIndex > 0  # partial verdict, worker re-plans
+
+    def test_malformed_eviction_only_preempt_plan_drops(self):
+        # Without the guard this rides "evict-only always fits" and stops
+        # a victim for a placement that never existed.
+        fsm = FSM()
+        raft = DevRaft(fsm)
+        node = _node(raft, cpu=1000)
+        low = _register_job(raft, 10)
+        victim = _victim(raft, node, low, 300)
+        plan, _ = self._preempt_plan(node, victim, cpu=0,
+                                     include_placement=False)
+        result = evaluate_plan(fsm.state.snapshot(), plan)
+        assert result.NodeUpdate == {}, \
+            "eviction committed without its placement"
+
+    def test_commit_counters_exclude_normal_placements_on_same_node(self):
+        # Review regression: a preempting node may also carry the plan's
+        # NORMALLY-selected placements; preempt_placed must count only
+        # the instances that landed via preemption.
+        from nomad_tpu.qos import QoSCounters
+        from nomad_tpu.server.plan_apply import PlanApplier
+        from nomad_tpu.server.plan_queue import PlanQueue
+        from nomad_tpu.structs import PlanResult
+
+        fsm = FSM()
+        raft = DevRaft(fsm)
+        node = _node(raft, cpu=1000)
+        low = _register_job(raft, 10)
+        victim = _victim(raft, node, low, 300)
+        plan, placed = self._preempt_plan(node, victim, cpu=200)
+        normal = mock.alloc()
+        normal.NodeID = node.ID
+        normal.Resources.CPU = 200
+        normal.Resources.Networks = []
+        normal.TaskResources = {}
+        plan.append_alloc(normal)  # same node, NOT via preemption
+        plan._preempt_counts = {node.ID: 1}
+        counters = QoSCounters()
+        applier = PlanApplier(PlanQueue(), raft, qos_counters=counters)
+        result = PlanResult(
+            NodeUpdate={node.ID: [victim]},
+            NodeAllocation={node.ID: [placed, normal]})
+        applier._count_preempt(plan, result)
+        snap = counters.snapshot()
+        assert snap["preempt_placed"] == 1, snap
+        assert snap["preempt_evictions"] == 1, snap
+
+    def test_fit_preemption_commits_both_sides(self):
+        fsm = FSM()
+        raft = DevRaft(fsm)
+        node = _node(raft, cpu=1000)
+        low = _register_job(raft, 10)
+        victim = _victim(raft, node, low, 800)
+        plan, placed = self._preempt_plan(node, victim, cpu=600)
+        result = evaluate_plan(fsm.state.snapshot(), plan)
+        assert [a.ID for a in result.NodeUpdate[node.ID]] == [victim.ID]
+        assert [a.ID for a in result.NodeAllocation[node.ID]] == [placed.ID]
+
+    def test_two_submitter_overlap_never_double_spends_eviction(self):
+        """Two workers race preemption plans against the SAME victim: at
+        most one placement commits; the victim is evicted exactly once;
+        the loser gets a partial verdict (re-plan), never a phantom
+        eviction credit."""
+        fsm = FSM()
+        raft = DevRaft(fsm)
+        node = _node(raft, cpu=1000)
+        low = _register_job(raft, 10)
+        victim = _victim(raft, node, low, 800)
+
+        broker = EvalBroker()  # disabled: applier skips the token check
+        queue = PlanQueue()
+        queue.set_enabled(True)
+        applier = PlanApplier(queue, raft)
+        applier.start()
+        try:
+            plan_a, placed_a = self._preempt_plan(node, victim, cpu=600)
+            plan_b, placed_b = self._preempt_plan(node, victim, cpu=600)
+            pendings = queue.enqueue_all([plan_a, plan_b])
+            results = [p.wait(timeout=30.0) for p in pendings]
+        finally:
+            applier.stop()
+            applier.join()
+            queue.set_enabled(False)
+
+        committed = [r for r in results if r.NodeAllocation]
+        assert len(committed) == 1, results
+        # Exactly one eviction of the victim landed.
+        evictions = [a for r in results
+                     for allocs in r.NodeUpdate.values() for a in allocs]
+        assert [a.ID for a in evictions] == [victim.ID]
+        state_victim = fsm.state.alloc_by_id(victim.ID)
+        assert state_victim.DesiredStatus == AllocDesiredStatusEvict
+        live = [a for a in fsm.state.allocs_by_node_terminal(node.ID, False)]
+        assert len(live) == 1 and live[0].ID in {placed_a.ID, placed_b.ID}
+
+
+def _slo_server(**qos_kw):
+    srv = Server(ServerConfig(num_schedulers=1,
+                              qos=QoSConfig(enabled=True, **qos_kw),
+                              min_heartbeat_ttl=24 * 3600.0,
+                              heartbeat_grace=24 * 3600.0))
+    srv.establish_leadership()
+    return srv
+
+
+def _fat_job(prio, cpu):
+    job = mock.job()
+    job.Priority = prio
+    tg = job.TaskGroups[0]
+    tg.Count = 1
+    task = tg.Tasks[0]
+    task.Resources.CPU = cpu
+    task.Resources.MemoryMB = 32
+    task.Resources.DiskMB = 10
+    task.Resources.Networks = []
+    task.Services = []
+    if task.LogConfig is not None:
+        task.LogConfig.MaxFiles = 1
+        task.LogConfig.MaxFileSizeMB = 1
+    return job
+
+
+def _wait_complete(srv, eid, timeout=30):
+    assert wait_for(
+        lambda: (e := srv.state.eval_by_id(eid)) is not None
+        and e.Status == EvalStatusComplete,
+        timeout=timeout, interval=0.02,
+        msg=f"eval {eid} complete")
+    return srv.state.eval_by_id(eid)
+
+
+class TestPreemptionServed:
+    """End-to-end through the live served path (register -> broker ->
+    pipelined worker -> preemption fallback -> plan apply -> commit)."""
+
+    def _saturate(self, srv, n_nodes=2):
+        for _ in range(n_nodes):
+            node = mock.node()
+            node.Resources.CPU = 1000
+            node.Reserved = None
+            compute_node_class(node)
+            srv.node_register(node)
+        for _ in range(n_nodes):
+            _wait_complete(srv, srv.job_register(_fat_job(10, 800))[0])
+
+    def test_high_tier_preempts_through_served_path(self):
+        srv = _slo_server()
+        try:
+            self._saturate(srv)
+            heid = srv.job_register(_fat_job(90, 600))[0]
+            _wait_complete(srv, heid)
+            allocs = list(srv.state.allocs_by_eval(heid))
+            assert len(allocs) == 1
+            assert allocs[0].DesiredStatus == AllocDesiredStatusRun
+            evicted = [a for a in srv.state.allocs()
+                       if a.DesiredStatus == AllocDesiredStatusEvict]
+            assert len(evicted) == 1
+            snap = srv.qos_counters.snapshot()
+            assert snap["preempt_placed"] == 1
+            assert snap["preempt_evictions"] == 1
+        finally:
+            srv.shutdown()
+
+    def test_low_tier_blocks_instead_of_preempting(self):
+        srv = _slo_server()
+        try:
+            self._saturate(srv)
+            # A NORMAL-tier job that doesn't fit must take the classic
+            # blocked-eval path — no evictions.
+            beid = srv.job_register(_fat_job(50, 600))[0]
+            assert wait_for(
+                lambda: (e := srv.state.eval_by_id(beid)) is not None
+                and e.Status in ("complete", "blocked"),
+                timeout=30, interval=0.02)
+            evicted = [a for a in srv.state.allocs()
+                       if a.DesiredStatus == AllocDesiredStatusEvict]
+            assert evicted == []
+            assert srv.qos_counters.snapshot()["preempt_placed"] == 0
+        finally:
+            srv.shutdown()
+
+    def test_preempt_commit_killed_redelivers_exactly_once(self):
+        """Chaos (ISSUE 8 satellite): the consensus commit of the
+        preemption dies once; the worker nacks, the broker redelivers,
+        and the retry commits evictions + placement together — exactly
+        one high alloc, no eviction without it, no duplicates."""
+        srv = _slo_server()
+        try:
+            self._saturate(srv)
+            failpoints.arm_from_spec("plan.preempt.commit=error:count=1")
+            heid = srv.job_register(_fat_job(90, 600))[0]
+            _wait_complete(srv, heid, timeout=60)
+            snap = failpoints.snapshot()
+            assert snap["plan.preempt.commit"]["fired"] >= 1, \
+                "chaos never hit the preempt commit seam"
+            allocs = list(srv.state.allocs_by_eval(heid))
+            assert len(allocs) == 1, "duplicate or lost high-tier alloc"
+            evicted = [a for a in srv.state.allocs()
+                       if a.DesiredStatus == AllocDesiredStatusEvict]
+            # Every committed eviction has the committed placement it
+            # paid for; capacity is never exceeded by survivors.
+            assert len(evicted) >= 1
+            for node_id in {a.NodeID for a in srv.state.allocs()}:
+                live = srv.state.allocs_by_node_terminal(node_id, False)
+                assert sum(a.Resources.CPU for a in live
+                           if a.Resources) <= 1000
+        finally:
+            srv.shutdown()
+
+    def test_admission_failpoint_served_path(self):
+        from nomad_tpu.qos import QoSBackpressureError
+        srv = _slo_server()
+        try:
+            node = mock.node()
+            compute_node_class(node)
+            srv.node_register(node)
+            failpoints.arm_from_spec("broker.admission=drop:count=1")
+            with pytest.raises(QoSBackpressureError):
+                srv.job_register(_fat_job(10, 20))
+            # Healed: same submission now lands.
+            _wait_complete(srv, srv.job_register(_fat_job(10, 20))[0])
+        finally:
+            srv.shutdown()

@@ -757,6 +757,147 @@ def build_system_job():
     return job
 
 
+def _capture_sweep_plan(n_nodes):
+    """One fixed-seed system sweep plan (with its columnar descriptor)
+    captured WITHOUT committing — the input both store-commit paths
+    replay."""
+    import logging
+    from nomad_tpu import mock
+    from nomad_tpu.scheduler.system_sched import SystemScheduler
+    from nomad_tpu.state.state_store import StateStore
+    from nomad_tpu.structs import PlanResult
+    from nomad_tpu.structs.structs import (
+        EvalStatusPending,
+        EvalTriggerJobRegister,
+    )
+    from nomad_tpu.tensor import TensorIndex
+
+    class _Capture:
+        def __init__(self):
+            self.plans = []
+
+        def plan_queue_depth(self):
+            return 0
+
+        def submit_plan(self, plan):
+            self.plans.append(plan)
+            r = PlanResult()
+            r.NodeUpdate = dict(plan.NodeUpdate)
+            r.NodeAllocation = dict(plan.NodeAllocation)
+            r.AllocIndex = 1
+            return r, None
+
+        def update_eval(self, ev):
+            pass
+
+        def create_eval(self, ev):
+            pass
+
+        def reblock_eval(self, ev):
+            pass
+
+    store = StateStore()
+    tindex = TensorIndex.attach(store)
+    idx = 0
+    for node in build_nodes(n_nodes):
+        idx += 1
+        store.upsert_node(idx, node)
+    job = build_system_job()
+    idx += 1
+    store.upsert_job(idx, job)
+    ev = mock.eval()
+    ev.JobID = job.ID
+    ev.Type = job.Type
+    ev.TriggeredBy = EvalTriggerJobRegister
+    ev.Status = EvalStatusPending
+    planner = _Capture()
+    SystemScheduler(store, planner, tindex,
+                    logging.getLogger("bench.store"),
+                    rng=random.Random(7)).process(ev)
+    return planner.plans[0]
+
+
+def bench_store_commit(n_nodes, reps=3):
+    """State-store commit microbench (the `store` section): the SAME
+    fixed-seed system sweep committed per-object (the pre-columnar path,
+    one upsert per alloc) and columnar (one ApplySweepBatch scatter) into
+    fresh FSMs. Reports per-alloc commit µs for both paths, the columnar
+    batch scatter ms, and the raft entry bytes of both encodings (the
+    wire cost of a chunk). Max-of-reps (min time) like the A/B protocol —
+    the commit is deterministic CPU, so the best rep is the least-noisy
+    one."""
+    import msgpack
+    from nomad_tpu.server.fsm import FSM, MessageType
+    from nomad_tpu.server.plan_apply import _encode_result
+    from nomad_tpu.structs import PlanResult, to_dict
+
+    plan = _capture_sweep_plan(n_nodes)
+    allocs = [a for placed in plan.NodeAllocation.values() for a in placed]
+    n = len(allocs)
+    obj_payload = {"Job": plan.Job, "Alloc": allocs}
+    result = PlanResult(NodeAllocation=dict(plan.NodeAllocation))
+    result._sweep = plan._sweep
+    element, is_sweep = _encode_result(plan, result)
+    assert is_sweep, "sweep plan lost its columnar descriptor"
+    col_payload = {"Batch": [element]}
+    # Entry bytes BEFORE any apply mutates the payload objects (the
+    # object path stamps Job/indexes into the shared allocs).
+    obj_bytes = len(msgpack.packb(
+        (int(MessageType.AllocUpdate), to_dict(obj_payload)),
+        use_bin_type=True))
+    col_bytes = len(msgpack.packb(
+        (int(MessageType.ApplySweepBatch), to_dict(col_payload)),
+        use_bin_type=True))
+
+    def timed(msg, payload):
+        best = float("inf")
+        for _ in range(reps):
+            fsm = FSM()
+            t0 = time.perf_counter()
+            fsm.apply(1, msg, payload)
+            best = min(best, time.perf_counter() - t0)
+        return best
+
+    t_obj = timed(MessageType.AllocUpdate, obj_payload)
+    t_col = timed(MessageType.ApplySweepBatch, col_payload)
+    return {
+        "nodes": n_nodes,
+        "allocs": n,
+        "object_per_alloc_us": round(t_obj / n * 1e6, 2),
+        "columnar_per_alloc_us": round(t_col / n * 1e6, 3),
+        "columnar_batch_scatter_ms": round(t_col * 1e3, 3),
+        "commit_speedup": round(t_obj / t_col, 1) if t_col else None,
+        "raft_entry_bytes": {"object": obj_bytes, "columnar": col_bytes,
+                             "ratio": round(obj_bytes / col_bytes, 1)
+                             if col_bytes else None},
+    }
+
+
+def bench_store_commit_window(per_eval=PER_EVAL, reps=5):
+    """Object-path commit cost at the SERVICE window shape (one 50-alloc
+    plan): the headline/config5 configs commit through this path, so the
+    store section tracks its per-alloc µs alongside the sweep numbers."""
+    from nomad_tpu import mock
+    from nomad_tpu.server.fsm import FSM, MessageType
+
+    job = build_job(per_eval)
+    allocs = []
+    for i in range(per_eval):
+        a = mock.alloc()
+        a.Job = None
+        a.JobID = job.ID
+        allocs.append(a)
+    best = float("inf")
+    for _ in range(reps):
+        fsm = FSM()
+        t0 = time.perf_counter()
+        fsm.apply(1, MessageType.AllocUpdate,
+                  {"Job": job, "Alloc": allocs})
+        best = min(best, time.perf_counter() - t0)
+    return {"allocs": per_eval,
+            "object_per_alloc_us": round(best / per_eval * 1e6, 2)}
+
+
 def bench_placer(nodes, n_evals, per_eval=PER_EVAL, dcs=None):
     """Placer-only device pipeline: the ceiling (no raft/plan-apply)."""
     from nomad_tpu.scheduler.pipeline import EvalRequest, PipelinedPlacer
@@ -1009,6 +1150,15 @@ def main(argv=None):
             "storm_latency_ms": storm_pct,
             "rep_rates": rep_rates,
         }
+
+    # State-store commit microbench (`store` section): per-alloc commit
+    # µs / batch scatter ms / raft entry bytes, per commit shape — the
+    # sweep shape feeds config4 (and any system storm), the window shape
+    # feeds the headline/config2/config5 service configs.
+    detail["store"] = {
+        "config4_system": bench_store_commit(N_NODES),
+        "service_window": bench_store_commit_window(),
+    }
 
     # Horizontal worker scaling: always recorded (smoke shapes), so every
     # BENCH file carries the 1-vs-2 ratio next to the single-worker rate.

@@ -53,21 +53,23 @@ class InProcServerChannel:
     def get_client_allocs(self, node_id: str, min_index: int,
                           max_wait: float) -> Tuple[Dict[str, int], int]:
         """Blocking query: alloc_id -> AllocModifyIndex for the node
-        (reference: node_endpoint.go:474-528 GetClientAllocs)."""
+        (reference: node_endpoint.go:474-528 GetClientAllocs). Reads the
+        store's columnar-aware index map: a sweep-placed alloc's id and
+        commit index come straight off the segment columns, so the pull
+        signal never materializes Allocation objects the node hasn't
+        actually fetched yet (state_store.client_alloc_map)."""
         state = self.server.state
         event = threading.Event()
         items = [Item(alloc_node=node_id)]
         state.watch(items, event)
         try:
             while True:
-                allocs = state.allocs_by_node(node_id)
-                index = max((a.AllocModifyIndex for a in allocs),
-                            default=state.get_index("allocs"))
+                alloc_map, index = state.client_alloc_map(node_id)
                 if index > min_index or max_wait <= 0:
-                    return ({a.ID: a.AllocModifyIndex for a in allocs}, index)
+                    return alloc_map, index
                 event.clear()
                 if not event.wait(max_wait):
-                    return ({a.ID: a.AllocModifyIndex for a in allocs}, index)
+                    return alloc_map, index
         finally:
             state.stop_watch(items, event)
 

@@ -67,6 +67,13 @@ KNOWN_SITES: Dict[str, str] = {
     "raft.request_vote": "raft: candidate->peer RequestVote send",
     "raft.snapshot.persist": "raft: state snapshot persist to the log store",
     "raft.snapshot.restore": "raft/state: FSM restore from snapshot blob",
+    "state.store.commit": "server: columnar sweep-batch bulk commit (fires "
+                          "in the plan applier BEFORE the consensus entry "
+                          "is proposed, so a killed commit never enters "
+                          "the raft log — the worker nacks, the broker "
+                          "redelivers exactly once, no duplicate allocs "
+                          "even across restart/replay, never a torn "
+                          "batch)",
     "server.blocked.unblock": "server: blocked-evals capacity wakeup "
                               "(drop=lost wakeup event)",
     "rpc.pool.call": "rpc: pooled client call over the wire",

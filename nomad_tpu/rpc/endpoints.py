@@ -450,15 +450,14 @@ class Endpoints:
 
     def node_get_client_allocs(self, body) -> Dict[str, Any]:
         """alloc_id -> AllocModifyIndex map, blocking — the client's cheap
-        pull signal (reference: node_endpoint.go:474-528)."""
+        pull signal (reference: node_endpoint.go:474-528). Served off the
+        columnar-aware index map so sweep-placed allocs never materialize
+        for a poll that only compares indexes."""
         state = self.server.state
         node_id = body["NodeID"]
 
         def run():
-            allocs = state.allocs_by_node(node_id)
-            index = max([a.AllocModifyIndex for a in allocs],
-                        default=state.get_index("allocs"))
-            return {a.ID: a.AllocModifyIndex for a in allocs}, index
+            return state.client_alloc_map(node_id)
 
         result, index = blocking_query(
             state, [Item(alloc_node=node_id)],

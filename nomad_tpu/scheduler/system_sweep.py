@@ -83,20 +83,64 @@ class SweepBatch:
     snapshot state, which the per-node path owns. ``epoch``/``n_rows``
     pin the tensor generation: a row that changed identity between emit
     and verify invalidates the whole descriptor (the applier falls back,
-    it never mis-verifies)."""
+    it never mis-verifies).
+
+    The per-ALLOC columns (``alloc_ids``/``alloc_names``/``alloc_tg``,
+    row-sorted, with ``starts`` giving each unique row's alloc range)
+    carry the batch the rest of the way: the plan applier encodes an
+    admitted sweep chunk as ONE ``ApplySweepBatch`` raft entry straight
+    from these columns — ids + instance names + a frozen per-TG template
+    — and the state store scatter-applies it without ever walking the
+    plan's per-alloc objects."""
 
     rows: np.ndarray        # [U] int64, sorted unique node rows
     node_ids: List[str]     # [U] aligned node IDs
     delta: np.ndarray       # [U, RES_DIMS] float32 summed placed demand
     epoch: int              # nt.row_epoch at emit
     n_rows: int             # nt.n_rows at emit
+    counts: np.ndarray = None       # [U] allocs folded into each row
+    starts: np.ndarray = None       # [U+1] per-row alloc offsets
+    alloc_ids: List[str] = None     # [K] row-sorted alloc UUIDs
+    alloc_names: List[str] = None   # [K] instance names (job.tg[i])
+    alloc_tg: List[int] = None      # [K] index into templates
+    templates: List = None          # per-TG frozen template Allocations
 
     def slice(self, lo: int, hi: int) -> "SweepBatch":
         """Chunk view for _submit_chunked: shares the backing arrays."""
+        if self.starts is None:
+            return SweepBatch(rows=self.rows[lo:hi],
+                              node_ids=self.node_ids[lo:hi],
+                              delta=self.delta[lo:hi],
+                              epoch=self.epoch, n_rows=self.n_rows)
+        s, e = int(self.starts[lo]), int(self.starts[hi])
         return SweepBatch(rows=self.rows[lo:hi],
                           node_ids=self.node_ids[lo:hi],
                           delta=self.delta[lo:hi],
-                          epoch=self.epoch, n_rows=self.n_rows)
+                          epoch=self.epoch, n_rows=self.n_rows,
+                          counts=self.counts[lo:hi],
+                          starts=self.starts[lo:hi + 1] - s,
+                          alloc_ids=self.alloc_ids[s:e],
+                          alloc_names=self.alloc_names[s:e],
+                          alloc_tg=self.alloc_tg[s:e],
+                          templates=self.templates)
+
+    def wire(self) -> dict:
+        """msgpack-safe encoding for the ApplySweepBatch raft entry (numpy
+        arrays become lists; templates stay Allocation objects — to_dict
+        flattens them at the consensus boundary). Per-alloc node ids are
+        NOT shipped: they re-expand from (node_ids, counts) at apply."""
+        return {
+            "Templates": self.templates,
+            "TGIdx": list(self.alloc_tg),
+            "AllocIDs": list(self.alloc_ids),
+            "Names": list(self.alloc_names),
+            "RowNodeIDs": list(self.node_ids),
+            "Counts": [int(c) for c in self.counts],
+            "Rows": [int(r) for r in self.rows],
+            "Delta": self.delta.tolist(),
+            "Epoch": self.epoch,
+            "NRows": self.n_rows,
+        }
 
 
 # Escape hatch for A/B benchmarks and oracle runs: True routes every
@@ -268,6 +312,13 @@ def compute_job_allocs(sched) -> None:
     nodes_by_row = elig.nodes_by_row
     sweep_rows: List[np.ndarray] = []
     sweep_vecs: List[np.ndarray] = []
+    # Per-alloc descriptor columns, appended in lockstep with sweep_rows:
+    # the columnar commit path replicates (id, name, template-index) per
+    # alloc instead of the alloc objects.
+    alloc_ids_l: List[str] = []
+    alloc_names_l: List[str] = []
+    alloc_tg_l: List[int] = []
+    sweep_templates: List[Allocation] = []
     n_emitted = 0
 
     for tg_name, names in by_tg.items():
@@ -372,6 +423,8 @@ def compute_job_allocs(sched) -> None:
         )
         template._resvec_cache = shared_vec
         tmpl_dict = template.__dict__
+        tpl_idx = len(sweep_templates)
+        sweep_templates.append(template)
         new = object.__new__
         cls = Allocation
         for name, ok_rows in placed_per_name:
@@ -393,6 +446,9 @@ def compute_job_allocs(sched) -> None:
                 else:
                     bucket.append(alloc)
                 kept.append(k)
+                alloc_ids_l.append(alloc.ID)
+                alloc_names_l.append(name)
+                alloc_tg_l.append(tpl_idx)
             rows_kept = (ok_rows if len(kept) == len(ids)
                          else ok_rows[kept])
             if len(rows_kept):
@@ -424,12 +480,32 @@ def compute_job_allocs(sched) -> None:
             [nid not in plan.NodeUpdate
              and len(plan.NodeAllocation[nid]) == emitted_per_row[k]
              for k, nid in enumerate(ids_list)], dtype=bool)
+        # Per-alloc columns, sorted into unique-row order so a node-range
+        # chunk slice maps to a contiguous alloc range (starts).
+        order = np.argsort(rows_all, kind="stable")
+        keep_alloc = keep[inv][order]
+        aid_sorted = np.asarray(alloc_ids_l, dtype=object)[order]
+        name_sorted = np.asarray(alloc_names_l, dtype=object)[order]
+        tg_sorted = np.asarray(alloc_tg_l, dtype=np.int64)[order]
+        counts = emitted_per_row
         if not keep.all():
             ur, delta = ur[keep], delta[keep]
             ids_list = [nid for nid, k in zip(ids_list, keep.tolist()) if k]
+            counts = emitted_per_row[keep]
+            aid_sorted = aid_sorted[keep_alloc]
+            name_sorted = name_sorted[keep_alloc]
+            tg_sorted = tg_sorted[keep_alloc]
+        starts = np.concatenate(
+            [np.zeros(1, dtype=np.int64),
+             np.cumsum(counts, dtype=np.int64)])
         plan._sweep = SweepBatch(rows=ur, node_ids=ids_list,
                                  delta=delta, epoch=nt.row_epoch,
-                                 n_rows=nt.n_rows)
+                                 n_rows=nt.n_rows,
+                                 counts=counts, starts=starts,
+                                 alloc_ids=aid_sorted.tolist(),
+                                 alloc_names=name_sorted.tolist(),
+                                 alloc_tg=tg_sorted.tolist(),
+                                 templates=sweep_templates)
         metrics.incr_counter(("nomad", "sched", "system", "placed"),
                              n_emitted)
     metrics.measure_since(("nomad", "sched", "system", "emit"), t1)

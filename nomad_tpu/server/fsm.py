@@ -16,8 +16,10 @@ import threading
 import time
 from typing import Any, Callable, Dict, List, Optional
 
+import numpy as np
+
 from nomad_tpu.server.timetable import TimeTable
-from nomad_tpu.state.state_store import StateStore
+from nomad_tpu.state.state_store import StateStore, SweepSegment
 from nomad_tpu.telemetry import metrics, trace
 from nomad_tpu.structs import (
     Allocation,
@@ -55,6 +57,11 @@ class MessageType(enum.IntEnum):
     PeriodicLaunchType = 10
     PeriodicLaunchDelete = 11
     ServiceSync = 12
+    # Columnar sweep-batch commit (beyond reference v0.4): one entry
+    # carries a whole admitted system-sweep chunk as columnar arrays
+    # (alloc ids, instance names, per-TG frozen templates, per-row usage
+    # delta) instead of N per-alloc payloads.
+    ApplySweepBatch = 13
 
 
 # Metric leaf names per message type (reference: the MeasureSince keys in
@@ -73,6 +80,7 @@ _MSG_METRIC = {
     MessageType.PeriodicLaunchType: "periodic_launch",
     MessageType.PeriodicLaunchDelete: "periodic_launch_delete",
     MessageType.ServiceSync: "service_sync",
+    MessageType.ApplySweepBatch: "sweep",
 }
 
 
@@ -192,6 +200,90 @@ class FSM:
         self.state.upsert_allocs(index, allocs)
         return None
 
+    def _apply_sweep_batch(self, index: int, req: Dict[str, Any]):
+        """Columnar sweep-batch commit: each group is either a per-object
+        {"Job","Alloc"} group (the AllocUpdate shape — mixed entries carry
+        the window's ordinary plans too) or a {"Job","Sweep","Updates"}
+        group whose placements land as ONE SweepSegment scatter. The
+        `state.store.commit` failure seam fires in the PLAN APPLIER,
+        before raft.apply — an entry that reaches this handler has
+        consensus-committed and must apply deterministically on every
+        replica (an injected failure here would survive in the durable
+        log and duplicate the batch on replay)."""
+        groups = req.get("Batch")
+        if groups is None:
+            groups = [req]
+        obj_allocs: List[Allocation] = []
+        n_sweep = 0
+        # One store transaction for the WHOLE entry: a sweep group's
+        # stops, its segment, and any object co-groups land in separate
+        # write calls below, and a blocking query woken between them
+        # could otherwise observe a torn entry (an eviction committed
+        # with its replacement not yet visible — exactly what the
+        # eviction+placement-one-entry contract forbids). The lock is
+        # reentrant; the inner writes re-acquire freely.
+        with self.state.transaction():
+            for group in groups:
+                job = group.get("Job")
+                if isinstance(job, dict):
+                    job = from_dict(Job, job)
+                sweep = group.get("Sweep")
+                if sweep is None:
+                    group_allocs = [
+                        from_dict(Allocation, a) if isinstance(a, dict)
+                        else a
+                        for a in group.get("Alloc", ())]
+                    for alloc in group_allocs:
+                        if alloc.Job is None and job is not None:
+                            alloc.Job = job
+                    obj_allocs.extend(group_allocs)
+                    continue
+                updates = [
+                    from_dict(Allocation, a) if isinstance(a, dict) else a
+                    for a in group.get("Updates", ())]
+                for alloc in updates:
+                    if alloc.Job is None and job is not None:
+                        alloc.Job = job
+                if updates:
+                    # Stop-then-place: the plan's exact-path evictions
+                    # commit before its columnar placements, same order
+                    # the object path guarantees within one entry.
+                    self.state.upsert_allocs(index, updates)
+                templates = [
+                    t if isinstance(t, Allocation)
+                    else from_dict(Allocation, t)
+                    for t in sweep["Templates"]]
+                for t in templates:
+                    if t.Job is None and job is not None:
+                        t.Job = job
+                row_node_ids = list(sweep["RowNodeIDs"])
+                counts = np.asarray(sweep["Counts"], dtype=np.int64)
+                node_per_alloc = np.repeat(
+                    np.asarray(row_node_ids, dtype=object),
+                    counts).tolist()
+                seg = SweepSegment(
+                    index=index,
+                    job_id=templates[0].JobID,
+                    eval_id=templates[0].EvalID,
+                    templates=templates,
+                    tg_idx=list(sweep["TGIdx"]),
+                    alloc_ids=list(sweep["AllocIDs"]),
+                    names=list(sweep["Names"]),
+                    node_ids=node_per_alloc)
+                self.state.apply_sweep_segment(
+                    index, seg,
+                    rows=np.asarray(sweep["Rows"], dtype=np.int64),
+                    delta=np.asarray(sweep["Delta"], dtype=np.float32),
+                    row_node_ids=row_node_ids,
+                    epoch=int(sweep.get("Epoch", -1)))
+                n_sweep += len(seg.alloc_ids)
+            if obj_allocs:
+                self.state.upsert_allocs(index, obj_allocs)
+        if n_sweep:
+            metrics.incr_counter(("nomad", "fsm", "sweep", "allocs"),
+                                 n_sweep)
+        return None
+
     def _apply_alloc_client_update(self, index: int, req: Dict[str, Any]):
         for a in req["Alloc"]:
             alloc = from_dict(Allocation, a) if isinstance(a, dict) else a
@@ -239,13 +331,18 @@ class FSM:
 
     # ------------------------------------------------------ snapshot/restore
     def snapshot(self) -> Dict[str, Any]:
-        """Serialize the full FSM state (reference: fsm.go:430-551)."""
+        """Serialize the full FSM state (reference: fsm.go:430-551).
+        Columnar sweep segments round-trip COLUMNAR ("columnar_allocs"):
+        a million sweep-placed rows persist as id/name/node columns plus
+        one template per task group, never as per-alloc object dicts."""
         snap = self.state.snapshot()
+        chain_allocs, col_segments = snap.alloc_dump()
         return {
             "nodes": [to_dict(n) for n in snap.nodes()],
             "jobs": [to_dict(j) for j in snap.jobs()],
             "evals": [to_dict(e) for e in snap.evals()],
-            "allocs": [to_dict(a) for a in snap.allocs()],
+            "allocs": [to_dict(a) for a in chain_allocs],
+            "columnar_allocs": col_segments,
             "periodic_launches": [to_dict(p) for p in snap.periodic_launches()],
             "services": [to_dict(s) for s in snap.services()],
             "indexes": {t: snap.get_index(t)
@@ -265,6 +362,8 @@ class FSM:
             r.eval_restore(from_dict(Evaluation, e))
         for a in data.get("allocs", ()):
             r.alloc_restore(from_dict(Allocation, a))
+        for seg in data.get("columnar_allocs", ()):
+            r.columnar_restore(seg)
         for p in data.get("periodic_launches", ()):
             r.periodic_launch_restore(from_dict(PeriodicLaunch, p))
         for s in data.get("services", ()):
@@ -290,6 +389,7 @@ _HANDLERS = {
     MessageType.PeriodicLaunchType: FSM._apply_periodic_launch,
     MessageType.PeriodicLaunchDelete: FSM._apply_periodic_launch_delete,
     MessageType.ServiceSync: FSM._apply_service_sync,
+    MessageType.ApplySweepBatch: FSM._apply_sweep_batch,
 }
 
 

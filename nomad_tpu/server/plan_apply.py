@@ -72,6 +72,37 @@ def _result_allocs(result: "PlanResult") -> List[Allocation]:
     return allocs
 
 
+def _encode_result(plan: Plan, result: "PlanResult"):
+    """One consensus-entry group element for a verified result. A result
+    carrying a full-coverage columnar SweepBatch encodes as ONE columnar
+    payload (ids + instance names + frozen per-TG templates + per-row
+    delta) — not N alloc dicts; its exact-path stops ride the same
+    element (`Updates`) so eviction+placement stay one atomic entry.
+    Returns (element, is_sweep)."""
+    sweep = getattr(result, "_sweep", None)
+    if sweep is not None and getattr(sweep, "alloc_ids", None):
+        updates: List[Allocation] = []
+        for ups in result.NodeUpdate.values():
+            updates.extend(ups)
+        element = {"Job": plan.Job, "Sweep": sweep.wire()}
+        if updates:
+            element["Updates"] = updates
+        return element, True
+    return {"Job": plan.Job, "Alloc": _result_allocs(result)}, False
+
+
+def _fire_store_commit() -> None:
+    """Failure seam: a consensus entry carrying a columnar sweep batch.
+    Fires BEFORE raft.apply (like plan.apply.commit), so a killed bulk
+    commit never enters the durable log — the waiting workers nack, the
+    broker redelivers exactly once, and no replica (or log replay) can
+    ever land the killed batch: all rows or none, never torn. Firing
+    post-consensus instead would leave the entry in the log and
+    duplicate the batch on replay."""
+    if failpoints.fire("state.store.commit") == "drop":
+        raise failpoints.FailpointError("state.store.commit")
+
+
 def _fire_preempt_commit(plans) -> None:
     """Failure seam: a consensus commit carrying alloc preemptions. Like
     plan.apply.commit, drop degrades to a failed apply — the waiting
@@ -719,10 +750,19 @@ class PlanApplier:
                                 "plan.apply.commit")
                         _fire_preempt_commit(
                             p.plan for p, _ in group)
-                        index = self.raft.apply(MessageType.AllocUpdate, {
-                            "Batch": [{"Job": pending.plan.Job,
-                                       "Alloc": _result_allocs(result)}
-                                      for pending, result in group],
+                        encoded = [_encode_result(pending.plan, result)
+                                   for pending, result in group]
+                        # Any columnar member upgrades the whole entry to
+                        # the sweep-batch op (its Batch shape is a strict
+                        # superset of AllocUpdate's); all-object entries
+                        # keep the reference AllocUpdate type.
+                        msg = (MessageType.ApplySweepBatch
+                               if any(f for _, f in encoded)
+                               else MessageType.AllocUpdate)
+                        if msg is MessageType.ApplySweepBatch:
+                            _fire_store_commit()
+                        index = self.raft.apply(msg, {
+                            "Batch": [e for e, _ in encoded],
                         })
             self.stats["t_apply_ms"] += (time.perf_counter() - ta0) * 1e3
             for span in spans:
@@ -765,6 +805,11 @@ class PlanApplier:
         if failpoints.fire("plan.apply.commit") == "drop":
             raise failpoints.FailpointError("plan.apply.commit")
         _fire_preempt_commit((plan,))
+        element, is_sweep = _encode_result(plan, result)
+        if is_sweep:
+            _fire_store_commit()
+            return self.raft.apply(MessageType.ApplySweepBatch,
+                                   {"Batch": [element]})
         return self.raft.apply(MessageType.AllocUpdate, {
             "Job": plan.Job,
             "Alloc": _result_allocs(result),

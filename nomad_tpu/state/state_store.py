@@ -21,17 +21,22 @@ log index in a replicated deployment, a local counter in dev mode).
 from __future__ import annotations
 
 import threading
+import time
 import weakref
 from bisect import bisect_right
 from dataclasses import replace
 from typing import Any, Callable, Dict, Iterable, List, Optional, Set, Tuple
 
+from nomad_tpu.analysis import guarded_by, requires_lock
+from nomad_tpu.telemetry import metrics
 from nomad_tpu.structs import (
     Allocation,
     Evaluation,
     Job,
     Node,
     PeriodicLaunch,
+    from_dict,
+    to_dict,
 )
 from nomad_tpu.structs.structs import (
     AllocClientStatusFailed,
@@ -96,10 +101,104 @@ class _Table:
             self.current[key] = value
 
 
+class SweepSegment:
+    """Columnar alloc storage for ONE committed sweep batch: per-alloc id /
+    instance-name / node columns plus a frozen per-task-group template the
+    rows share everything else with. A 10k-alloc system sweep commits as
+    one of these — no per-alloc objects, chains, member-set inserts or
+    watch items on the apply path. Rows materialize a real Allocation only
+    on first read (`materialize`), and any MUTATION promotes the row out
+    of the segment into the exact per-object chain path
+    (StateStore._col_promote_locked), so write semantics are unchanged.
+
+    Concurrency: all fields are guarded by the owning StateStore's _lock
+    (segments are never shared between stores)."""
+
+    __slots__ = ("index", "job_id", "eval_id", "templates", "tg_idx",
+                 "alloc_ids", "names", "node_ids", "live", "n_live",
+                 "_objs")
+
+    def __init__(self, index: int, job_id: str, eval_id: str,
+                 templates: List[Allocation], tg_idx: Optional[List[int]],
+                 alloc_ids: List[str], names: List[str],
+                 node_ids: List[str]):
+        self.index = index
+        self.job_id = job_id
+        self.eval_id = eval_id
+        self.templates = templates
+        self.tg_idx = tg_idx  # None => single template for every row
+        self.alloc_ids = alloc_ids
+        self.names = names
+        self.node_ids = node_ids
+        self.live = [True] * len(alloc_ids)
+        self.n_live = len(alloc_ids)
+        self._objs: Dict[int, Allocation] = {}  # pos -> materialized
+
+    def materialize(self, pos: int) -> Allocation:
+        """Stamp (and cache) the real Allocation for one row. The clone is
+        bit-equal to what the per-object commit path would have stored:
+        template fields shared (value-frozen contract), identity fields
+        and the client-mutable containers fresh, raft indexes = the
+        segment's commit index."""
+        obj = self._objs.get(pos)
+        if obj is not None:
+            return obj
+        template = self.templates[self.tg_idx[pos] if self.tg_idx else 0]
+        obj = object.__new__(Allocation)
+        obj.__dict__ = dict(template.__dict__)
+        obj.ID = self.alloc_ids[pos]
+        obj.Name = self.names[pos]
+        obj.NodeID = self.node_ids[pos]
+        obj.Services = {}
+        obj.TaskStates = {}
+        obj.CreateIndex = self.index
+        obj.ModifyIndex = self.index
+        obj.AllocModifyIndex = self.index
+        vec = getattr(template, "_resvec_cache", None)
+        if vec is not None:
+            obj._resvec_cache = vec
+        self._objs[pos] = obj
+        return obj
+
+    def serialize(self) -> Dict[str, Any]:
+        """Plain-data dump of the LIVE rows for raft snapshot persist.
+        No watermark filter is needed: a promoted row's chain version is
+        written at this segment's own index, so for every watermark that
+        can see this segment the chain dump already carries exactly the
+        promoted rows and `live` carries the rest. Shape round-trips
+        through msgpack and `deserialize`."""
+        keep = [i for i, alive in enumerate(self.live) if alive]
+        return {
+            "Index": self.index,
+            "JobID": self.job_id,
+            "EvalID": self.eval_id,
+            "Templates": [to_dict(t) for t in self.templates],
+            "TGIdx": ([self.tg_idx[i] for i in keep]
+                      if self.tg_idx else None),
+            "AllocIDs": [self.alloc_ids[i] for i in keep],
+            "Names": [self.names[i] for i in keep],
+            "NodeIDs": [self.node_ids[i] for i in keep],
+        }
+
+    @staticmethod
+    def deserialize(data: Dict[str, Any]) -> "SweepSegment":
+        templates = [t if isinstance(t, Allocation)
+                     else from_dict(Allocation, t)
+                     for t in data["Templates"]]
+        return SweepSegment(
+            index=int(data["Index"]), job_id=data["JobID"],
+            eval_id=data["EvalID"], templates=templates,
+            tg_idx=(list(data["TGIdx"]) if data.get("TGIdx") else None),
+            alloc_ids=list(data["AllocIDs"]), names=list(data["Names"]),
+            node_ids=list(data["NodeIDs"]))
+
+
 class _ReadAPI:
     """Read operations shared by StateStore (live view) and StateSnapshot."""
 
     # Subclasses define _get(table, key) and _iter(table) and _members(...)
+    # plus the columnar hooks _col_alloc / _col_members / _col_allocs_all
+    # (lazy views over SweepSegment rows).
 
     # -- nodes --
     def node_by_id(self, node_id: str) -> Optional[Node]:
@@ -142,23 +241,29 @@ class _ReadAPI:
 
     # -- allocs --
     def alloc_by_id(self, alloc_id: str) -> Optional[Allocation]:
-        return self._get("allocs", alloc_id)
+        found = self._get("allocs", alloc_id)
+        if found is None:
+            found = self._col_alloc(alloc_id)
+        return found
 
     def allocs(self) -> List[Allocation]:
-        return self._iter("allocs")
+        return self._iter("allocs") + self._col_allocs_all()
 
     def allocs_by_node(self, node_id: str) -> List[Allocation]:
-        return self._members("alloc_node", node_id, "allocs")
+        return (self._members("alloc_node", node_id, "allocs")
+                + self._col_members("node", node_id))
 
     def allocs_by_node_terminal(self, node_id: str, terminal: bool) -> List[Allocation]:
         return [a for a in self.allocs_by_node(node_id)
                 if a.terminal_status() == terminal]
 
     def allocs_by_job(self, job_id: str) -> List[Allocation]:
-        return self._members("alloc_job", job_id, "allocs")
+        return (self._members("alloc_job", job_id, "allocs")
+                + self._col_members("job", job_id))
 
     def allocs_by_eval(self, eval_id: str) -> List[Allocation]:
-        return self._members("alloc_eval", eval_id, "allocs")
+        return (self._members("alloc_eval", eval_id, "allocs")
+                + self._col_members("eval", eval_id))
 
     # -- periodic launches --
     def periodic_launch_by_id(self, job_id: str) -> Optional[PeriodicLaunch]:
@@ -199,6 +304,14 @@ _MEMBER_INDEXES = {
 class StateStore(_ReadAPI):
     """The authoritative in-memory store behind the FSM."""
 
+    # Columnar alloc tables (SweepSegment) and their lazy secondary
+    # indexes: commits append whole segments; the per-row id/node indexes
+    # are merged in on first READ (_col_flush_locked), so index
+    # maintenance never rides the serialized FSM apply.
+    _concurrency = guarded_by(
+        "_lock", "_col_segments", "_col_by_job", "_col_by_eval",
+        "_col_alloc_index", "_col_node_index", "_col_unindexed")
+
     def __init__(self) -> None:
         self._lock = threading.RLock()
         self._tables: Dict[str, _Table] = {t: _Table() for t in TABLES}
@@ -210,6 +323,22 @@ class StateStore(_ReadAPI):
         self._notify = NotifyGroup()
         self._watermarks: Dict[int, int] = {}  # snapshot token -> watermark
         self._next_token = 0
+        # Columnar alloc tables: one SweepSegment per committed sweep
+        # batch, plus segment-level (job/eval) and lazily-merged per-row
+        # (alloc id / node) indexes.
+        self._col_segments: List[SweepSegment] = []
+        self._col_by_job: Dict[str, List[SweepSegment]] = {}
+        self._col_by_eval: Dict[str, List[SweepSegment]] = {}
+        self._col_alloc_index: Dict[str, Tuple[SweepSegment, int]] = {}
+        self._col_node_index: Dict[str, List[Tuple[SweepSegment, int]]] = {}
+        self._col_unindexed: List[SweepSegment] = []
+        # Relaxed fast-path flag (deliberately OUTSIDE the guarded set):
+        # set under the lock when the first segment commits, read lock-free
+        # by the columnar hooks so non-sweep deployments never pay an extra
+        # lock round per alloc read. Monotonic once a store has seen a
+        # sweep; a racing reader at the flip boundary just orders before
+        # the commit.
+        self._has_col = False
         # Change listeners: cb(kind, old, new) fired post-commit. Used to keep
         # the device-resident node tensor in sync (nomad_tpu/tensor/).
         self._listeners: List[Callable[[str, Any, Any], None]] = []
@@ -229,6 +358,15 @@ class StateStore(_ReadAPI):
             for kind, old, new in events:
                 cb(kind, old, new)
 
+    def transaction(self):
+        """The store's write lock, for callers that must make SEVERAL
+        write calls atomic with respect to readers — the FSM wraps one
+        raft entry's groups (a sweep group's stops + its segment, plus
+        any object co-groups) in `with state.transaction():` so no
+        blocking query can observe a torn entry. Reentrant: the inner
+        write methods re-acquire freely."""
+        return self._lock
+
     # ------------------------------------------------------------------ reads
     def _get(self, table: str, key: str):
         return self._tables[table].current.get(key)
@@ -246,6 +384,92 @@ class StateStore(_ReadAPI):
     def _members_sets(self, index_name: str) -> Dict[str, Set[str]]:
         return self._member_sets[index_name]
 
+    # ------------------------------------------------- columnar alloc reads
+    def _col_flush_locked(self) -> None:
+        """Merge freshly committed segments into the per-row indexes.
+        Runs on the first read that needs them — off the commit path —
+        and costs O(rows) once per segment, amortized."""
+        if not self._col_unindexed:
+            return
+        for seg in self._col_unindexed:
+            by_alloc = self._col_alloc_index
+            by_node = self._col_node_index
+            for pos, (aid, nid) in enumerate(zip(seg.alloc_ids,
+                                                 seg.node_ids)):
+                if not seg.live[pos]:
+                    continue  # promoted before the first index merge
+                by_alloc[aid] = (seg, pos)
+                bucket = by_node.get(nid)
+                if bucket is None:
+                    by_node[nid] = [(seg, pos)]
+                else:
+                    bucket.append((seg, pos))
+        self._col_unindexed = []
+
+    def _col_alloc(self, alloc_id: str) -> Optional[Allocation]:
+        if not self._has_col:
+            return None
+        with self._lock:
+            self._col_flush_locked()
+            hit = self._col_alloc_index.get(alloc_id)
+            if hit is None:
+                return None
+            seg, pos = hit
+            if not seg.live[pos]:
+                return None
+            return seg.materialize(pos)
+
+    def _col_members(self, kind: str, key: str) -> List[Allocation]:
+        if not self._has_col:
+            return []
+        with self._lock:
+            if kind == "node":
+                self._col_flush_locked()
+                return [seg.materialize(pos)
+                        for seg, pos in self._col_node_index.get(key, ())
+                        if seg.live[pos]]
+            segs = (self._col_by_job if kind == "job"
+                    else self._col_by_eval).get(key, ())
+            return [seg.materialize(pos)
+                    for seg in segs for pos in range(len(seg.alloc_ids))
+                    if seg.live[pos]]
+
+    def _col_allocs_all(self) -> List[Allocation]:
+        if not self._has_col:
+            return []
+        with self._lock:
+            return [seg.materialize(pos)
+                    for seg in self._col_segments
+                    for pos in range(len(seg.alloc_ids))
+                    if seg.live[pos]]
+
+    def client_alloc_map(self, node_id: str) -> Tuple[Dict[str, int], int]:
+        """The client pull signal — {alloc_id: AllocModifyIndex} plus the
+        blocking-query index — WITHOUT materializing columnar rows: a
+        sweep-placed alloc's identity and index live in the segment
+        columns, so a node's 30s poll never stamps objects it won't run."""
+        with self._lock:
+            out: Dict[str, int] = {}
+            idx = 0
+            ids = self._members_sets("alloc_node").get(node_id, ())
+            cur = self._tables["allocs"].current
+            for aid in ids:
+                a = cur.get(aid)
+                if a is not None:
+                    out[aid] = a.AllocModifyIndex
+                    if a.AllocModifyIndex > idx:
+                        idx = a.AllocModifyIndex
+            if self._col_segments:
+                self._col_flush_locked()
+                for seg, pos in self._col_node_index.get(node_id, ()):
+                    if seg.live[pos]:
+                        out[seg.alloc_ids[pos]] = seg.index
+                        if seg.index > idx:
+                            idx = seg.index
+            if not out:
+                idx = self.get_index("allocs")
+            return out, idx
+
     def get_index(self, table: str) -> int:
         return self._table_index.get(table, 0)
 
@@ -260,16 +484,92 @@ class StateStore(_ReadAPI):
         self._notify.stop_watch(items, event)
 
     # ----------------------------------------------------------------- writes
-    def _commit(self, index: int, tables: Iterable[str], watch_items: Items) -> None:
+    def _commit(self, index: int, tables: Iterable[str], watch_items: Items,
+                scoped: Optional[Dict[str, Set[str]]] = None) -> None:
         for t in set(tables):
             self._table_index[t] = index
             watch_items.add(Item(table=t))
         if index > self._latest_index:
             self._latest_index = index
-        self._notify.notify(watch_items)
+        self._notify.notify(watch_items, scoped=scoped)
 
     def _member_add(self, index_name: str, key: str, obj_id: str) -> None:
         self._members_sets(index_name).setdefault(key, set()).add(obj_id)
+
+    # --------------------------------------------------- columnar alloc writes
+    def apply_sweep_segment(self, index: int, seg: SweepSegment,
+                            rows=None, delta=None, row_node_ids=None,
+                            epoch: int = -1) -> None:
+        """Commit one columnar sweep batch as ONE scatter: register the
+        segment, bump indexes, fire ONE batched trigger set (job/eval/table
+        items plus a waiter-intersection over the touched node/alloc keys),
+        and hand the per-row usage delta to batch-aware listeners (the
+        tensor index) as one scatter-add. No per-alloc work happens here —
+        per-row secondary indexes merge lazily on first read, and real
+        Allocation objects stamp lazily on first touch."""
+        t0 = time.monotonic()
+        with self._lock:
+            self._col_segments.append(seg)
+            self._col_unindexed.append(seg)
+            self._col_by_job.setdefault(seg.job_id, []).append(seg)
+            self._col_by_eval.setdefault(seg.eval_id, []).append(seg)
+            self._has_col = True
+            watch_items = Items([Item(alloc_job=seg.job_id),
+                                 Item(alloc_eval=seg.eval_id)])
+            # Job status: one live alloc <=> RUNNING, and every segment row
+            # is live — skip the O(fleet) derivation when already there.
+            jobs: Dict[str, str] = {}
+            job = self._get("jobs", seg.job_id)
+            if job is not None and job.Status != JobStatusRunning:
+                jobs[seg.job_id] = ""
+            touched = self._set_job_statuses(index, watch_items, jobs,
+                                             eval_delete=False)
+            self._commit(index, ["allocs"] + touched, watch_items,
+                         scoped={"alloc_node": set(seg.node_ids),
+                                 "alloc": set(seg.alloc_ids)})
+            for cb in self._listeners:
+                sweep_cb = getattr(cb, "on_sweep_batch", None)
+                if sweep_cb is not None and delta is not None:
+                    sweep_cb(row_node_ids, rows, delta, epoch)
+                    continue
+                # Generic listener fallback: per-event contract needs the
+                # objects — correctness over speed for foreign listeners.
+                events = [("alloc", None, seg.materialize(pos))
+                          for pos in range(len(seg.alloc_ids))]
+                batch = getattr(cb, "on_change_batch", None)
+                if batch is not None:
+                    batch(events)
+                else:
+                    for kind, old, new in events:
+                        cb(kind, old, new)
+        metrics.measure_since(("nomad", "state", "scatter"), t0)
+        metrics.incr_counter(("nomad", "state", "sweep_allocs"),
+                             len(seg.alloc_ids))
+
+    def _col_promote_locked(self, alloc_id: str) -> Optional[Allocation]:
+        """Promote a columnar row into the exact per-object chain path.
+        The materialized value is written into the chain AT THE SEGMENT'S
+        COMMIT INDEX, so every snapshot watermark keeps seeing exactly what
+        it saw before — the row just changes representation. Callers then
+        mutate through the ordinary object path. Caller holds _lock."""
+        if not self._has_col:
+            return None
+        self._col_flush_locked()
+        hit = self._col_alloc_index.pop(alloc_id, None)
+        if hit is None:
+            return None
+        seg, pos = hit
+        if not seg.live[pos]:
+            return None
+        obj = seg.materialize(pos)
+        seg.live[pos] = False
+        seg.n_live -= 1
+        self._tables["allocs"].write(seg.index, alloc_id, obj)
+        self._member_add("alloc_node", obj.NodeID, alloc_id)
+        self._member_add("alloc_job", obj.JobID, alloc_id)
+        self._member_add("alloc_eval", obj.EvalID, alloc_id)
+        metrics.incr_counter(("nomad", "state", "promote"))
+        return obj
 
     def upsert_node(self, index: int, node: Node) -> None:
         """(reference: state_store.go:91 UpsertNode) Preserves CreateIndex and
@@ -471,6 +771,10 @@ class StateStore(_ReadAPI):
             for aid in alloc_ids:
                 existing = self._get("allocs", aid)
                 if existing is None:
+                    # GC of a columnar row: promote (chain gets the value
+                    # at the segment index), then tombstone as usual.
+                    existing = self._col_promote_locked(aid)
+                if existing is None:
                     continue
                 self._tables["allocs"].write(index, aid, None)
                 watch_items.add(Item(alloc=aid))
@@ -506,8 +810,14 @@ class StateStore(_ReadAPI):
             members_node = self._members_sets("alloc_node")
             members_job = self._members_sets("alloc_job")
             members_eval = self._members_sets("alloc_eval")
+            has_col = self._has_col
             for alloc in allocs:
                 existing = alloc_current(alloc.ID)
+                if existing is None and has_col:
+                    # A mutation of a columnar row (eviction, preemption,
+                    # in-place replace) first promotes it onto the exact
+                    # object path, preserving upsert semantics verbatim.
+                    existing = self._col_promote_locked(alloc.ID)
                 if existing is None:
                     alloc.CreateIndex = index
                     alloc.ModifyIndex = index
@@ -557,6 +867,10 @@ class StateStore(_ReadAPI):
         merges the client-reported fields into the server's copy."""
         with self._lock:
             existing = self._get("allocs", alloc.ID)
+            if existing is None:
+                # Client status for a sweep-committed row: promote it out
+                # of the columnar table, then merge exactly as before.
+                existing = self._col_promote_locked(alloc.ID)
             if existing is None:
                 raise KeyError(f"alloc not found: {alloc.ID}")
             copy_alloc = existing.copy()
@@ -618,9 +932,16 @@ class StateStore(_ReadAPI):
             touched.append("jobs")
         return touched
 
+    @requires_lock("_lock")
     def _derive_job_status(self, job: Job, eval_delete: bool) -> str:
         """(reference: state_store.go:1097 getJobStatus)"""
         has_alloc = False
+        # Columnar rows are live (non-terminal) by construction — any
+        # segment row means RUNNING without materializing anything.
+        for seg in self._col_by_job.get(job.ID, ()):
+            if seg.n_live:
+                return JobStatusRunning
+            has_alloc = True
         for alloc in self._members("alloc_job", job.ID, "allocs"):
             has_alloc = True
             if not alloc.terminal_status():
@@ -669,6 +990,29 @@ class StateStore(_ReadAPI):
                     sets[key] = {i for i in sets[key] if i in chains}
                     if not sets[key]:
                         del sets[key]
+            # Drop fully-promoted segments: every row's value now lives in
+            # its chain (written at the segment index), so no watermark can
+            # still need the columnar view. Rebuild the per-row indexes
+            # without the dead segments' entries.
+            dead_segs = [s for s in self._col_segments if s.n_live == 0]
+            if dead_segs:
+                gone = set(map(id, dead_segs))
+                self._col_segments = [s for s in self._col_segments
+                                      if id(s) not in gone]
+                self._col_unindexed = [s for s in self._col_unindexed
+                                       if id(s) not in gone]
+                for by in (self._col_by_job, self._col_by_eval):
+                    for key in list(by):
+                        by[key] = [s for s in by[key] if id(s) not in gone]
+                        if not by[key]:
+                            del by[key]
+                for key in list(self._col_node_index):
+                    kept = [(s, p) for s, p in self._col_node_index[key]
+                            if id(s) not in gone]
+                    if kept:
+                        self._col_node_index[key] = kept
+                    else:
+                        del self._col_node_index[key]
 
     # ---------------------------------------------------------------- restore
     def restore(self) -> "Restore":
@@ -712,6 +1056,66 @@ class StateSnapshot(_ReadAPI):
                     out.append(v)
             return out
 
+    # ----------------------------------------------- columnar (at watermark)
+    # A segment is visible iff it committed at or before the watermark;
+    # promoted rows left the columnar view FOR EVERY WATERMARK (their chain
+    # version is written at the segment's own commit index), so `live` is
+    # the only per-row check needed.
+    def _col_alloc(self, alloc_id: str):
+        store = self._store
+        if not store._has_col:
+            return None
+        with store._lock:
+            store._col_flush_locked()
+            hit = store._col_alloc_index.get(alloc_id)
+            if hit is None:
+                return None
+            seg, pos = hit
+            if seg.index > self.watermark or not seg.live[pos]:
+                return None
+            return seg.materialize(pos)
+
+    def _col_members(self, kind: str, key: str):
+        store = self._store
+        if not store._has_col:
+            return []
+        with store._lock:
+            if kind == "node":
+                store._col_flush_locked()
+                return [seg.materialize(pos)
+                        for seg, pos in store._col_node_index.get(key, ())
+                        if seg.index <= self.watermark and seg.live[pos]]
+            segs = (store._col_by_job if kind == "job"
+                    else store._col_by_eval).get(key, ())
+            return [seg.materialize(pos)
+                    for seg in segs if seg.index <= self.watermark
+                    for pos in range(len(seg.alloc_ids))
+                    if seg.live[pos]]
+
+    def _col_allocs_all(self):
+        store = self._store
+        if not store._has_col:
+            return []
+        with store._lock:
+            return [seg.materialize(pos)
+                    for seg in store._col_segments
+                    if seg.index <= self.watermark
+                    for pos in range(len(seg.alloc_ids))
+                    if seg.live[pos]]
+
+    def alloc_dump(self):
+        """(chain allocs, serialized live columnar segments) read under ONE
+        store lock hold — the raft snapshot's alloc state. Two separate
+        reads could straddle a promotion and lose the row from both views;
+        this can't."""
+        store = self._store
+        with store._lock:
+            chain_allocs = self._iter("allocs")
+            segments = [seg.serialize()
+                        for seg in store._col_segments
+                        if seg.index <= self.watermark and seg.n_live]
+            return chain_allocs, segments
+
     def get_index(self, table: str) -> int:
         # Table indexes are monotone; clamp to the watermark.
         return min(self._store.get_index(table), self.watermark)
@@ -750,6 +1154,20 @@ class Restore:
         self._store._member_add("alloc_job", alloc.JobID, alloc.ID)
         self._store._member_add("alloc_eval", alloc.EvalID, alloc.ID)
         self._bump(alloc.ModifyIndex)
+
+    def columnar_restore(self, seg_data: Dict[str, Any]) -> None:
+        """Re-register one serialized columnar segment: the snapshot
+        round-trips the columnar tables columnar — a 1M-row restore never
+        explodes into per-alloc objects."""
+        seg = (seg_data if isinstance(seg_data, SweepSegment)
+               else SweepSegment.deserialize(seg_data))
+        store = self._store
+        store._col_segments.append(seg)
+        store._col_unindexed.append(seg)
+        store._col_by_job.setdefault(seg.job_id, []).append(seg)
+        store._col_by_eval.setdefault(seg.eval_id, []).append(seg)
+        store._has_col = True
+        self._bump(seg.index)
 
     def periodic_launch_restore(self, launch: PeriodicLaunch) -> None:
         self._store._tables["periodic_launch"].write(launch.ModifyIndex,

@@ -111,6 +111,17 @@ class TensorIndex:
     # on_change_batch (preferred by state_store._emit).
     __call__ = _on_change
 
+    def on_sweep_batch(self, node_ids, rows, delta, epoch: int) -> None:
+        """Columnar sweep-commit listener (state_store.apply_sweep_segment):
+        the batch's per-row demand lands as ONE scatter-add. Row-addressed
+        when the tensor epoch still matches emit time (no dict lookups at
+        all); id-addressed otherwise (rows may have changed identity)."""
+        delta = np.asarray(delta, dtype=np.float32)
+        if rows is not None and self.nt.apply_row_usage_deltas(
+                np.asarray(rows, dtype=np.int64), delta, epoch):
+            return
+        self.nt.apply_usage_deltas(list(node_ids), delta)
+
     def on_change_batch(self, events) -> None:
         """Batch form the state store prefers (state_store._emit): alloc
         usage transitions collapse into one scatter-add under one tensor

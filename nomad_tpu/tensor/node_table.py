@@ -250,6 +250,25 @@ class NodeTensor:
             self.usage[row] += sign * alloc_vec(alloc)
             self._usage_dirty.add(row)
 
+    def apply_row_usage_deltas(self, rows: np.ndarray, vecs: np.ndarray,
+                               epoch: int) -> bool:
+        """Row-addressed batch usage transition: a columnar sweep commit
+        carries its node ROWS from emit time, so when no row changed
+        identity since (`epoch` still current) the whole batch lands as
+        one scatter-add with ZERO per-node dict lookups. Returns False —
+        apply nothing — when the epoch moved or rows are out of bounds;
+        the caller falls back to the id-addressed path."""
+        with self._lock:
+            if len(rows) == 0:
+                return True
+            if epoch != self.row_epoch:
+                return False
+            if int(rows[-1]) >= self.n_rows:  # rows are sorted ascending
+                return False
+            np.add.at(self.usage, rows, vecs)
+            self._usage_dirty.update(rows.tolist())
+            return True
+
     def apply_usage_deltas(self, node_ids: Sequence[str],
                            vecs: np.ndarray) -> None:
         """Batched usage transitions under ONE lock: a committed plan's 50

@@ -1,0 +1,392 @@
+"""Fixed-seed columnar-vs-object state-store commit equivalence.
+
+The columnar commit path (plan applier -> ApplySweepBatch raft entry ->
+SweepSegment scatter-apply -> lazy materialization) must be
+indistinguishable from the per-object path it optimizes: identical
+allocs_by_node/-job/-eval results, identical alloc_by_id values,
+identical client pull maps, identical snapshot->restore state — and any
+MUTATION (client status update, stop/preemption eviction, GC) must
+promote the row onto the exact object path with the same end state the
+object commit would have produced.
+
+One fixed-seed system sweep is generated ONCE (capture-only planner),
+then the same verified result is committed twice — once as the columnar
+raft entry (through a real msgpack round-trip, the wire shape), once as
+the reference AllocUpdate object entry — into two fresh FSMs, and every
+read surface is compared as plain data.
+"""
+
+import logging
+import random
+
+import msgpack
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.resilience import failpoints
+from nomad_tpu.scheduler.system_sched import SystemScheduler
+from nomad_tpu.server.fsm import FSM, MessageType
+from nomad_tpu.server.plan_apply import _encode_result
+from nomad_tpu.state.state_store import StateStore
+from nomad_tpu.structs import PlanResult, compute_node_class, to_dict
+from nomad_tpu.structs.structs import (
+    AllocClientStatusRunning,
+    AllocDesiredStatusEvict,
+    EvalStatusPending,
+    EvalTriggerJobRegister,
+)
+from nomad_tpu.tensor import TensorIndex
+
+logger = logging.getLogger("test.columnar")
+
+APPLY_INDEX = 100
+
+
+class CapturePlanner:
+    def __init__(self):
+        self.plans = []
+        self.evals = []
+
+    def plan_queue_depth(self):
+        return 0
+
+    def submit_plan(self, plan):
+        self.plans.append(plan)
+        r = PlanResult()
+        r.NodeUpdate = dict(plan.NodeUpdate)
+        r.NodeAllocation = dict(plan.NodeAllocation)
+        r.AllocIndex = 1
+        return r, None
+
+    def update_eval(self, ev):
+        self.evals.append(ev)
+
+    def create_eval(self, ev):
+        self.evals.append(ev)
+
+    def reblock_eval(self, ev):
+        self.evals.append(ev)
+
+
+def make_node(i):
+    n = mock.node()
+    n.ID = f"node-{i:04d}"
+    n.Name = n.ID
+    compute_node_class(n)
+    return n
+
+
+def sys_job(count=2):
+    job = mock.system_job()
+    t = job.TaskGroups[0].Tasks[0]
+    t.Resources.CPU = 50
+    t.Resources.MemoryMB = 32
+    t.Resources.DiskMB = 150
+    t.Resources.Networks = []
+    t.Services = []
+    job.TaskGroups[0].Count = count
+    job.init_fields()
+    return job
+
+
+def sweep_plan(n_nodes=8, count=2):
+    """One fixed-seed system sweep plan (with its columnar descriptor)
+    against a capture-only planner — nothing committed."""
+    store = StateStore()
+    tindex = TensorIndex.attach(store)
+    idx = 0
+    for i in range(n_nodes):
+        idx += 1
+        store.upsert_node(idx, make_node(i))
+    job = sys_job(count)
+    idx += 1
+    store.upsert_job(idx, job)
+    ev = mock.eval()
+    ev.JobID = job.ID
+    ev.Type = job.Type
+    ev.TriggeredBy = EvalTriggerJobRegister
+    ev.Status = EvalStatusPending
+    planner = CapturePlanner()
+    sched = SystemScheduler(store, planner, tindex, logger,
+                            rng=random.Random(7))
+    sched.process(ev)
+    [plan] = planner.plans
+    assert getattr(plan, "_sweep", None) is not None
+    assert plan._sweep.alloc_ids  # per-alloc columns present
+    return job, plan
+
+
+def commit_columnar(plan):
+    """Commit the sweep through the REAL columnar entry, including a
+    msgpack round-trip (the consensus wire shape)."""
+    result = PlanResult(NodeUpdate=dict(plan.NodeUpdate),
+                        NodeAllocation=dict(plan.NodeAllocation))
+    result._sweep = plan._sweep
+    element, is_sweep = _encode_result(plan, result)
+    assert is_sweep
+    blob = msgpack.packb(
+        (int(MessageType.ApplySweepBatch), to_dict({"Batch": [element]})),
+        use_bin_type=True)
+    msg, payload = msgpack.unpackb(blob, raw=False)
+    fsm = FSM()
+    fsm.apply(APPLY_INDEX, MessageType(msg), payload)
+    assert fsm.state._col_segments, "sweep did not commit columnar"
+    return fsm
+
+
+def commit_objects(plan):
+    """The reference per-object commit of the SAME result."""
+    blob = msgpack.packb(
+        (int(MessageType.AllocUpdate),
+         to_dict({"Job": plan.Job,
+                  "Alloc": [a for placed in plan.NodeAllocation.values()
+                            for a in placed]})),
+        use_bin_type=True)
+    msg, payload = msgpack.unpackb(blob, raw=False)
+    fsm = FSM()
+    fsm.apply(APPLY_INDEX, MessageType(msg), payload)
+    assert not fsm.state._col_segments
+    return fsm
+
+
+def visible(state, job, plan):
+    """Every read surface as plain data, sorted for comparison."""
+    def dump(allocs):
+        return sorted((to_dict(a) for a in allocs), key=lambda d: d["ID"])
+
+    eval_id = plan.EvalID
+    node_ids = sorted(plan.NodeAllocation)
+    out = {
+        "all": dump(state.allocs()),
+        "by_job": dump(state.allocs_by_job(job.ID)),
+        "by_eval": dump(state.allocs_by_eval(eval_id)),
+        "by_node": {nid: dump(state.allocs_by_node(nid))
+                    for nid in node_ids},
+        "by_node_live": {nid: dump(state.allocs_by_node_terminal(nid,
+                                                                 False))
+                         for nid in node_ids},
+        "index": state.get_index("allocs"),
+    }
+    out["by_id"] = {d["ID"]: d for d in out["all"]}
+    if hasattr(state, "client_alloc_map"):
+        out["client"] = {nid: state.client_alloc_map(nid)
+                         for nid in node_ids}
+    return out
+
+
+def assert_same_state(fsm_col, fsm_obj, job, plan):
+    vc = visible(fsm_col.state, job, plan)
+    vo = visible(fsm_obj.state, job, plan)
+    assert vc == vo
+
+
+def roundtrip(fsm):
+    blob = msgpack.packb(fsm.snapshot(), use_bin_type=True)
+    out = FSM()
+    out.restore(msgpack.unpackb(blob, raw=False))
+    return out
+
+
+@pytest.fixture(autouse=True)
+def _heal_failpoints():
+    failpoints.disarm_all()
+    yield
+    failpoints.disarm_all()
+
+
+class TestColumnarEquivalence:
+    def test_commit_reads_identical(self):
+        """The same sweep committed columnar and per-object is
+        indistinguishable through every read surface."""
+        job, plan = sweep_plan()
+        fsm_col = commit_columnar(plan)
+        fsm_obj = commit_objects(plan)
+        assert_same_state(fsm_col, fsm_obj, job, plan)
+        # And the columnar side really stayed lazy at commit: no chain
+        # entries were created for the sweep's allocs.
+        assert not fsm_col.state._tables["allocs"].current
+
+    def test_snapshot_restore_identical(self):
+        """snapshot->restore round-trips the columnar tables columnar and
+        lands byte-identical client-visible state."""
+        job, plan = sweep_plan()
+        fsm_col = commit_columnar(plan)
+        fsm_obj = commit_objects(plan)
+        snap = fsm_col.snapshot()
+        assert snap["columnar_allocs"] and not snap["allocs"]
+        r_col = roundtrip(fsm_col)
+        r_obj = roundtrip(fsm_obj)
+        assert r_col.state._col_segments  # still columnar after restore
+        assert_same_state(r_col, r_obj, job, plan)
+        # Restored-columnar == live-object too (transitivity check).
+        assert visible(r_col.state, job, plan)["by_id"] \
+            == visible(fsm_obj.state, job, plan)["by_id"]
+
+    def test_client_update_promotes_row(self):
+        """A client status update on a sweep-committed alloc promotes the
+        row onto the exact object path; both stores converge to the same
+        mutated state and the row leaves the columnar table."""
+        job, plan = sweep_plan()
+        fsm_col = commit_columnar(plan)
+        fsm_obj = commit_objects(plan)
+        target = plan._sweep.alloc_ids[3]
+        seg = fsm_col.state._col_segments[0]
+        live_before = seg.n_live
+        for fsm in (fsm_col, fsm_obj):
+            running = fsm.state.alloc_by_id(target).copy()
+            running.ClientStatus = AllocClientStatusRunning
+            running.ClientDescription = "started"
+            fsm.apply(APPLY_INDEX + 1, MessageType.AllocClientUpdate,
+                      {"Alloc": [running]})
+        assert seg.n_live == live_before - 1
+        assert fsm_col.state._tables["allocs"].current[target] is not None
+        assert_same_state(fsm_col, fsm_obj, job, plan)
+        got = fsm_col.state.alloc_by_id(target)
+        assert got.ClientStatus == AllocClientStatusRunning
+        assert got.CreateIndex == APPLY_INDEX  # promotion kept identity
+        # Snapshot/restore still identical after a promotion.
+        assert_same_state(roundtrip(fsm_col), roundtrip(fsm_obj), job, plan)
+
+    def test_preemption_eviction_promotes_and_matches(self):
+        """A preemption-style eviction (stop upsert of a columnar row)
+        promotes the victim and commits the same terminal state the
+        object path produces — including the terminal/live split reads."""
+        job, plan = sweep_plan()
+        fsm_col = commit_columnar(plan)
+        fsm_obj = commit_objects(plan)
+        victim_id = plan._sweep.alloc_ids[0]
+        for fsm in (fsm_col, fsm_obj):
+            victim = fsm.state.alloc_by_id(victim_id).copy()
+            victim.DesiredStatus = AllocDesiredStatusEvict
+            victim.DesiredDescription = "preempted"
+            fsm.apply(APPLY_INDEX + 2, MessageType.AllocUpdate,
+                      {"Job": None, "Alloc": [victim]})
+        assert_same_state(fsm_col, fsm_obj, job, plan)
+        got = fsm_col.state.alloc_by_id(victim_id)
+        assert got.terminal_status()
+        node = got.NodeID
+        assert victim_id not in {
+            a.ID for a in fsm_col.state.allocs_by_node_terminal(node,
+                                                                False)}
+
+    def test_gc_delete_matches(self):
+        """delete_eval GC of columnar rows promotes + tombstones exactly
+        like the object path."""
+        job, plan = sweep_plan()
+        fsm_col = commit_columnar(plan)
+        fsm_obj = commit_objects(plan)
+        doomed = list(plan._sweep.alloc_ids[:3])
+        for fsm in (fsm_col, fsm_obj):
+            fsm.apply(APPLY_INDEX + 3, MessageType.EvalDelete,
+                      {"Evals": [], "Allocs": list(doomed)})
+        assert_same_state(fsm_col, fsm_obj, job, plan)
+        for aid in doomed:
+            assert fsm_col.state.alloc_by_id(aid) is None
+
+    def test_killed_commit_is_atomic(self):
+        """An injected kill at the bulk-commit seam fires BEFORE the
+        entry is proposed to consensus (like plan.apply.commit): the
+        raft log never carries the batch, so no replica — and no log
+        replay after the redelivered eval commits fresh UUIDs — can ever
+        land it. No torn batch: zero rows visible, zero segments, log
+        index unmoved."""
+        from nomad_tpu.server.fsm import DevRaft
+        from nomad_tpu.server.plan_apply import PlanApplier
+        from nomad_tpu.server.plan_queue import PlanQueue
+
+        job, plan = sweep_plan()
+        fsm = FSM()
+        raft = DevRaft(fsm)
+        # The applier verifies against real state: give the store the
+        # same (deterministic-ID) node fleet the plan targets.
+        for i in range(8):
+            fsm.state.upsert_node(i + 1, make_node(i))
+        index_before = raft.last_index
+        failpoints.arm_from_spec("state.store.commit=error:count=1")
+        queue = PlanQueue()
+        queue.set_enabled(True)
+        applier = PlanApplier(queue, raft)
+        queue.enqueue(plan)
+        with pytest.raises(failpoints.FailpointError):
+            applier.apply_one(queue.dequeue(timeout=1))
+        assert raft.last_index == index_before  # never entered the log
+        assert not fsm.state._col_segments
+        assert not fsm.state.allocs_by_job(job.ID)
+        queue.set_enabled(False)
+
+    def test_tensor_listener_epoch_fallback(self):
+        """The usage listener's row-addressed scatter must decline on an
+        epoch mismatch and fall back to the id-addressed path — same
+        final usage either way (regression: the fallback once executed
+        orphaned per-event code and raised NameError)."""
+        import numpy as np
+        from nomad_tpu.tensor.node_table import RES_DIMS
+
+        store = StateStore()
+        tindex = TensorIndex.attach(store)
+        node = make_node(0)
+        store.upsert_node(1, node)
+        row = tindex.nt.row_of[node.ID]
+        base = tindex.nt.usage[row].copy()
+        delta = np.ones((1, RES_DIMS), dtype=np.float32)
+        # Current epoch: row-addressed path.
+        tindex.on_sweep_batch([node.ID], np.asarray([row]), delta,
+                              tindex.nt.row_epoch)
+        assert np.allclose(tindex.nt.usage[row], base + 1)
+        # Stale epoch: id-addressed fallback, same result.
+        tindex.on_sweep_batch([node.ID], np.asarray([row]), delta,
+                              tindex.nt.row_epoch - 1)
+        assert np.allclose(tindex.nt.usage[row], base + 2)
+        # And the ordinary per-event batch listener is still wired (the
+        # store's _emit prefers it).
+        assert callable(getattr(tindex, "on_change_batch"))
+
+    def test_entry_with_updates_is_one_transaction(self):
+        """A sweep element carrying exact-path stops (Updates) commits
+        stops AND placements in the same entry; afterwards both are
+        visible together (stop-then-place order inside one
+        transaction)."""
+        job, plan = sweep_plan()
+        fsm = commit_columnar(plan)
+        # Build a second sweep entry for the same job whose element also
+        # carries a stop of one previously committed alloc.
+        victim = fsm.state.alloc_by_id(plan._sweep.alloc_ids[0]).copy()
+        victim.DesiredStatus = AllocDesiredStatusEvict
+        victim.DesiredDescription = "preempted"
+        job2, plan2 = sweep_plan()
+        result = PlanResult(NodeUpdate={victim.NodeID: [victim]},
+                            NodeAllocation=dict(plan2.NodeAllocation))
+        result._sweep = plan2._sweep
+        element, is_sweep = _encode_result(plan2, result)
+        assert is_sweep and "Updates" in element
+        fsm.apply(APPLY_INDEX + 5, MessageType.ApplySweepBatch,
+                  {"Batch": [element]})
+        got = fsm.state.alloc_by_id(victim.ID)
+        assert got.terminal_status()
+        assert len(fsm.state.allocs_by_job(job2.ID)) \
+            == len(plan2._sweep.alloc_ids)
+
+    def test_chunk_slices_cover_batch(self):
+        """Descriptor slices (the chunked submit path) partition the
+        per-alloc columns exactly: committing the slices equals
+        committing the whole batch."""
+        job, plan = sweep_plan(n_nodes=9, count=2)
+        sweep = plan._sweep
+        mid = len(sweep.node_ids) // 2
+        parts = [sweep.slice(0, mid),
+                 sweep.slice(mid, len(sweep.node_ids))]
+        assert sum(len(p.alloc_ids) for p in parts) == len(sweep.alloc_ids)
+        assert [i for p in parts for i in p.alloc_ids] == sweep.alloc_ids
+        fsm_whole = commit_columnar(plan)
+        fsm_parts = FSM()
+        for k, part in enumerate(parts):
+            chunk = PlanResult(NodeAllocation={
+                nid: plan.NodeAllocation[nid] for nid in part.node_ids})
+            chunk._sweep = part
+            element, is_sweep = _encode_result(plan, chunk)
+            assert is_sweep
+            fsm_parts.apply(APPLY_INDEX + k, MessageType.ApplySweepBatch,
+                            {"Batch": [element]})
+        whole = {a.ID for a in fsm_whole.state.allocs_by_job(job.ID)}
+        split = {a.ID for a in fsm_parts.state.allocs_by_job(job.ID)}
+        assert whole == split == set(sweep.alloc_ids)

@@ -91,6 +91,18 @@ SLO_LOW = int(os.environ.get("BENCH_SLO_LOW", 400))
 SLO_HIGH = int(os.environ.get("BENCH_SLO_HIGH", 12))
 SLO_REPS = int(os.environ.get("BENCH_SLO_REPS", 3))
 RUN_SLO = os.environ.get("BENCH_SLO", "1") != "0"
+# Service columnar-commit A/B (bench_service_columnar_ab): the same
+# service storm served with columnar commits on vs off, servers live
+# simultaneously, reps interleaved with ALTERNATING within-pair order
+# (the cgroup quota punishes whoever runs second), max-of-reps.
+SVC_AB_NODES = int(os.environ.get("BENCH_SVC_NODES", 2000))
+SVC_AB_EVALS = int(os.environ.get("BENCH_SVC_EVALS", 60))
+SVC_AB_REPS = int(os.environ.get("BENCH_SVC_REPS", 3))
+RUN_SVC_AB = os.environ.get("BENCH_SVC_AB", "1") != "0"
+# Smoke gate on the store microbench: columnar service-window commit must
+# beat the per-object path by at least this factor (parity-style exit 2).
+# Measured ~8-15x on a quiet box; 3x leaves noise headroom.
+STORE_SVC_GATE = float(os.environ.get("BENCH_STORE_GATE", 3.0))
 
 
 def _apply_smoke():
@@ -103,6 +115,7 @@ def _apply_smoke():
     global RUN_C2, RUN_C4, RUN_C5, PARITY_NODES, PARITY_EVALS
     global SCALING_NODES, SCALING_EVALS, C4_EVALS
     global SLO_NODES, SLO_LOW, SLO_HIGH, SLO_REPS
+    global SVC_AB_NODES, SVC_AB_EVALS, SVC_AB_REPS
     N_NODES = min(N_NODES, 512)
     N_PLACEMENTS = min(N_PLACEMENTS, 2000)   # 40 evals @ PER_EVAL=50
     N_REPS = min(N_REPS, 3)
@@ -128,6 +141,13 @@ def _apply_smoke():
     SLO_LOW = min(SLO_LOW, 24)
     SLO_HIGH = min(SLO_HIGH, 6)
     SLO_REPS = min(SLO_REPS, 2)
+    # The service columnar A/B STAYS on at smoke scale: the columnar
+    # service commit has its in-tree microbench gate (store section), but
+    # the e2e interleave is the only place an A/B parity break (columnar
+    # placing differently from object) would surface. A few seconds.
+    SVC_AB_NODES = min(SVC_AB_NODES, 256)
+    SVC_AB_EVALS = min(SVC_AB_EVALS, 20)
+    SVC_AB_REPS = min(SVC_AB_REPS, 2)
 
 
 def _freeze_heap():
@@ -873,29 +893,217 @@ def bench_store_commit(n_nodes, reps=3):
     }
 
 
-def bench_store_commit_window(per_eval=PER_EVAL, reps=5):
-    """Object-path commit cost at the SERVICE window shape (one 50-alloc
-    plan): the headline/config5 configs commit through this path, so the
-    store section tracks its per-alloc µs alongside the sweep numbers."""
-    from nomad_tpu import mock
-    from nomad_tpu.server.fsm import FSM, MessageType
+def _capture_service_plans(n_nodes, per_eval=PER_EVAL, n_plans=1):
+    """Fixed-seed service-window plans (each with its columnar service
+    descriptor) captured through the pipelined fast path's build —
+    prepare_batch -> host placement kernel -> compact -> collect_build —
+    nothing committed. One store/tensor boot serves every capture; the
+    plans are the input both store-commit paths replay."""
+    import logging
 
-    job = build_job(per_eval)
-    allocs = []
-    for i in range(per_eval):
-        a = mock.alloc()
-        a.Job = None
-        a.JobID = job.ID
-        allocs.append(a)
-    best = float("inf")
-    for _ in range(reps):
-        fsm = FSM()
-        t0 = time.perf_counter()
-        fsm.apply(1, MessageType.AllocUpdate,
-                  {"Job": job, "Alloc": allocs})
-        best = min(best, time.perf_counter() - t0)
-    return {"allocs": per_eval,
-            "object_per_alloc_us": round(best / per_eval * 1e6, 2)}
+    from nomad_tpu import mock
+    from nomad_tpu.scheduler import kernels
+    from nomad_tpu.scheduler.context import EvalContext
+    from nomad_tpu.scheduler.stack import GenericStack, WindowAccumulator
+    from nomad_tpu.scheduler.util import (
+        diff_allocs,
+        materialize_task_groups,
+        ready_nodes_in_dcs,
+    )
+    from nomad_tpu.state.state_store import StateStore
+    from nomad_tpu.structs.structs import EvalTriggerJobRegister
+    from nomad_tpu.tensor import ClassEligibility, TensorIndex
+
+    store = StateStore()
+    tindex = TensorIndex.attach(store)
+    idx = 0
+    for node in build_nodes(n_nodes):
+        idx += 1
+        store.upsert_node(idx, node)
+    plans = []
+    for k in range(n_plans):
+        job = build_job(per_eval)
+        idx += 1
+        store.upsert_job(idx, job)
+        ev = mock.eval()
+        ev.JobID = job.ID
+        ev.Type = job.Type
+        ev.TriggeredBy = EvalTriggerJobRegister
+        snap = store.snapshot()
+        plan = ev.make_plan(job, copy_job=False)
+        ctx = EvalContext(snap, plan, logging.getLogger("bench.store"))
+        stack = GenericStack(ctx, tindex, batch=False,
+                             rng=random.Random(7 + k))
+        diff = diff_allocs(job, {}, materialize_task_groups(job), [])
+        nodes, _ = ready_nodes_in_dcs(snap, job.Datacenters)
+        nt = tindex.nt
+        cand_mask = np.zeros(nt.n_rows, dtype=bool)
+        for n in nodes:
+            row = nt.row_of.get(n.ID)
+            if row is not None:
+                cand_mask[row] = True
+        stack.job = job
+        stack.adopt_nodes({n.ID: n for n in nodes}, cand_mask,
+                          ClassEligibility(nt, nodes))
+        prep = stack.prepare_batch([t.TaskGroup for t in diff.place])
+        res = stack.dispatch_host(prep)
+        cr = kernels.compact_host(np.asarray(res.packed), prep.n_valid)
+        ok = stack.collect_build(prep, cr, ev.ID, job, diff.place, plan,
+                                 {}, WindowAccumulator(nt.n_rows))
+        assert ok and getattr(plan, "_sweep", None) is not None, \
+            "service window lost its columnar descriptor"
+        plans.append(plan)
+    return plans
+
+
+def bench_store_commit_window(per_eval=PER_EVAL, reps=5):
+    """Commit A/B at the SERVICE window shapes: the SAME fixed-seed
+    service-window plans committed per-object (the pre-columnar service
+    path, one upsert per alloc) and columnar (ApplySweepBatch scatter)
+    into fresh FSMs. Two shapes: one lone plan (the idle-broker commit)
+    and the applier's 16-plan group entry (_APPLY_BATCH — what a storm
+    window actually commits as; the per-entry fixed costs amortize
+    there, which is where the --smoke gate holds the speedup)."""
+    import msgpack
+    from nomad_tpu.server.fsm import FSM, MessageType
+    from nomad_tpu.server.plan_apply import _APPLY_BATCH, _encode_result
+    from nomad_tpu.structs import PlanResult, to_dict
+
+    plans = _capture_service_plans(min(N_NODES, 2048), per_eval,
+                                   n_plans=_APPLY_BATCH)
+    elements = []
+    obj_groups = []
+    for plan in plans:
+        result = PlanResult(NodeAllocation=dict(plan.NodeAllocation))
+        result._sweep = plan._sweep
+        element, is_sweep = _encode_result(plan, result)
+        assert is_sweep, "service plan lost its columnar descriptor"
+        elements.append(element)
+        obj_groups.append({"Job": plan.Job,
+                           "Alloc": [a for v in plan.NodeAllocation.values()
+                                     for a in v]})
+    obj_bytes = len(msgpack.packb(
+        (int(MessageType.AllocUpdate), to_dict(obj_groups[0])),
+        use_bin_type=True))
+    col_bytes = len(msgpack.packb(
+        (int(MessageType.ApplySweepBatch),
+         to_dict({"Batch": [elements[0]]})),
+        use_bin_type=True))
+
+    def timed(msg, payload):
+        best = float("inf")
+        for _ in range(reps):
+            fsm = FSM()
+            t0 = time.perf_counter()
+            fsm.apply(1, msg, payload)
+            best = min(best, time.perf_counter() - t0)
+        return best
+
+    t_obj = timed(MessageType.AllocUpdate, obj_groups[0])
+    t_col = timed(MessageType.ApplySweepBatch, {"Batch": [elements[0]]})
+    n_storm = per_eval * len(plans)
+    ts_obj = timed(MessageType.AllocUpdate, {"Batch": obj_groups})
+    ts_col = timed(MessageType.ApplySweepBatch, {"Batch": elements})
+    return {
+        "allocs": per_eval,
+        "object_per_alloc_us": round(t_obj / per_eval * 1e6, 2),
+        "columnar_per_alloc_us": round(t_col / per_eval * 1e6, 3),
+        "columnar_batch_scatter_ms": round(t_col * 1e3, 3),
+        "commit_speedup": round(t_obj / t_col, 1) if t_col else None,
+        "raft_entry_bytes": {"object": obj_bytes, "columnar": col_bytes,
+                             "ratio": round(obj_bytes / col_bytes, 1)
+                             if col_bytes else None},
+        "storm_group": {
+            "plans": len(plans),
+            "allocs": n_storm,
+            "object_per_alloc_us": round(ts_obj / n_storm * 1e6, 2),
+            "columnar_per_alloc_us": round(ts_col / n_storm * 1e6, 3),
+            "commit_speedup": round(ts_obj / ts_col, 1) if ts_col else None,
+        },
+    }
+
+
+def bench_service_columnar_ab():
+    """Service-path commit A/B end to end: the SAME storm served with
+    columnar service commits on (ApplySweepBatch + SweepSegment scatter)
+    vs off (per-object upserts, the pre-columnar path). Both servers live
+    simultaneously, timed reps interleaved with the within-pair order
+    ALTERNATING each rep (this box's cgroup quota punishes whoever runs
+    second), max-of-reps compared. Records per-side rates + storm latency
+    percentiles, the columnar server's segment counters (the proof the
+    storm took the new path), and a parity gate: both sides must place
+    the full storm every rep."""
+    from nomad_tpu.server import Server, ServerConfig
+
+    nodes = build_nodes(SVC_AB_NODES)
+    out = {"nodes": SVC_AB_NODES, "evals_per_rep": SVC_AB_EVALS}
+    servers = {}
+    try:
+        for mode, columnar in (("columnar", True), ("object", False)):
+            srv = Server(ServerConfig(num_schedulers=N_WORKERS,
+                                      pipelined_scheduling=True,
+                                      scheduler_window=WINDOW,
+                                      service_columnar=columnar,
+                                      min_heartbeat_ttl=24 * 3600.0,
+                                      heartbeat_grace=24 * 3600.0))
+            srv.establish_leadership()
+            for node in nodes:
+                srv.node_register(node)
+            run = _make_storm_runner(srv)
+            run(3)
+            run(3)
+            srv.tindex.nt.warm_device()
+            run(SVC_AB_EVALS)  # full-size warm storm (compiles)
+            servers[mode] = (srv, run)
+        _tune_gc()
+        # Baseline the cumulative segment counter AFTER warmups so the
+        # parity gate proves the TIMED reps took the columnar path (a
+        # silent fallback-to-object mid-rep would otherwise hide behind
+        # warmup segments).
+        base_service = servers["columnar"][0].state.columnar_stats()[
+            "Batches"].get("service", 0)
+        rates = {"columnar": [], "object": []}
+        lats = {"columnar": [], "object": []}
+        placed = {"columnar": [], "object": []}
+        for rep in range(SVC_AB_REPS):
+            order = (("columnar", "object") if rep % 2 == 0
+                     else ("object", "columnar"))
+            for mode in order:
+                srv, run = servers[mode]
+                for w in srv.workers:
+                    if hasattr(w, "quiesce"):
+                        w.quiesce(30.0)
+                t0 = time.perf_counter()
+                eval_ids = run(SVC_AB_EVALS, latencies=lats[mode])
+                rates[mode].append(
+                    round(SVC_AB_EVALS / (time.perf_counter() - t0), 2))
+                _freeze_heap()
+                placed[mode].append(sum(
+                    1 for eid in eval_ids
+                    for _ in srv.state.allocs_by_eval(eid)))
+        for mode in ("columnar", "object"):
+            out[mode] = {"evals_sec": max(rates[mode]),
+                         "rep_rates": rates[mode],
+                         "storm_latency_ms": _pctiles_ms(lats[mode]),
+                         "placed_per_rep": placed[mode]}
+        out["speedup"] = round(max(rates["columnar"])
+                               / max(rates["object"]), 3) \
+            if rates["object"] else None
+        out["columnar_store"] = servers["columnar"][0].state.columnar_stats()
+        out["object_store_batches"] = \
+            servers["object"][0].state.columnar_stats()["Batches"]
+        out["timed_service_batches"] = \
+            out["columnar_store"]["Batches"].get("service", 0) - base_service
+        want = SVC_AB_EVALS * PER_EVAL
+        out["parity_ok"] = bool(
+            all(p == want for mode in placed for p in placed[mode])
+            and out["timed_service_batches"] >= 1
+            and not out["object_store_batches"])
+        out["expected_allocs"] = want
+        return out
+    finally:
+        for srv, _ in servers.values():
+            srv.shutdown()
 
 
 def bench_placer(nodes, n_evals, per_eval=PER_EVAL, dcs=None):
@@ -1152,13 +1360,20 @@ def main(argv=None):
         }
 
     # State-store commit microbench (`store` section): per-alloc commit
-    # µs / batch scatter ms / raft entry bytes, per commit shape — the
-    # sweep shape feeds config4 (and any system storm), the window shape
-    # feeds the headline/config2/config5 service configs.
-    detail["store"] = {
+    # µs / batch scatter ms / raft entry bytes, object vs columnar at
+    # BOTH commit shapes — the sweep shape feeds config4 (and any system
+    # storm), the window shape feeds the headline/config2/config5 service
+    # configs (columnar service commits since ISSUE 11).
+    detail["store"] = (store := {
         "config4_system": bench_store_commit(N_NODES),
         "service_window": bench_store_commit_window(),
-    }
+    })
+
+    # Service columnar-commit A/B: end-to-end evals/s + storm tails with
+    # columnar service commits on vs off, interleaved/alternating reps.
+    svc_ab = None
+    if RUN_SVC_AB:
+        detail["service_columnar"] = (svc_ab := bench_service_columnar_ab())
 
     # Horizontal worker scaling: always recorded (smoke shapes), so every
     # BENCH file carries the 1-vs-2 ratio next to the single-worker rate.
@@ -1198,6 +1413,23 @@ def main(argv=None):
         # drops work), admission must shed when told to, preemption must
         # place atomically. Same fail-after-emit contract as above.
         sys.stderr.write(f"QOS SLO GATE FAILED: {json.dumps(slo)}\n")
+        sys.exit(2)
+    svc_store = store["service_window"]
+    if (svc_store["storm_group"]["commit_speedup"] or 0) < STORE_SVC_GATE:
+        # Columnar-commit gate: at the storm commit unit (the applier's
+        # 16-plan group entry) the service-window FSM commit must stay
+        # >= STORE_SVC_GATE x faster than the per-object path (the whole
+        # point of the columnar service path). Deterministic CPU, so a
+        # miss is a regression, not noise. Same fail-after-emit contract.
+        sys.stderr.write(
+            f"SERVICE COLUMNAR STORE GATE FAILED "
+            f"(want >= {STORE_SVC_GATE}x): {json.dumps(svc_store)}\n")
+        sys.exit(2)
+    if svc_ab is not None and not svc_ab["parity_ok"]:
+        # Columnar A/B parity: both commit paths place the full storm and
+        # the columnar server really committed service segments.
+        sys.stderr.write(
+            f"SERVICE COLUMNAR AB GATE FAILED: {json.dumps(svc_ab)}\n")
         sys.exit(2)
 
 

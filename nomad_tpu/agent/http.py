@@ -827,8 +827,17 @@ def route(agent, method: str, path: str, query, get_body):
             qos_out = {"Enabled": True,
                        **srv.eval_broker.qos_stats(),
                        "Counters": srv.qos_counters.snapshot()}
+        # Columnar-store counters: segment/live-row/promoted counts plus
+        # committed batches split by commit path (system sweep vs service
+        # window) — which path a storm took (README "Columnar state
+        # store").
+        store_out = None
+        state = getattr(srv, "state", None)
+        col_stats = getattr(state, "columnar_stats", None)
+        if col_stats is not None:
+            store_out = col_stats()
         return {"Workers": workers, "ByWorker": by_worker,
-                "Totals": totals, "QoS": qos_out}, None
+                "Totals": totals, "QoS": qos_out, "Store": store_out}, None
 
     if path == "/v1/agent/metrics":
         # In-memory telemetry snapshot (reference shape: go-metrics

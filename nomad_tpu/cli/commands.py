@@ -841,6 +841,16 @@ def cmd_sched_stats(args) -> int:
         counters = qos.get("Counters") or {}
         print("  " + "  ".join(f"{k}={v}" for k, v in
                                sorted(counters.items())))
+    store = out.get("Store") or {}
+    if store:
+        # Which commit path storms took: columnar segments by kind
+        # ("service" window vs "system" sweep) + promotion pressure.
+        batches = store.get("Batches") or {}
+        kinds = ("  ".join(f"{k}={v}" for k, v in sorted(batches.items()))
+                 or "none")
+        print(f"Columnar store: {store.get('Segments', 0)} segments / "
+              f"{store.get('LiveRows', 0)} live rows / "
+              f"{store.get('PromotedRows', 0)} promoted; batches: {kinds}")
     workers = out.get("Workers") or []
     if not workers:
         print("No scheduling workers running (agent is not the leader?)")

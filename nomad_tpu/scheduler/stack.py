@@ -277,11 +277,18 @@ class GenericStack:
     """Stack for service/batch jobs (reference: stack.go:35-173)."""
 
     def __init__(self, ctx: EvalContext, tindex: TensorIndex, batch: bool,
-                 rng: Optional[random.Random] = None):
+                 rng: Optional[random.Random] = None,
+                 columnar: bool = True):
         self.ctx = ctx
         self.tindex = tindex
         self.batch = batch
         self.rng = rng or random.Random()
+        # Columnar service commits: the all-placed window build attaches a
+        # SweepBatch descriptor (kind="service") so the plan replicates as
+        # ONE ApplySweepBatch raft entry + SweepSegment scatter instead of
+        # per-object upserts. False keeps the per-object commit (the
+        # equivalence oracle and the bench A/B's object side).
+        self.columnar = columnar
         self.job: Optional[Job] = None
         self.elig: Optional[ClassEligibility] = None
         self._cand_mask: Optional[np.ndarray] = None
@@ -788,12 +795,25 @@ class GenericStack:
         row and no group asks for networks. One fancy-index gather maps
         chosen rows to node IDs, scores land in the metrics dict via one
         zip pass, the window-usage contribution queues as one batch, and
-        allocs share per-TG frozen task-resource templates instead of
-        copying Resources per task per alloc."""
+        allocs stamp from per-TG frozen template Allocations (the sweep
+        path's __dict__-clone trick) instead of running the 20-field
+        dataclass constructor per winner.
+
+        The winner rows stay COLUMNAR past the build: a SweepBatch
+        descriptor (kind="service") rides the plan so the applier bulk-
+        verifies it as one vector op, replicates it as one ApplySweepBatch
+        raft entry, and the store scatter-applies it as a SweepSegment —
+        the service window never explodes into per-object upserts. Rows
+        that take the exact path today (failed placements, network asks,
+        vanished nodes) never reach this build, so the descriptor always
+        covers the whole plan."""
+        from .system_sweep import SweepBatch
+
         nt = self.tindex.nt
         n = len(place)
         rows = cr.chosen[:n]
-        ids = nt.node_id_array()[rows]
+        id_arr = nt.node_id_array()
+        ids = id_arr[rows]
         nodes_by_id = self._nodes_by_id
         ids_list = ids.tolist()
         for nid in set(ids_list):
@@ -810,30 +830,78 @@ class GenericStack:
         tg_index = prep.tg_index
         tgs = prep.tgs
         self._fill_metrics(prep, tg_index[tgs[n - 1].Name], cr.nf_last)
-        acc.add(rows.astype(np.int64, copy=False), prep.demands[:n])
+        rows64 = rows.astype(np.int64, copy=False)
+        acc.add(rows64, prep.demands[:n])
 
         # Scoring is final now: one immutable metric snapshot shared by
-        # every placed alloc (reference: alloc.Metrics).
+        # every placed alloc (reference: alloc.Metrics). Templates are
+        # per-CALL (eval_id/metrics are per-eval) but their task-resource
+        # dict + vector come from the shared prep memo.
         shared_metric = metrics_.copy()
         append_alloc = plan.append_alloc
-        template = self._tg_template
+        templates: List[Allocation] = []
+        tpl_dicts: List[dict] = []
+        tpl_of: Dict[int, int] = {}
+        alloc_ids_l: List[str] = []
+        names_l: List[str] = []
+        alloc_tg = np.empty(n, dtype=np.int64)
+        new = object.__new__
+        cls = Allocation
         for p, tup in enumerate(place):
             tg = tgs[p]
-            tr, vec = template(prep, tg_index[tg.Name])
-            alloc = Allocation(
-                ID=generate_uuid(),
-                EvalID=eval_id,
-                Name=tup.Name,
-                JobID=job.ID,
-                TaskGroup=tg.Name,
-                NodeID=ids_list[p],
-                TaskResources=tr,
-                Metrics=shared_metric,
-                DesiredStatus=AllocDesiredStatusRun,
-                ClientStatus=AllocClientStatusPending,
-            )
-            alloc._resvec_cache = vec
+            ti = tg_index[tg.Name]
+            k = tpl_of.get(ti)
+            if k is None:
+                tr, vec = self._tg_template(prep, ti)
+                template = Allocation(
+                    EvalID=eval_id,
+                    JobID=job.ID,
+                    TaskGroup=tg.Name,
+                    TaskResources=tr,
+                    Metrics=shared_metric,
+                    DesiredStatus=AllocDesiredStatusRun,
+                    ClientStatus=AllocClientStatusPending,
+                )
+                template._resvec_cache = vec
+                k = tpl_of[ti] = len(templates)
+                templates.append(template)
+                tpl_dicts.append(template.__dict__)
+            alloc = new(cls)
+            alloc.__dict__ = dict(tpl_dicts[k])
+            alloc.ID = generate_uuid()
+            alloc.Name = tup.Name
+            alloc.NodeID = ids_list[p]
+            alloc.Services = {}
+            alloc.TaskStates = {}
+            alloc_ids_l.append(alloc.ID)
+            names_l.append(tup.Name)
+            alloc_tg[p] = k
             append_alloc(alloc)
+
+        if not self.columnar:
+            return True
+        # Columnar descriptor: unique placed rows with summed demand, plus
+        # the per-alloc columns sorted into row order so chunk slices stay
+        # contiguous (same layout the system sweep emits). The delta uses
+        # the template resource vectors — exactly what alloc_vec() yields
+        # for every stamped clone, so the applier's bulk verify and the
+        # optimistic overlay account the same bytes the object path would.
+        ur, inv = np.unique(rows64, return_inverse=True)
+        tpl_vecs = np.stack([t._resvec_cache for t in templates])
+        delta = np.zeros((len(ur), RES_DIMS), dtype=np.float32)
+        np.add.at(delta, inv, tpl_vecs[alloc_tg])
+        order = np.argsort(rows64, kind="stable")
+        counts = np.bincount(inv, minlength=len(ur)).astype(np.int64)
+        starts = np.concatenate([np.zeros(1, dtype=np.int64),
+                                 np.cumsum(counts, dtype=np.int64)])
+        plan._sweep = SweepBatch(
+            rows=ur, node_ids=id_arr[ur].tolist(), delta=delta,
+            epoch=nt.row_epoch, n_rows=nt.n_rows,
+            counts=counts, starts=starts,
+            alloc_ids=np.asarray(alloc_ids_l, dtype=object)[order].tolist(),
+            alloc_names=np.asarray(names_l, dtype=object)[order].tolist(),
+            alloc_tg=alloc_tg[order].tolist(),
+            templates=templates, kind="service")
         return True
 
     def collect_build(self, prep: PreparedBatch, cr,

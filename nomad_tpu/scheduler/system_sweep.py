@@ -104,6 +104,11 @@ class SweepBatch:
     alloc_names: List[str] = None   # [K] instance names (job.tg[i])
     alloc_tg: List[int] = None      # [K] index into templates
     templates: List = None          # per-TG frozen template Allocations
+    # Which emit path built the batch: "system" (tensor sweep) or
+    # "service" (pipelined service window, stack._collect_build_all_placed).
+    # Carried through the raft entry into the SweepSegment so operators
+    # can see which commit path a storm took (sched-stats `Store` block).
+    kind: str = "system"
 
     def slice(self, lo: int, hi: int) -> "SweepBatch":
         """Chunk view for _submit_chunked: shares the backing arrays."""
@@ -111,7 +116,8 @@ class SweepBatch:
             return SweepBatch(rows=self.rows[lo:hi],
                               node_ids=self.node_ids[lo:hi],
                               delta=self.delta[lo:hi],
-                              epoch=self.epoch, n_rows=self.n_rows)
+                              epoch=self.epoch, n_rows=self.n_rows,
+                              kind=self.kind)
         s, e = int(self.starts[lo]), int(self.starts[hi])
         return SweepBatch(rows=self.rows[lo:hi],
                           node_ids=self.node_ids[lo:hi],
@@ -122,7 +128,7 @@ class SweepBatch:
                           alloc_ids=self.alloc_ids[s:e],
                           alloc_names=self.alloc_names[s:e],
                           alloc_tg=self.alloc_tg[s:e],
-                          templates=self.templates)
+                          templates=self.templates, kind=self.kind)
 
     def wire(self) -> dict:
         """msgpack-safe encoding for the ApplySweepBatch raft entry (numpy
@@ -130,6 +136,7 @@ class SweepBatch:
         flattens them at the consensus boundary). Per-alloc node ids are
         NOT shipped: they re-expand from (node_ids, counts) at apply."""
         return {
+            "Kind": self.kind,
             "Templates": self.templates,
             "TGIdx": list(self.alloc_tg),
             "AllocIDs": list(self.alloc_ids),

@@ -215,6 +215,7 @@ class FSM:
             groups = [req]
         obj_allocs: List[Allocation] = []
         n_sweep = 0
+        n_service = 0
         # One store transaction for the WHOLE entry: a sweep group's
         # stops, its segment, and any object co-groups land in separate
         # write calls below, and a blocking query woken between them
@@ -269,7 +270,8 @@ class FSM:
                     tg_idx=list(sweep["TGIdx"]),
                     alloc_ids=list(sweep["AllocIDs"]),
                     names=list(sweep["Names"]),
-                    node_ids=node_per_alloc)
+                    node_ids=node_per_alloc,
+                    kind=sweep.get("Kind", "system"))
                 self.state.apply_sweep_segment(
                     index, seg,
                     rows=np.asarray(sweep["Rows"], dtype=np.int64),
@@ -277,11 +279,19 @@ class FSM:
                     row_node_ids=row_node_ids,
                     epoch=int(sweep.get("Epoch", -1)))
                 n_sweep += len(seg.alloc_ids)
+                if seg.kind == "service":
+                    n_service += len(seg.alloc_ids)
             if obj_allocs:
                 self.state.upsert_allocs(index, obj_allocs)
         if n_sweep:
             metrics.incr_counter(("nomad", "fsm", "sweep", "allocs"),
                                  n_sweep)
+        if n_service:
+            # Service-window rows committed columnar, vs the system-sweep
+            # rows the total above also counts — the per-path split the
+            # sched-stats `Store` block surfaces.
+            metrics.incr_counter(("nomad", "fsm", "sweep", "service_allocs"),
+                                 n_service)
         return None
 
     def _apply_alloc_client_update(self, index: int, req: Dict[str, Any]):

@@ -244,10 +244,16 @@ class PipelinedWorker(Worker):
     """Drop-in Worker with windowed device-chained placement."""
 
     def __init__(self, *args, window: int = 32, host_placement: bool = True,
-                 chain_arbiter: Optional[ChainArbiter] = None, **kwargs):
+                 chain_arbiter: Optional[ChainArbiter] = None,
+                 service_columnar: bool = True, **kwargs):
         super().__init__(*args, **kwargs)
         self.window = max(1, window)
         self.host_placement = host_placement
+        # Columnar service commits (ServerConfig.service_columnar): the
+        # all-placed window build attaches a SweepBatch descriptor so the
+        # plan commits as ONE ApplySweepBatch entry + SweepSegment scatter.
+        # False keeps the per-object commit path (bench A/B oracle side).
+        self.service_columnar = service_columnar
         self._noise: Optional[np.ndarray] = None
         # Observability: how evals flowed (fast = device-chained window,
         # slow = per-eval GenericScheduler, fallback = fast dispatch that
@@ -766,7 +772,8 @@ class PipelinedWorker(Worker):
         # jobs are value-frozen in the state store and the plan only reads.
         plan = ev.make_plan(job, copy_job=False)
         ctx = EvalContext(snap, plan, logger)
-        stack = GenericStack(ctx, self.tindex, batch)
+        stack = GenericStack(ctx, self.tindex, batch,
+                             columnar=self.service_columnar)
         dc_key = tuple(sorted(job.Datacenters))
         cached = node_cache.get(dc_key)
         if cached is None:

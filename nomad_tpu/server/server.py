@@ -113,6 +113,12 @@ class ServerConfig:
     # False forces every fast-path window onto the device chain; the
     # multichip dryrun uses that to prove the SPMD path compiles and runs.
     host_placement: bool = True
+    # Columnar service commits: all-placed pipelined windows ride the
+    # sweep-batch machinery end to end — one ApplySweepBatch raft entry +
+    # one SweepSegment store scatter per plan instead of per-object
+    # upserts (README "Columnar state store"). False keeps the per-object
+    # commit path (the bench `service_columnar` A/B's object side).
+    service_columnar: bool = True
     # Server-side coalescing of Node.UpdateAlloc: concurrent client RPCs
     # within this window share ONE raft entry / future (reference:
     # batchUpdateInterval + batchFuture, node_endpoint.go:530-593). At 10k
@@ -340,7 +346,9 @@ class Server:
                                     window=self.config.scheduler_window,
                                     host_placement=self.config
                                     .host_placement,
-                                    chain_arbiter=arbiter)
+                                    chain_arbiter=arbiter,
+                                    service_columnar=self.config
+                                    .service_columnar)
             else:
                 w = Worker(self.raft, self.eval_broker, self.plan_queue,
                            self.blocked_evals, self.tindex, schedulers)

@@ -116,12 +116,12 @@ class SweepSegment:
 
     __slots__ = ("index", "job_id", "eval_id", "templates", "tg_idx",
                  "alloc_ids", "names", "node_ids", "live", "n_live",
-                 "_objs")
+                 "kind", "_objs")
 
     def __init__(self, index: int, job_id: str, eval_id: str,
                  templates: List[Allocation], tg_idx: Optional[List[int]],
                  alloc_ids: List[str], names: List[str],
-                 node_ids: List[str]):
+                 node_ids: List[str], kind: str = "system"):
         self.index = index
         self.job_id = job_id
         self.eval_id = eval_id
@@ -130,6 +130,9 @@ class SweepSegment:
         self.alloc_ids = alloc_ids
         self.names = names
         self.node_ids = node_ids
+        # Which commit path built the batch ("system" sweep / "service"
+        # window) — operator observability only, no read-path semantics.
+        self.kind = kind
         self.live = [True] * len(alloc_ids)
         self.n_live = len(alloc_ids)
         self._objs: Dict[int, Allocation] = {}  # pos -> materialized
@@ -172,6 +175,7 @@ class SweepSegment:
             "Index": self.index,
             "JobID": self.job_id,
             "EvalID": self.eval_id,
+            "Kind": self.kind,
             "Templates": [to_dict(t) for t in self.templates],
             "TGIdx": ([self.tg_idx[i] for i in keep]
                       if self.tg_idx else None),
@@ -190,7 +194,8 @@ class SweepSegment:
             eval_id=data["EvalID"], templates=templates,
             tg_idx=(list(data["TGIdx"]) if data.get("TGIdx") else None),
             alloc_ids=list(data["AllocIDs"]), names=list(data["Names"]),
-            node_ids=list(data["NodeIDs"]))
+            node_ids=list(data["NodeIDs"]),
+            kind=data.get("Kind", "system"))
 
 
 class _ReadAPI:
@@ -310,7 +315,8 @@ class StateStore(_ReadAPI):
     # maintenance never rides the serialized FSM apply.
     _concurrency = guarded_by(
         "_lock", "_col_segments", "_col_by_job", "_col_by_eval",
-        "_col_alloc_index", "_col_node_index", "_col_unindexed")
+        "_col_alloc_index", "_col_node_index", "_col_unindexed",
+        "_col_batches", "_col_promoted")
 
     def __init__(self) -> None:
         self._lock = threading.RLock()
@@ -332,6 +338,11 @@ class StateStore(_ReadAPI):
         self._col_alloc_index: Dict[str, Tuple[SweepSegment, int]] = {}
         self._col_node_index: Dict[str, List[Tuple[SweepSegment, int]]] = {}
         self._col_unindexed: List[SweepSegment] = []
+        # Operator counters (sched-stats `Store` block): columnar batches
+        # committed per kind ("system" sweep / "service" window) and rows
+        # promoted onto the object chain by mutations, since boot.
+        self._col_batches: Dict[str, int] = {}
+        self._col_promoted = 0
         # Relaxed fast-path flag (deliberately OUTSIDE the guarded set):
         # set under the lock when the first segment commits, read lock-free
         # by the columnar hooks so non-sweep deployments never pay an extra
@@ -470,6 +481,19 @@ class StateStore(_ReadAPI):
                 idx = self.get_index("allocs")
             return out, idx
 
+    def columnar_stats(self) -> Dict[str, Any]:
+        """Operator snapshot of the columnar alloc tables (sched-stats
+        `Store` block): live segment/row counts, rows promoted onto the
+        object chain, and committed batches split by commit path — the
+        "which path did the storm take" answer."""
+        with self._lock:
+            return {
+                "Segments": len(self._col_segments),
+                "LiveRows": sum(s.n_live for s in self._col_segments),
+                "PromotedRows": self._col_promoted,
+                "Batches": dict(self._col_batches),
+            }
+
     def get_index(self, table: str) -> int:
         return self._table_index.get(table, 0)
 
@@ -513,6 +537,8 @@ class StateStore(_ReadAPI):
             self._col_unindexed.append(seg)
             self._col_by_job.setdefault(seg.job_id, []).append(seg)
             self._col_by_eval.setdefault(seg.eval_id, []).append(seg)
+            self._col_batches[seg.kind] = \
+                self._col_batches.get(seg.kind, 0) + 1
             self._has_col = True
             watch_items = Items([Item(alloc_job=seg.job_id),
                                  Item(alloc_eval=seg.eval_id)])
@@ -545,6 +571,9 @@ class StateStore(_ReadAPI):
         metrics.measure_since(("nomad", "state", "scatter"), t0)
         metrics.incr_counter(("nomad", "state", "sweep_allocs"),
                              len(seg.alloc_ids))
+        # Per-path segment counter; the trailing segment is dynamic
+        # ("system"/"service"), like the per-type fsm keys.
+        metrics.incr_counter(("nomad", "state", "segments", seg.kind))
 
     def _col_promote_locked(self, alloc_id: str) -> Optional[Allocation]:
         """Promote a columnar row into the exact per-object chain path.
@@ -564,6 +593,7 @@ class StateStore(_ReadAPI):
         obj = seg.materialize(pos)
         seg.live[pos] = False
         seg.n_live -= 1
+        self._col_promoted += 1
         self._tables["allocs"].write(seg.index, alloc_id, obj)
         self._member_add("alloc_node", obj.NodeID, alloc_id)
         self._member_add("alloc_job", obj.JobID, alloc_id)

@@ -346,6 +346,13 @@ def test_debug_sched_stats_exports_worker_schema(dev_agent):
     totals = out["Totals"]
     assert totals["windows"] == sum(
         w["Stats"]["windows"] for w in pipelined)
+    # Columnar-store block: segment/live-row/promotion counts plus the
+    # per-commit-path batch counters (service vs system), present even
+    # when zero so operators can rely on the shape.
+    store = out["Store"]
+    for key in ("Segments", "LiveRows", "PromotedRows", "Batches"):
+        assert key in store, f"Store key {key} missing from endpoint"
+    assert isinstance(store["Batches"], dict)
 
 
 def test_debug_profile_rejects_malformed_seconds(dev_agent):

@@ -319,6 +319,65 @@ class TestStoreCommitSchedule:
             srv.shutdown()
 
 
+class TestServiceStoreCommitSchedule:
+    """ISSUE 11 site: the SERVICE columnar commit rides the same
+    `state.store.commit` seam as the sweep path — a pipelined window's
+    plans group into one ApplySweepBatch entry once the window build
+    attaches service descriptors. A kill at the seam fires BEFORE the
+    entry is proposed to consensus: the waiting window's evals fall back
+    to the exact per-eval path, every eval still terminates, and no
+    batch is ever torn or double-committed."""
+
+    def test_service_bulk_commit_kill_redelivers_exactly_once(self):
+        # Fired counts are process-cumulative (the equivalence gate also
+        # exercises this site); assert the DELTA this schedule causes.
+        fired_before = failpoints.snapshot().get(
+            "state.store.commit", {}).get("fired", 0)
+        srv = Server(ServerConfig(num_schedulers=1, scheduler_window=8))
+        srv.establish_leadership()
+        try:
+            for _ in range(8):
+                srv.node_register(mock.node())
+            jobs = [make_job() for _ in range(6)]
+            eval_ids = []
+            with ChaosSchedule(name="svc-store-commit") \
+                    .arm(0.0, "state.store.commit=error:count=1") as sched:
+                sched.join(2.0)
+                for job in jobs:
+                    eval_ids.append(srv.job_register(job)[0])
+                assert wait_for(
+                    lambda: _all_terminal(srv.state, eval_ids),
+                    timeout=30, interval=0.05,
+                    msg="evals terminal after a service bulk-commit kill")
+            snap = failpoints.snapshot()
+            assert snap["state.store.commit"]["fired"] - fired_before == 1, \
+                "the bulk-commit seam never fired for a service window"
+            # Exactly-once: every job at exactly its asked-for live
+            # allocs (the killed entry committed NOTHING; the fallback
+            # re-runs placed fresh UUIDs once), no duplicates, no
+            # oversubscription.
+            assert_invariants(srv.state, jobs, per_job=PER_JOB,
+                              eval_ids=eval_ids)
+            # (No assertion on the segment count here: how many windows
+            # the storm split into — and therefore whether any committed
+            # columnar before/after the killed entry — is timing-
+            # dependent. The invariants above already prove the killed
+            # entry landed NOTHING.) Healed, the next storm must go
+            # columnar again.
+            heal_jobs = [make_job() for _ in range(2)]
+            heal_ids = [srv.job_register(job)[0] for job in heal_jobs]
+            assert wait_for(
+                lambda: _all_terminal(srv.state, heal_ids),
+                timeout=30, interval=0.05,
+                msg="post-heal service storm never completed")
+            assert srv.state.columnar_stats()["Batches"].get(
+                "service", 0) >= 1
+            assert_invariants(srv.state, jobs + heal_jobs, per_job=PER_JOB,
+                              eval_ids=eval_ids + heal_ids)
+        finally:
+            srv.shutdown()
+
+
 class TestBlockedWakeupSchedule:
     """ROADMAP candidate site: the blocked-evals capacity wakeup. A lost
     wakeup event (dropped at the seam) strands parked evals ONLY until

@@ -14,10 +14,16 @@ then the same verified result is committed twice — once as the columnar
 raft entry (through a real msgpack round-trip, the wire shape), once as
 the reference AllocUpdate object entry — into two fresh FSMs, and every
 read surface is compared as plain data.
+
+TestServiceColumnarEquivalence holds the SERVICE window path (the
+pipelined fast path's all-placed build, kind="service") to the same
+gate, including the mixed-window exclusions: failed placements, network
+asks, and vanished nodes must keep the exact per-object path.
 """
 
 import logging
 import random
+import types
 
 import msgpack
 import pytest
@@ -390,3 +396,252 @@ class TestColumnarEquivalence:
         whole = {a.ID for a in fsm_whole.state.allocs_by_job(job.ID)}
         split = {a.ID for a in fsm_parts.state.allocs_by_job(job.ID)}
         assert whole == split == set(sweep.alloc_ids)
+
+
+# --------------------------------------------------- service window path
+def svc_job(count=5, cpu=50, networks=False):
+    """Service job for the window harness: small asks, no networks by
+    default (the storm shape); networks=True keeps mock.job's dynamic
+    port ask so the window must take the exact per-object path."""
+    job = mock.job()
+    tg = job.TaskGroups[0]
+    tg.Count = count
+    t = tg.Tasks[0]
+    t.Resources.CPU = cpu
+    t.Resources.MemoryMB = 32
+    t.Resources.DiskMB = 10
+    if not networks:
+        t.Resources.Networks = []
+    t.Services = []
+    if t.LogConfig is not None:
+        t.LogConfig.MaxFiles = 1
+        t.LogConfig.MaxFileSizeMB = 1
+    job.init_fields()
+    return job
+
+
+def service_window(job, n_nodes=6, seed=7, vanish=False):
+    """One fixed-seed service eval through the pipelined fast path's
+    build — prepare_batch -> host placement kernel -> compact ->
+    collect_build — the exact recipe _try_dispatch_fast/_finish_fast run,
+    minus the stage threads. Returns a namespace with the plan (carrying
+    its service SweepBatch when the window stayed columnar), the build
+    verdict, and the store/tensor the window ran against."""
+    import numpy as np
+
+    from nomad_tpu.scheduler import kernels
+    from nomad_tpu.scheduler.context import EvalContext
+    from nomad_tpu.scheduler.stack import GenericStack, WindowAccumulator
+    from nomad_tpu.scheduler.util import (
+        diff_allocs,
+        materialize_task_groups,
+        ready_nodes_in_dcs,
+    )
+    from nomad_tpu.tensor import ClassEligibility
+
+    store = StateStore()
+    tindex = TensorIndex.attach(store)
+    idx = 0
+    for i in range(n_nodes):
+        idx += 1
+        store.upsert_node(idx, make_node(i))
+    idx += 1
+    store.upsert_job(idx, job)
+    ev = mock.eval()
+    ev.JobID = job.ID
+    ev.Type = job.Type
+    ev.TriggeredBy = EvalTriggerJobRegister
+    snap = store.snapshot()
+    plan = ev.make_plan(job, copy_job=False)
+    ctx = EvalContext(snap, plan, logger)
+    stack = GenericStack(ctx, tindex, batch=False, rng=random.Random(seed))
+    diff = diff_allocs(job, {}, materialize_task_groups(job), [])
+    nodes, by_dc = ready_nodes_in_dcs(snap, job.Datacenters)
+    nt = tindex.nt
+    nodes_by_id = {n.ID: n for n in nodes}
+    cand_mask = np.zeros(nt.n_rows, dtype=bool)
+    for n in nodes:
+        row = nt.row_of.get(n.ID)
+        if row is not None:
+            cand_mask[row] = True
+    stack.job = job
+    stack.adopt_nodes(nodes_by_id, cand_mask, ClassEligibility(nt, nodes))
+    ctx.metrics.NodesAvailable = by_dc
+    prep = stack.prepare_batch([t.TaskGroup for t in diff.place])
+    res = stack.dispatch_host(prep)
+    cr = kernels.compact_host(np.asarray(res.packed), prep.n_valid)
+    if vanish:
+        # A node vanishing between dispatch and build: the window-level
+        # lookup must fail and route the eval onto the exact path.
+        nodes_by_id.pop(nt.node_id_array()[cr.chosen[0]])
+    failed = {}
+    ok = stack.collect_build(prep, cr, ev.ID, job, diff.place, plan,
+                             failed, WindowAccumulator(nt.n_rows))
+    return types.SimpleNamespace(job=job, plan=plan, ok=ok, failed=failed,
+                                 store=store, tindex=tindex)
+
+
+class TestServiceColumnarEquivalence:
+    def test_service_commit_reads_identical(self):
+        """A service window committed columnar and per-object is
+        indistinguishable through every read surface, and the columnar
+        side stays fully lazy at commit."""
+        ns = service_window(svc_job())
+        assert ns.ok and not ns.failed
+        sweep = ns.plan._sweep
+        assert sweep is not None and sweep.kind == "service"
+        assert sweep.alloc_ids and sorted(sweep.node_ids) \
+            == sorted(ns.plan.NodeAllocation)
+        fsm_col = commit_columnar(ns.plan)
+        fsm_obj = commit_objects(ns.plan)
+        assert fsm_col.state._col_segments[0].kind == "service"
+        assert_same_state(fsm_col, fsm_obj, ns.job, ns.plan)
+        assert not fsm_col.state._tables["allocs"].current
+
+    def test_service_snapshot_restore_identical(self):
+        """snapshot->restore keeps service segments columnar (Kind
+        round-trips) and lands identical client-visible state."""
+        ns = service_window(svc_job())
+        fsm_col = commit_columnar(ns.plan)
+        fsm_obj = commit_objects(ns.plan)
+        snap = fsm_col.snapshot()
+        assert snap["columnar_allocs"] and not snap["allocs"]
+        r_col = roundtrip(fsm_col)
+        assert r_col.state._col_segments[0].kind == "service"
+        assert_same_state(r_col, roundtrip(fsm_obj), ns.job, ns.plan)
+
+    def test_service_client_update_promotes_row(self):
+        """A client status update on a service-window row promotes it
+        onto the object chain; both stores converge and the promotion
+        shows in the operator counters."""
+        ns = service_window(svc_job())
+        fsm_col = commit_columnar(ns.plan)
+        fsm_obj = commit_objects(ns.plan)
+        target = ns.plan._sweep.alloc_ids[2]
+        for fsm in (fsm_col, fsm_obj):
+            running = fsm.state.alloc_by_id(target).copy()
+            running.ClientStatus = AllocClientStatusRunning
+            running.ClientDescription = "started"
+            fsm.apply(APPLY_INDEX + 1, MessageType.AllocClientUpdate,
+                      {"Alloc": [running]})
+        assert_same_state(fsm_col, fsm_obj, ns.job, ns.plan)
+        got = fsm_col.state.alloc_by_id(target)
+        assert got.ClientStatus == AllocClientStatusRunning
+        assert got.CreateIndex == APPLY_INDEX
+        stats = fsm_col.state.columnar_stats()
+        assert stats["PromotedRows"] == 1
+        assert stats["Batches"] == {"service": 1}
+
+    def test_service_descriptor_bulk_verifies(self):
+        """The applier's vectorized verify admits a full-coverage service
+        descriptor wholesale and attaches it to the result — the
+        precondition for the columnar raft encode."""
+        from nomad_tpu.server.plan_apply import (
+            OptimisticSnapshot,
+            evaluate_plan,
+        )
+
+        ns = service_window(svc_job())
+        opt = OptimisticSnapshot(ns.store.snapshot(), nt=ns.tindex.nt)
+        result = evaluate_plan(opt, ns.plan, None, nt=ns.tindex.nt)
+        assert getattr(result, "_sweep", None) is ns.plan._sweep
+        full, _, _ = result.full_commit(ns.plan)
+        assert full
+
+    def test_service_multi_alloc_rows_fold(self):
+        """Count > nodes: several instances land on one node row, so the
+        descriptor folds them — counts/starts must partition the
+        row-sorted alloc columns exactly, and the commit must still read
+        identical to the object path."""
+        ns = service_window(svc_job(count=5), n_nodes=2)
+        assert ns.ok and not ns.failed
+        sweep = ns.plan._sweep
+        assert sweep is not None and len(sweep.rows) <= 2
+        assert int(sweep.counts.sum()) == 5
+        assert sweep.starts[-1] == len(sweep.alloc_ids) == 5
+        # Each row's alloc slice really sits on that row's node.
+        by_node = {nid: {a.ID for a in v}
+                   for nid, v in ns.plan.NodeAllocation.items()}
+        for k, nid in enumerate(sweep.node_ids):
+            s, e = int(sweep.starts[k]), int(sweep.starts[k + 1])
+            assert set(sweep.alloc_ids[s:e]) == by_node[nid]
+        assert_same_state(commit_columnar(ns.plan),
+                          commit_objects(ns.plan), ns.job, ns.plan)
+
+    def test_service_mixed_window_stays_object(self):
+        """Failed placements route the whole eval through the exact
+        per-object build: no descriptor, the placed rows commit as plain
+        objects, and the failures coalesce into FailedTGAllocs."""
+        ns = service_window(svc_job(count=4, cpu=2000), n_nodes=2)
+        assert ns.ok and ns.failed  # built exact, with coalesced failures
+        assert getattr(ns.plan, "_sweep", None) is None
+        placed = sum(len(v) for v in ns.plan.NodeAllocation.values())
+        assert placed == 2  # one 2000-CPU alloc fits per 3900-free node
+        element, is_sweep = _encode_result(
+            ns.plan, PlanResult(NodeAllocation=dict(ns.plan.NodeAllocation)))
+        assert not is_sweep and "Alloc" in element
+        fsm = commit_objects(ns.plan)
+        assert len(fsm.state.allocs_by_job(ns.job.ID)) == placed
+
+    def test_service_network_asks_stay_object(self):
+        """Port asks keep the exact per-object path (offers are
+        sequential host state): no descriptor even when fully placed."""
+        ns = service_window(svc_job(count=3, networks=True))
+        assert ns.ok and not ns.failed
+        assert getattr(ns.plan, "_sweep", None) is None
+        placed = [a for v in ns.plan.NodeAllocation.values() for a in v]
+        assert len(placed) == 3
+        # The exact build really assigned ports.
+        assert any(r.Networks for a in placed
+                   for r in a.TaskResources.values())
+
+    def test_service_vanished_node_falls_back(self):
+        """A winner row whose node vanished mid-window fails the build —
+        the caller re-runs the eval on the exact path — and never leaves
+        a descriptor on the abandoned plan."""
+        ns = service_window(svc_job(), vanish=True)
+        assert ns.ok is False
+        assert getattr(ns.plan, "_sweep", None) is None
+
+    def test_served_service_storm_commits_columnar(self):
+        """End to end through a live server: a service storm commits as
+        service-kind segments (no chain objects), every read surface and
+        the client pull map serve the placements, and the sched-stats
+        Store counters record the path taken."""
+        import time
+
+        from nomad_tpu.server import Server, ServerConfig
+        from nomad_tpu.structs.structs import EvalStatusComplete
+
+        srv = Server(ServerConfig(num_schedulers=1, scheduler_window=8,
+                                  min_heartbeat_ttl=3600.0,
+                                  heartbeat_grace=3600.0))
+        srv.establish_leadership()
+        try:
+            for _ in range(6):
+                srv.node_register(mock.node())
+            eval_ids = [srv.job_register(svc_job())[0] for _ in range(4)]
+            deadline = time.monotonic() + 30
+            while time.monotonic() < deadline:
+                if all((e := srv.state.eval_by_id(eid)) is not None
+                       and e.Status == EvalStatusComplete
+                       for eid in eval_ids):
+                    break
+                time.sleep(0.02)
+            else:
+                raise AssertionError("service storm never completed")
+            state = srv.state
+            stats = state.columnar_stats()
+            assert stats["Batches"].get("service", 0) >= 1
+            assert not stats["Batches"].get("system")
+            placed = [a for eid in eval_ids
+                      for a in state.allocs_by_eval(eid)]
+            assert len(placed) == 4 * 5
+            assert len({a.ID for a in placed}) == len(placed)
+            # The pull signal answers from the columns.
+            pulled = {}
+            for node in state.nodes():
+                pulled.update(state.client_alloc_map(node.ID)[0])
+            assert set(pulled) == {a.ID for a in placed}
+        finally:
+            srv.shutdown()

@@ -103,6 +103,20 @@ RUN_SVC_AB = os.environ.get("BENCH_SVC_AB", "1") != "0"
 # beat the per-object path by at least this factor (parity-style exit 2).
 # Measured ~8-15x on a quiet box; 3x leaves noise headroom.
 STORE_SVC_GATE = float(os.environ.get("BENCH_STORE_GATE", 3.0))
+# config6_mesh_1m (bench_mesh_1m): the ISSUE-12 headline shape — 1M nodes
+# x one wide storm window — as a keyed-kernel 1dev-vs-8dev-mesh A/B with
+# per-window latency percentiles. The 8 virtual devices need XLA's
+# device-count flag set BEFORE jax initializes, so the measurement runs
+# in a clean subprocess (`bench.py --_mesh-child`). Slow-gated: --smoke
+# turns it off (a 1M-node compile alone blows the 60s budget; tier-1
+# covers the mesh path via tests/test_mesh_keyed_equivalence.py and the
+# collective audit, and the multichip dry run reports the full sweep).
+MESH_NODES = int(os.environ.get("BENCH_MESH_NODES", 1_048_576))
+MESH_P = int(os.environ.get("BENCH_MESH_P", 1024))
+MESH_VALID = int(os.environ.get("BENCH_MESH_VALID", 800))
+MESH_WINDOWS = int(os.environ.get("BENCH_MESH_WINDOWS", 6))
+MESH_REPS = int(os.environ.get("BENCH_MESH_REPS", 3))
+RUN_MESH = os.environ.get("BENCH_MESH", "1") != "0"
 
 
 def _apply_smoke():
@@ -115,7 +129,7 @@ def _apply_smoke():
     global RUN_C2, RUN_C4, RUN_C5, PARITY_NODES, PARITY_EVALS
     global SCALING_NODES, SCALING_EVALS, C4_EVALS
     global SLO_NODES, SLO_LOW, SLO_HIGH, SLO_REPS
-    global SVC_AB_NODES, SVC_AB_EVALS, SVC_AB_REPS
+    global SVC_AB_NODES, SVC_AB_EVALS, SVC_AB_REPS, RUN_MESH
     N_NODES = min(N_NODES, 512)
     N_PLACEMENTS = min(N_PLACEMENTS, 2000)   # 40 evals @ PER_EVAL=50
     N_REPS = min(N_REPS, 3)
@@ -148,6 +162,10 @@ def _apply_smoke():
     SVC_AB_NODES = min(SVC_AB_NODES, 256)
     SVC_AB_EVALS = min(SVC_AB_EVALS, 20)
     SVC_AB_REPS = min(SVC_AB_REPS, 2)
+    # The 1M mesh A/B is slow-gated OUT of smoke (its subprocess compile
+    # alone blows the budget); the mesh path's correctness coverage is
+    # tier-1 (equivalence gate + collective audit + chaos schedule).
+    RUN_MESH = False
 
 
 def _freeze_heap():
@@ -1258,6 +1276,138 @@ def bench_placement_parity(n_evals=None, n_nodes=None):
             "ok": bool(ok)}
 
 
+def _mesh_child():
+    """Child half of bench_mesh_1m: runs under the 8-virtual-device XLA
+    flag, measures the keyed kernel 1dev-vs-mesh at MESH_NODES x one
+    MESH_P-wide storm window, prints ONE json line on stdout."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    from nomad_tpu.parallel import pow2_prefix, scheduling_mesh
+    from nomad_tpu.scheduler import kernels
+
+    n, p, nv = MESH_NODES, MESH_P, min(MESH_VALID, MESH_P)
+    w, reps, t = MESH_WINDOWS, MESH_REPS, 1
+    devices = pow2_prefix(jax.devices())
+    n_dev = len(devices)
+
+    def setup(devs):
+        rng = np.random.default_rng(1)
+        mesh = scheduling_mesh(devs)
+        axis = mesh.axis_names[0]
+        node_sh = NamedSharding(mesh, PartitionSpec(axis))
+        mask_sh = NamedSharding(mesh, PartitionSpec(None, axis))
+        d = {k: jax.device_put(v, node_sh) for k, v in {
+            "capacity": rng.uniform(1000, 4000, (n, 5)).astype(np.float32),
+            "score_cap": rng.uniform(800, 3800, (n, 2)).astype(np.float32),
+            "usage": rng.uniform(0, 200, (n, 5)).astype(np.float32),
+            "job_counts": np.zeros(n, np.int32),
+            "noise": (rng.random(n) * 1e-3).astype(np.float32),
+            "banned0": np.zeros(n, bool),
+        }.items()}
+        tg_masks = jax.device_put(rng.random((t, n)) < 0.9, mask_sh)
+        kd = rng.uniform(5, 40, (t, 5)).astype(np.float32)
+        tg_ids = rng.integers(0, t, p).astype(np.int32)
+        valid = np.zeros(p, bool)
+        valid[:nv] = True
+        reset = np.zeros(p, bool)
+        reset[::64] = True
+        penalty = np.float32(10.0)
+        distinct = np.asarray(False)
+        jax.block_until_ready(list(d.values()))
+
+        def fn(u):
+            return kernels.place_batch_keyed(
+                mesh if len(devs) > 1 else None, d["capacity"],
+                d["score_cap"], u, tg_masks, d["job_counts"], kd, tg_ids,
+                valid, d["noise"], penalty, distinct, d["banned0"], reset,
+                nv)
+
+        res = fn(d["usage"])  # compile + warm (one cold + warm program)
+        res = fn(res.usage_after)
+        jax.block_until_ready(res.packed)
+        return fn, d["usage"]
+
+    def rate_rep(fn, u0):
+        t0 = time.perf_counter()
+        u, res = u0, None
+        for _ in range(w):
+            res = fn(u)
+            u = res.usage_after
+        jax.block_until_ready(res.packed)
+        return w / (time.perf_counter() - t0)
+
+    def lat_rep(fn, u0):
+        # Per-window latency: each window blocks to the host, the way a
+        # lone interactive eval pays it. The chain restarts at u0 first,
+        # so index 0 is the COLD window (rebuild + exchange) and the
+        # rest are warm — the percentiles honestly mix both, like a
+        # served storm does across rebases.
+        lats, u = [], u0
+        for _ in range(w):
+            t0 = time.perf_counter()
+            res = fn(u)
+            jax.block_until_ready(res.packed)
+            lats.append(time.perf_counter() - t0)
+            u = res.usage_after
+        return lats
+
+    sides = {"one_dev": setup(devices[:1]), "mesh": setup(devices)}
+    kernels.mesh_stats_drain()
+    rates = {k: [] for k in sides}
+    lats = {k: [] for k in sides}
+    # Interleaved A/B, alternating within-pair order, max-of-reps (the
+    # cgroup-throttle methodology: a throttled rep loses a sample, never
+    # skews the ratio). Latency reps ride the same alternation.
+    for i in range(reps):
+        order = list(sides) if i % 2 == 0 else list(reversed(sides))
+        for side in order:
+            fn, u0 = sides[side]
+            rates[side].append(rate_rep(fn, u0))
+            lats[side].extend(lat_rep(fn, u0))
+    ms = kernels.mesh_stats_drain()
+    out = {
+        "nodes": n, "window_p": p, "valid_per_window": nv,
+        "windows_per_rep": w, "reps": reps, "devices": n_dev,
+        "one_dev": {"windows_sec": round(max(rates["one_dev"]), 2),
+                    "rep_rates": [round(r, 2) for r in rates["one_dev"]],
+                    "window_latency_ms": _pctiles_ms(lats["one_dev"])},
+        "mesh": {"windows_sec": round(max(rates["mesh"]), 2),
+                 "rep_rates": [round(r, 2) for r in rates["mesh"]],
+                 "window_latency_ms": _pctiles_ms(lats["mesh"]),
+                 "mesh_windows": ms["windows"],
+                 "warm_windows": ms["warm_windows"],
+                 "exchange_bytes": ms["candidate_bytes"]},
+    }
+    out["ratio"] = round(out["mesh"]["windows_sec"]
+                         / out["one_dev"]["windows_sec"], 2)
+    print(json.dumps(out))
+
+
+def bench_mesh_1m():
+    """config6_mesh_1m: the trajectory's millions-of-users shape — 1M
+    nodes x a wide storm window — measured as a keyed-kernel A/B on the
+    8-virtual-CPU-device mesh in a clean subprocess (the device-count
+    flag must precede jax init). The served mesh path itself is
+    equivalence- and chaos-gated in tier-1; this records the RATE and
+    per-window latency tails at the headline scale in every BENCH JSON."""
+    import subprocess
+
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["NOMAD_TPU_FORCE_CPU"] = "1"
+    xf = env.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in xf:
+        env["XLA_FLAGS"] = \
+            (xf + " --xla_force_host_platform_device_count=8").strip()
+    proc = subprocess.run(
+        [sys.executable, os.path.abspath(__file__), "--_mesh-child"],
+        env=env, capture_output=True, text=True, timeout=900)
+    if proc.returncode != 0:
+        return {"error": proc.stderr[-800:]}
+    return json.loads(proc.stdout.strip().splitlines()[-1])
+
+
 def main(argv=None):
     import argparse
 
@@ -1266,7 +1416,12 @@ def main(argv=None):
     ap.add_argument("--smoke", action="store_true",
                     help="tiny CPU-safe shapes (<60s) with the parity "
                          "gate; for in-tree perf-path regression checks")
+    ap.add_argument("--_mesh-child", action="store_true",
+                    dest="mesh_child", help=argparse.SUPPRESS)
     args = ap.parse_args(argv)
+    if args.mesh_child:
+        _mesh_child()
+        return
     if args.smoke:
         _apply_smoke()
     nodes = build_nodes(N_NODES)
@@ -1374,6 +1529,12 @@ def main(argv=None):
     svc_ab = None
     if RUN_SVC_AB:
         detail["service_columnar"] = (svc_ab := bench_service_columnar_ab())
+
+    # The millions-of-users shape: 1M nodes x a wide storm window,
+    # keyed kernel 1dev-vs-mesh with latency percentiles (subprocess;
+    # slow-gated out of --smoke).
+    if RUN_MESH:
+        detail["config6_mesh_1m"] = bench_mesh_1m()
 
     # Horizontal worker scaling: always recorded (smoke shapes), so every
     # BENCH file carries the 1-vs-2 ratio next to the single-worker rate.

@@ -2,10 +2,20 @@
 
 Design: one logical axis 'nodes' over all chips of a region. The node-table
 arrays shard along their first (node) axis; per-placement inputs (demands,
-tg ids) and scalars replicate. Under jit, XLA's SPMD partitioner inserts the
-ICI collectives for the global argmax/sum reductions in place_batch — no
-hand-written collectives needed (the scaling-book recipe: pick a mesh,
-annotate shardings, let XLA insert collectives).
+tg ids) and scalars replicate.
+
+Two regimes use the mesh differently. The naive scan path
+(place_batch_sharded, kept as the oracle/fallback) follows the
+scaling-book recipe — annotate shardings, let XLA's SPMD partitioner
+insert the ICI collectives for its global argmax/sum reductions — which
+is correct but pays collectives per PLACEMENT. The served keyed path
+does NOT hand the partitioner that choice: kernels.py's 'shard-local
+mesh pipeline' (`_place_batch_keyed_mesh`) runs an explicit `shard_map`
+cold stage over these same shardings with ZERO collectives in any
+compiled program, exchanges only O(devices x T x k) winner-candidate
+rows point-to-point, and keeps warm storm windows resident on the lead
+device (`mesh_collective_audit` gates the claim in tier-1 and the
+multi-chip dry run).
 """
 
 from __future__ import annotations

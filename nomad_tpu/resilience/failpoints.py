@@ -84,6 +84,13 @@ KNOWN_SITES: Dict[str, str] = {
     "rpc.server.handle": "rpc: server-side endpoint dispatch",
     "services.sync": "client: service-registry sync push to the servers "
                      "(drop=lost batch; retried next flush)",
+    "tensor.mesh.exchange": "scheduler: sharded mesh winner-row exchange "
+                            "(the per-shard candidate packets' hop to the "
+                            "lead device in a cold keyed window; kill it "
+                            "mid-storm and the worker must nack the "
+                            "window, the ChainArbiter rebase the chain, "
+                            "and the broker redeliver every eval exactly "
+                            "once with no duplicate allocs)",
     "worker.dequeue": "server: scheduling worker eval dequeue",
     "worker.window.drain": "server: pipelined worker's window drain fetch "
                            "(kill a worker's window mid-flight; the broker "

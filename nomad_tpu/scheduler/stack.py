@@ -476,8 +476,9 @@ class GenericStack:
         bound only trips on degenerate many-key mega-windows), else the
         monolithic scan. Keyed is bit-identical and does one score pass
         per unique task group instead of one per placement; on a sharded
-        mesh it costs 2 collectives per WINDOW instead of 2 per placement
-        (kernels.py: 'keyed candidates')."""
+        mesh it runs the shard-local pipeline — ZERO collectives per
+        window, only winner-candidate rows cross devices (kernels.py:
+        'shard-local mesh pipeline') — vs the scan's 2 per placement."""
         nt = self.tindex.nt
         n_dev = nt.mesh.devices.size if nt.mesh is not None else 1
         n_keys = prep.tg_masks.shape[0]
@@ -495,6 +496,10 @@ class GenericStack:
             return kernels.place_batch_keyed(
                 mesh, d["capacity"], d["score_cap"], usage, *dev,
                 n_valid=n_valid)
+        if isinstance(usage, kernels.MeshChain):
+            # Degenerate mega-window routed to the monolithic scan: fold
+            # the chain's pending ring into the sharded usage first.
+            usage = usage.materialize()
         return kernels.place_batch(d["capacity"], d["score_cap"], usage,
                                    *dev)
 
@@ -546,6 +551,13 @@ class GenericStack:
         node_sh, _, _ = _mesh_shardings(nt)
         usage = usage_override if usage_override is not None else d["usage"]
         usage = _chain_to_device(usage, node_sh)
+        if isinstance(usage, kernels.MeshChain) and (
+                len(prep.evict_rows)
+                or (placed_usage is not None and placed_usage.any())):
+            # Eviction/overlay math needs a real array; fold the chain's
+            # pending winner ring back into the sharded usage first (one
+            # scatter dispatch, stays on the mesh).
+            usage = usage.materialize()
         if len(prep.evict_rows):
             usage = usage.at[prep.evict_rows].add(-prep.evict_vecs)
         if placed_usage is not None and placed_usage.any():
@@ -629,6 +641,8 @@ class GenericStack:
         if kind == "keyed":
             res = self._launch_device(d, usage, kind, dev, n_valid)
         else:
+            if isinstance(usage, kernels.MeshChain):
+                usage = usage.materialize()
             res = kernels.place_batch_multi(d["capacity"], d["score_cap"],
                                             usage, *dev)
         return res, e_pad

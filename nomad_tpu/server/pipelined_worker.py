@@ -85,7 +85,7 @@ from nomad_tpu.scheduler.util import (
     tainted_nodes,
 )
 from nomad_tpu.structs import AllocMetric, Evaluation, Plan
-from nomad_tpu.telemetry import trace
+from nomad_tpu.telemetry import metrics, trace
 from nomad_tpu.tensor.node_table import ChainArbiter
 from nomad_tpu.structs.structs import (
     EvalStatusBlocked,
@@ -118,6 +118,12 @@ STATS_COUNTERS = (
     "windows",    # dispatched windows
     "rebases",    # chain rebases onto committed usage
     "qos_cut",    # windows cut short by a tier's deadline budget (QoS)
+    "mesh_windows",    # keyed windows run on the sharded mesh pipeline
+    "mesh_warm",       # of those, warm (pool-resident, zero-exchange)
+    "mesh_bytes",      # winner-candidate bytes crossing the interconnect
+    "mesh_shards",     # device count of the serving mesh (gauge)
+    "mesh_cert_miss",  # warm windows whose exactness certificate failed
+    #                    (window nacked + chain tainted -> cold redispatch)
 )
 STATS_TIMERS_MS = (
     "t_lease_ms",        # waiting for the shared chain-lease (ChainArbiter)
@@ -135,6 +141,7 @@ STATS_TIMERS_MS = (
     "t_planwait_ms",     # waiting on the plan applier
     "t_evalupd_ms",      # consensus EvalUpdate batch
     "t_slow_ms",         # slow-path evals of the window
+    "t_mesh_exchange_ms",  # mesh pipeline: cold rebuild + winner exchange
 )
 
 
@@ -210,6 +217,8 @@ class _WindowWork:
     taint_seq: int = 0          # arbiter taint seq observed at chain read
     published: bool = False     # tail published: arbiter counts us in flight
     chain_seq: int = 0          # chain position (arbiter finish barrier)
+    mesh_flags: Optional[list] = None  # warm-window exactness certificates
+    #                            (device scalars; drain fetches + enforces)
 
 
 def _prep_sig(job, place, batch: bool) -> Optional[tuple]:
@@ -638,6 +647,10 @@ class PipelinedWorker(Worker):
         # re-verifies all of them against committed state).
         tl0 = time.perf_counter()
         i = 0
+        # Warm mesh windows carry an exactness-certificate flag (device
+        # scalar) per dispatch; the drain stage fetches and enforces them
+        # (a failed certificate nacks the window like a failed drain).
+        mesh_flags: list = []
         pend = [r for r in fast if r.res is None]
         group_ids: Dict[int, int] = {}
         pend.sort(key=lambda r: group_ids.setdefault(
@@ -666,6 +679,9 @@ class PipelinedWorker(Worker):
                     rec.res = rec.stack.dispatch(
                         rec.prep, usage_override=usage_chain, tables=tables)
                     usage_chain = rec.res.usage_after
+                fl = getattr(usage_chain, "flag", None)
+                if fl is not None:
+                    mesh_flags.append(fl)
             except Exception:
                 logger.exception("window launch failed; routing %d evals "
                                  "to the exact path", len(run))
@@ -697,7 +713,8 @@ class PipelinedWorker(Worker):
         self.stats["windows"] += 1
         self.stats["slow"] += len(slow)
         work = _WindowWork(fast=fast, slow=slow, published=bool(fast),
-                           chain_seq=lease.seq)
+                           chain_seq=lease.seq,
+                           mesh_flags=mesh_flags or None)
         # Build the drain plan NOW: the compaction kernels dispatch async
         # behind the window's placement kernels and their (much smaller)
         # outputs start copying to the host immediately, so the drain
@@ -716,6 +733,25 @@ class PipelinedWorker(Worker):
             if not (self._stop.is_set() or not self.eval_broker.enabled()):
                 logger.exception("pipelined worker: drain plan failed")
         self.stats["t_dispatch_ms"] += (time.perf_counter() - t0) * 1e3
+        # Mesh pipeline roll-up: module counters drain into the declared
+        # schema here (workers sharing a mesh may attribute a window to
+        # whichever worker drains first; totals are preserved).
+        ms = kernels.mesh_stats_drain()
+        if ms["windows"]:
+            self.stats["mesh_windows"] += ms["windows"]
+            self.stats["mesh_warm"] += ms["warm_windows"]
+            self.stats["mesh_bytes"] += ms["candidate_bytes"]
+            self.stats["t_mesh_exchange_ms"] += ms["exchange_ms"]
+            self.stats["mesh_shards"] = (
+                int(nt.mesh.devices.size) if nt.mesh is not None else 1)
+            metrics.incr_counter(("nomad", "mesh", "windows"),
+                                 ms["windows"])
+            metrics.incr_counter(("nomad", "mesh", "warm"),
+                                 ms["warm_windows"])
+            metrics.incr_counter(("nomad", "mesh", "candidate_bytes"),
+                                 ms["candidate_bytes"])
+            metrics.add_sample(("nomad", "mesh", "exchange_ms"),
+                               ms["exchange_ms"])
         # Taint bookkeeping: a window dispatched on a previous window's
         # tail inherits any phantom usage that tail turns out to carry;
         # record the taint sequence the lease saw so _finish_fast can
@@ -1159,13 +1195,31 @@ class PipelinedWorker(Worker):
         plan = work.drain
         out: list = [None] * len(plan.layout)
         fetched = {}
-        if plan.fetches:
+        flags = work.mesh_flags or []
+        if plan.fetches or flags:
             import jax
 
             t0 = time.perf_counter()
-            fetched = jax.device_get(plan.fetches)
+            # The warm-mesh exactness certificates (tiny device scalars)
+            # ride the SAME blocking call as the compaction outputs, so
+            # the one-host-sync invariant above survives the mesh path.
+            flags_h, fetched = jax.device_get((flags, plan.fetches))
             self.stats["t_drain_fetch_ms"] += \
                 (time.perf_counter() - t0) * 1e3
+            if any(float(f) > 0 for f in flags_h):
+                # Warm mesh windows are exact only when the certificate
+                # held (kernels.py 'shard-local mesh pipeline'): a failed
+                # certificate means a winner may have come from outside
+                # the resident pool, so the window's placements are
+                # suspect. Fail the drain — the build stage's failure
+                # handler nacks every eval and taints the chain, and the
+                # broker's exactly-once redelivery re-runs them on a
+                # COLD (unconditionally exact) window after the rebase.
+                self.stats["mesh_cert_miss"] += 1
+                metrics.incr_counter(("nomad", "mesh", "cert_miss"))
+                raise RuntimeError(
+                    "mesh warm-window exactness certificate failed; "
+                    "nacking window for cold redispatch")
         for i, ent in enumerate(plan.layout):
             if ent[0] == "host":
                 out[i] = ent[1]

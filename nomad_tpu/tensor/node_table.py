@@ -499,7 +499,17 @@ class ChainArbiter:
     GIL-bound Python, so two workers' dispatches could not run
     concurrently anyway — the win is that their drain fetches (GIL
     released) and build stages interleave on a chain that stays
-    coherent."""
+    coherent.
+
+    On a sharded mesh the tail is a :class:`kernels.MeshChain` — the
+    node-sharded usage PLUS a lead-device pending winner ring — not a
+    plain array. The arbiter treats it opaquely: ``shape`` drives the
+    resize/epoch rebase checks, publish/acquire hand it through, and a
+    rebase simply drops it (committed state lives in the node tensor;
+    the ring's placements either committed through plans or are being
+    redelivered). Consumers that need real rows (eviction overlays,
+    the monolithic-scan fallback, numpy readers) call
+    ``materialize()``, which folds the ring into the sharded usage."""
 
     _concurrency = guarded_by(
         "_cond", "_tail", "_tail_epoch", "_holder", "_pending",

@@ -193,6 +193,64 @@ class TestWindowDrainSchedule:
             srv.shutdown()
 
 
+class TestMeshExchangeSchedule:
+    """ISSUE 12 site: the sharded mesh winner-row exchange. A window
+    whose candidate exchange is silently lost (`drop` poisons the
+    chain's exactness certificate — the observable a real ICI loss
+    would produce) must fail at the drain-stage certificate check, nack
+    the WHOLE window, taint + rebase the chain through the ChainArbiter,
+    and redeliver every eval exactly once — no lost evals, no duplicate
+    allocs."""
+
+    def test_exchange_kill_rebases_and_redelivers_exactly_once(self):
+        import jax
+
+        if len(jax.devices()) < 8:
+            pytest.skip("needs 8 (virtual) devices")
+        # Mesh serving: node axis sharded over all devices, device
+        # kernels forced (the host fast path would absorb these shallow
+        # windows and never cross the exchange seam).
+        srv = Server(ServerConfig(num_schedulers=1, scheduler_window=8,
+                                  pipelined_scheduling=True,
+                                  scheduler_mesh="all",
+                                  host_placement=False))
+        srv.establish_leadership()
+        try:
+            for _ in range(8):
+                srv.node_register(mock.node())
+            jobs = [make_job() for _ in range(6)]
+            eval_ids = []
+            with ChaosSchedule(name="mesh-exchange") \
+                    .arm(0.0, "tensor.mesh.exchange=drop:count=1") as sched:
+                sched.join(2.0)
+                for job in jobs:
+                    eval_ids.append(srv.job_register(job)[0])
+                assert wait_for(
+                    lambda: _all_terminal(srv.state, eval_ids),
+                    timeout=60, interval=0.05,
+                    msg="evals terminal after a mesh-exchange kill")
+            snap = failpoints.snapshot()
+            assert snap["tensor.mesh.exchange"]["fired"] == 1, \
+                "the exchange seam never fired — mesh path not taken?"
+            stats = srv.workers[0].stats
+            # The poisoned window really was a sharded-mesh window, and
+            # its certificate failure is what killed it.
+            assert stats["mesh_windows"] >= 1, stats
+            assert stats["mesh_cert_miss"] >= 1, stats
+            # Exactly-once redelivery: every eval terminal, every job at
+            # exactly its asked-for live allocs (a double delivery would
+            # overshoot, a lost window would undershoot), no duplicate
+            # alloc IDs, no node oversubscribed.
+            assert_invariants(srv.state, jobs, per_job=PER_JOB,
+                              eval_ids=eval_ids)
+            # The killed window's chain was tainted; the redelivered
+            # window rebased through the ChainArbiter onto committed
+            # state instead of inheriting the poisoned tail.
+            assert stats["rebases"] >= 1, stats
+        finally:
+            srv.shutdown()
+
+
 class TestSystemEmitSchedule:
     """ISSUE 6 site: the system sweep's bulk placement emit
     (`sched.system.emit`, scheduler/system_sweep.py). A sweep killed at

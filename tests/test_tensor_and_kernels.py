@@ -393,50 +393,64 @@ class TestKeyedKernel:
             d["capacity"], d["score_cap"], d["usage"], tg_masks,
             d["job_counts"], demands, tg_ids, valid, d["noise"],
             np.float32(10.0), np.asarray(False), d["banned0"])
+        one = kernels.place_batch_keyed(
+            None, d["capacity"], d["score_cap"], d["usage"], tg_masks,
+            d["job_counts"], kd, tg_ids, valid, d["noise"],
+            np.float32(10.0), np.asarray(False), d["banned0"], reset, p)
         mesh = scheduling_mesh(jax.devices()[:8])
         res = kernels.place_batch_keyed(
             mesh, d["capacity"], d["score_cap"], d["usage"], tg_masks,
             d["job_counts"], kd, tg_ids, valid, d["noise"],
             np.float32(10.0), np.asarray(False), d["banned0"], reset, p)
-        np.testing.assert_array_equal(np.asarray(ref.packed),
-                                      np.asarray(res.packed))
+        rp = np.asarray(ref.packed)
+        mp = np.asarray(res.packed)
+        # The regression under test is candidate SELECTION: a dropped row
+        # would flip a chosen index or an n_feasible count. Those (and
+        # the chained usage) must match the monolithic scan exactly.
+        np.testing.assert_array_equal(rp[:, 0], mp[:, 0])
+        np.testing.assert_array_equal(rp[:, 2], mp[:, 2])
         np.testing.assert_array_equal(np.asarray(ref.usage_after),
                                       np.asarray(res.usage_after))
+        # Scores: <= 2 ulp vs the scan on XLA:CPU. Environmental, not a
+        # selection bug — the replay and the scan are two differently
+        # fused compilations of the same f32 ops (`- counts * penalty
+        # + noise` may or may not FMA-contract per fusion shape), and
+        # this shape's data lands on a boundary (observed: one score of
+        # 64 off by ~1e-6, chosen rows and usage bit-identical; the
+        # same codegen class as the historical keyed-vs-scan seed
+        # failures). On TPU both programs round identically.
+        np.testing.assert_array_almost_equal_nulp(
+            np.where(np.isfinite(rp[:, 1]), rp[:, 1], 0.0),
+            np.where(np.isfinite(mp[:, 1]), mp[:, 1], 0.0), nulp=2)
+        # The ISSUE-12 parity bar is exact: the sharded pipeline must
+        # match the SINGLE-DEVICE keyed kernel bit-for-bit.
+        np.testing.assert_array_equal(np.asarray(one.packed), mp)
 
     def test_sharded_collective_count_is_per_window(self):
-        """The point of the keyed kernel: a sharded window compiles to
-        O(1) collectives (one all-gather + one psum family), not O(P)
-        like the naive SPMD scan whose per-placement argmax/sum lower to
-        collectives inside the scan body."""
+        """The point of the shard-local mesh pipeline: NO compiled
+        program contains a collective. The cold stage scores and top-Ks
+        only its own shard's rows (shard_map, no cross-shard ops), the
+        winner-row exchange is an explicit device_put — a point-to-point
+        transfer, not a rendezvous collective — and warm windows run
+        entirely on the lead device. The naive SPMD scan pays 2
+        collectives PER PLACEMENT inside its scan body; the old
+        single-program keyed variant paid 2 per window. Now: zero."""
         import jax
 
         if len(jax.devices()) < 8:
             pytest.skip("needs 8 virtual devices")
-        import re
-
         from nomad_tpu.parallel import scheduling_mesh
         from nomad_tpu.scheduler import kernels
 
-        _, d = self._inputs()
-        t = d["key_demands"].shape[0]
-        p = 64
-        tg_ids = np.zeros(p, np.int32)
-        valid = np.ones(p, bool)
-        reset = np.zeros(p, bool)
         mesh = scheduling_mesh(jax.devices()[:8])
-        fn = kernels._keyed_program(mesh, kernels.keyed_cand_count(p))
-        hlo = fn.lower(
-            d["capacity"], d["score_cap"], d["usage"], d["tg_masks"],
-            d["job_counts"], d["key_demands"], tg_ids, valid, d["noise"],
-            np.float32(10.0), np.asarray(False), d["banned0"],
-            reset).compile().as_text()
-        n_collectives = len(re.findall(
-            r"(all-gather|all-reduce|reduce-scatter|collective-permute)",
-            hlo))
-        # One all-gather for the candidate packets, one all-reduce for
-        # the published packed result; a small constant factor tolerates
-        # XLA splitting a tuple collective. The naive scan pays >= 2 * P.
-        assert 0 < n_collectives <= 8, n_collectives
+        p = 64
+        counts = kernels.mesh_collective_audit(
+            mesh, kernels.keyed_cand_count(p), n_rows=512,
+            n_keys=self._inputs()[1]["key_demands"].shape[0], p_pad=p)
+        assert counts["cold"] == 0, counts
+        assert counts["pool_build"] == 0, counts
+        assert counts["warm"] == 0, counts
+        assert counts["apply"] == 0, counts
 
 
 class TestPlacementQualityParity:

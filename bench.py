@@ -117,6 +117,17 @@ MESH_VALID = int(os.environ.get("BENCH_MESH_VALID", 800))
 MESH_WINDOWS = int(os.environ.get("BENCH_MESH_WINDOWS", 6))
 MESH_REPS = int(os.environ.get("BENCH_MESH_REPS", 3))
 RUN_MESH = os.environ.get("BENCH_MESH", "1") != "0"
+# failover_storm (bench_failover_storm, ISSUE 13): a real 3-server
+# in-process cluster (raft + gossip + QoS lanes + streaming snapshots)
+# rides a mixed-priority storm through an induced LEADER KILL, recording
+# placements/s and per-tier e2e percentiles THROUGH the election plus
+# the measured leader gap. Parity-style exit-2 gate: zero lost evals,
+# zero duplicate allocs. --smoke runs the small variant; the full storm
+# is the slow-gated shape.
+FAILOVER_NODES = int(os.environ.get("BENCH_FAILOVER_NODES", 96))
+FAILOVER_JOBS = int(os.environ.get("BENCH_FAILOVER_JOBS", 90))
+FAILOVER_PER_JOB = int(os.environ.get("BENCH_FAILOVER_PER_JOB", 4))
+RUN_FAILOVER = os.environ.get("BENCH_FAILOVER", "1") != "0"
 
 
 def _apply_smoke():
@@ -130,6 +141,7 @@ def _apply_smoke():
     global SCALING_NODES, SCALING_EVALS, C4_EVALS
     global SLO_NODES, SLO_LOW, SLO_HIGH, SLO_REPS
     global SVC_AB_NODES, SVC_AB_EVALS, SVC_AB_REPS, RUN_MESH
+    global FAILOVER_NODES, FAILOVER_JOBS
     N_NODES = min(N_NODES, 512)
     N_PLACEMENTS = min(N_PLACEMENTS, 2000)   # 40 evals @ PER_EVAL=50
     N_REPS = min(N_REPS, 3)
@@ -162,6 +174,11 @@ def _apply_smoke():
     SVC_AB_NODES = min(SVC_AB_NODES, 256)
     SVC_AB_EVALS = min(SVC_AB_EVALS, 20)
     SVC_AB_REPS = min(SVC_AB_REPS, 2)
+    # The failover storm STAYS on at smoke scale (the zero-loss gate is
+    # the only bench-side check that an election loses nothing); the
+    # full 90-job storm is the slow-gated shape. A few seconds.
+    FAILOVER_NODES = min(FAILOVER_NODES, 24)
+    FAILOVER_JOBS = min(FAILOVER_JOBS, 24)
     # The 1M mesh A/B is slow-gated OUT of smoke (its subprocess compile
     # alone blows the budget); the mesh path's correctness coverage is
     # tier-1 (equivalence gate + collective audit + chaos schedule).
@@ -763,6 +780,219 @@ def _slo_preempt_probe():
                 "ok": bool(ok and placed == 1 and evicted >= 1)}
     finally:
         srv.shutdown()
+
+
+def bench_failover_storm():
+    """Zero-downtime gate (ISSUE 13): a mixed-priority storm against a
+    REAL 3-server cluster — raft replication, gossip failure detection,
+    QoS lanes, streaming snapshots (low threshold so persists run
+    mid-storm) — with the leader killed a third of the way in. Records
+    placements/s through the whole storm (election included), per-tier
+    e2e latency percentiles (the election wait lands in the tails of
+    whatever was queued at the kill), the measured kill->new-leader gap,
+    and the zero-loss gate: every eval terminal, every job at exactly
+    its asked-for live allocs, no duplicate alloc IDs."""
+    import random as _random
+    import threading as _threading
+
+    from nomad_tpu import mock
+    from nomad_tpu.gossip import GossipConfig
+    from nomad_tpu.qos import QoSConfig
+    from nomad_tpu.raft import RaftConfig
+    from nomad_tpu.rpc.cluster import ClusterServer
+    from nomad_tpu.server import ServerConfig
+    from nomad_tpu.structs import to_dict
+    from nomad_tpu.structs.structs import (
+        EvalStatusCancelled,
+        EvalStatusComplete,
+        EvalStatusFailed,
+    )
+
+    terminal = (EvalStatusComplete, EvalStatusFailed, EvalStatusCancelled)
+    raft_cfg = RaftConfig(heartbeat_interval=0.02,
+                          election_timeout_min=0.08,
+                          election_timeout_max=0.16, apply_timeout=5.0,
+                          snapshot_threshold=30, trailing_logs=32)
+
+    def boot(name, join=None):
+        cs = ClusterServer(ServerConfig(
+            node_id="", num_schedulers=1, bootstrap_expect=3,
+            scheduler_window=8,
+            # Election-scale deadlines: the per-tier burn through the
+            # kill is the SLO story, not sub-second compute on a loaded
+            # bench box.
+            qos=QoSConfig(enabled=True,
+                          deadlines_s=(10.0, 30.0, 120.0))))
+        cs.connect([], raft_config=raft_cfg)
+        cs.start()
+        ml_join = join
+        cs.enable_gossip(name, join=ml_join,
+                         gossip_config=GossipConfig.fast())
+        return cs
+
+    def leader_of(live):
+        for n in live:
+            try:
+                if n.server is not None and n.server.is_leader() \
+                        and n.server._leader:
+                    return n
+            except Exception:
+                pass
+        return None
+
+    def rpc(live, method, args, attempts=80, delay=0.1):
+        last = None
+        for _ in range(attempts):
+            targets = [n for n in live if n.endpoints is not None]
+            _random.shuffle(targets)
+            for cs in targets:
+                try:
+                    return cs.endpoints.handle(method, dict(args))
+                except Exception as e:
+                    last = e
+            time.sleep(delay)
+        raise last if last is not None else RuntimeError("no servers")
+
+    def gaddr(cs):
+        ml = cs.membership.memberlist
+        return f"{ml.addr}:{ml.port}"
+
+    tiers = (80, 20, 50)
+    tier_name = {80: "high", 20: "low", 50: "normal"}
+    nodes = [boot("b0")]
+    nodes.append(boot("b1", join=[gaddr(nodes[0])]))
+    nodes.append(boot("b2", join=[gaddr(nodes[0])]))
+    live = list(nodes)
+    out = {"nodes": FAILOVER_NODES, "jobs": FAILOVER_JOBS,
+           "per_job": FAILOVER_PER_JOB}
+    try:
+        deadline = time.monotonic() + 30
+        while leader_of(live) is None:
+            if time.monotonic() > deadline:
+                raise RuntimeError("cluster never elected")
+            time.sleep(0.05)
+        for _ in range(FAILOVER_NODES):
+            rpc(live, "Node.Register", {"Node": to_dict(mock.node())})
+
+        jobs, submit_t, eval_of = [], {}, {}
+        lat = {}
+        watch_stop = _threading.Event()
+
+        def watcher():
+            """Record each eval's submit->terminal latency against
+            whichever server currently leads."""
+            while True:
+                ldr = leader_of(live)
+                if ldr is not None:
+                    state = ldr.server.state
+                    now = time.monotonic()
+                    for eid in [e for e in list(eval_of) if e not in lat]:
+                        ev = state.eval_by_id(eid)
+                        if ev is not None and ev.Status in terminal:
+                            lat[eid] = now - submit_t[eid]
+                if watch_stop.is_set():
+                    return
+                time.sleep(0.02)
+
+        wt = _threading.Thread(target=watcher, name="failover-watch",
+                               daemon=True)
+        wt.start()
+
+        kill_at = max(1, FAILOVER_JOBS // 3)
+        recovery_s = None
+        t0 = time.monotonic()
+        for i in range(FAILOVER_JOBS):
+            if i == kill_at:
+                victim = leader_of(live)
+                if victim is not None:
+                    live.remove(victim)
+                    tk = time.monotonic()
+                    victim.shutdown()
+                    while leader_of(live) is None:
+                        if time.monotonic() - tk > 30:
+                            raise RuntimeError("no post-kill leader")
+                        time.sleep(0.02)
+                    recovery_s = time.monotonic() - tk
+            prio = tiers[i % len(tiers)]
+            job = build_job(FAILOVER_PER_JOB)
+            job.Priority = prio
+            jobs.append(job)
+            resp = rpc(live, "Job.Register", {"Job": to_dict(job)})
+            # submit_t before eval_of: the watcher keys off eval_of.
+            submit_t[resp["EvalID"]] = time.monotonic()
+            eval_of[resp["EvalID"]] = prio
+            time.sleep(0.005)
+
+        drain_deadline = time.monotonic() + 180
+        while len(lat) < len(eval_of):
+            if time.monotonic() > drain_deadline:
+                break
+            time.sleep(0.05)
+        t_total = time.monotonic() - t0
+        watch_stop.set()
+        wt.join(timeout=10)
+
+        ldr = leader_of(live)
+        end_wait = time.monotonic() + 15
+        while ldr is None and time.monotonic() < end_wait:
+            # A second election can be mid-flight at sample time.
+            time.sleep(0.05)
+            ldr = leader_of(live)
+        if ldr is None:
+            # Emit a failing gate rather than crash: the exit-2 contract
+            # is fail-AFTER-emit.
+            out["gate"] = {"ok": False, "error": "no leader after drain",
+                           "lost_evals": len(eval_of) - len(lat),
+                           "duplicate_allocs": None, "placed": None,
+                           "expected": len(jobs) * FAILOVER_PER_JOB}
+            return out
+        state = ldr.server.state
+        lost_evals = len(eval_of) - len(lat)
+        placed, dup, all_ids = 0, 0, set()
+        for job in jobs:
+            job_live = [a for a in state.allocs_by_job(job.ID)
+                        if not a.terminal_status()]
+            placed += len(job_live)
+            for a in job_live:
+                if a.ID in all_ids:
+                    dup += 1
+                all_ids.add(a.ID)
+            if len(job_live) != FAILOVER_PER_JOB:
+                lost_evals = max(lost_evals, 1)  # under/overshoot = loss
+        by_tier = {}
+        for eid, prio in eval_of.items():
+            if eid in lat:
+                by_tier.setdefault(tier_name[prio], []).append(lat[eid])
+        out.update({
+            "placements_sec": round(placed / t_total, 2)
+            if t_total > 0 else None,
+            "storm_s": round(t_total, 2),
+            "recovery_s": round(recovery_s, 3)
+            if recovery_s is not None else None,
+            "tier_latency_ms": {t: _pctiles_ms(v)
+                                for t, v in sorted(by_tier.items())},
+            "slo_burn": dict(zip(("high", "normal", "low"),
+                                 [round(b, 4) for b in
+                                  ldr.server.eval_broker.slo_burn()])),
+            "streaming_snapshot": ldr.server.raft.node.log
+            .latest_snapshot_chunks() is not None,
+            "gate": {
+                "ok": lost_evals == 0 and dup == 0
+                and placed == len(jobs) * FAILOVER_PER_JOB
+                and recovery_s is not None and recovery_s < 30.0,
+                "lost_evals": lost_evals,
+                "duplicate_allocs": dup,
+                "placed": placed,
+                "expected": len(jobs) * FAILOVER_PER_JOB,
+            },
+        })
+        return out
+    finally:
+        for n in nodes:
+            try:
+                n.shutdown()
+            except Exception:
+                pass
 
 
 def build_plain_job(per_eval=PER_EVAL):
@@ -1546,6 +1776,12 @@ def main(argv=None):
     if RUN_SLO:
         detail["slo_storm"] = (slo := bench_slo_storm())
 
+    # failover_storm: placements/s + per-tier tails through an induced
+    # leader election on a real 3-server cluster, zero-loss gated.
+    failover = None
+    if RUN_FAILOVER:
+        detail["failover_storm"] = (failover := bench_failover_storm())
+
     detail["placement_parity"] = (parity := bench_placement_parity())
 
     result = {
@@ -1574,6 +1810,12 @@ def main(argv=None):
         # drops work), admission must shed when told to, preemption must
         # place atomically. Same fail-after-emit contract as above.
         sys.stderr.write(f"QOS SLO GATE FAILED: {json.dumps(slo)}\n")
+        sys.exit(2)
+    if failover is not None and not failover["gate"]["ok"]:
+        # Zero-downtime gate: an election may slow the storm but must
+        # never lose or duplicate work. Same fail-after-emit contract.
+        sys.stderr.write(
+            f"FAILOVER STORM GATE FAILED: {json.dumps(failover)}\n")
         sys.exit(2)
     svc_store = store["service_window"]
     if (svc_store["storm_group"]["commit_speedup"] or 0) < STORE_SVC_GATE:

@@ -37,6 +37,11 @@ class RaftBackend:
             apply_fn=self._fsm_apply,
             snapshot_fn=self._fsm_snapshot,
             restore_fn=self._fsm_restore,
+            # Streaming snapshots: chunked persist off the apply path,
+            # chunked InstallSnapshot, chunk-by-chunk restore with one
+            # atomic cutover (README "Failover & streaming snapshots").
+            snapshot_stream_fn=self._fsm_snapshot_stream,
+            restore_stream_fn=self._fsm_restore_stream,
             config=config,
             on_leader_change=on_leader_change,
             electable=electable,
@@ -60,6 +65,17 @@ class RaftBackend:
 
     def _fsm_restore(self, blob: bytes) -> None:
         self.fsm.restore(msgpack.unpackb(blob, raw=False))
+
+    def _fsm_snapshot_stream(self):
+        """Chunk-dict generator, MVCC-pinned eagerly (the raft layer calls
+        this under its FSM lock so the pin matches the captured index)."""
+        return self.fsm.snapshot_chunks()
+
+    def _fsm_restore_stream(self, raw_chunks) -> None:
+        """raw_chunks: iterable of msgpack chunk blobs. Decoding stays
+        lazy so the atomic-cutover guarantee covers decode faults too."""
+        self.fsm.restore_chunks(
+            msgpack.unpackb(c, raw=False) for c in raw_chunks)
 
     # ----------------------------------------------------------- apply seam
     def apply(self, msg_type, payload: Dict[str, Any]) -> int:

@@ -33,6 +33,13 @@ LOG = logging.getLogger("nomad.raft.log")
 # into raft replay); legacy headerless files are parsed without CRC and
 # upgraded at the first rewrite.
 _MAGIC = b"NTL2"
+# Chunked snapshot file: magic, then the same CRC framing — record 0 is
+# msgpack((index, term)), every later record one snapshot chunk. Written
+# incrementally to a tmp file and published by one atomic os.replace, so
+# the on-disk snapshot is either the complete old one or the complete
+# new one; a CRC mismatch on load discards the file (raft falls back to
+# full log replay).
+_SNAP_MAGIC = b"NTS1"
 
 
 class EntryType(enum.IntEnum):
@@ -63,7 +70,7 @@ class InMemLogStore:
     by DevMode, nomad/server.go:612-616)."""
 
     _concurrency = guarded_by("_lock", "_entries", "_first", "_last",
-                              "_stable", "_snapshot")
+                              "_stable", "_snapshot", "_snapshot_chunks")
 
     def __init__(self):
         self._lock = threading.Lock()
@@ -72,6 +79,10 @@ class InMemLogStore:
         self._last = 0
         self._stable: Dict[str, Any] = {}
         self._snapshot: Optional[Tuple[int, int, bytes]] = None
+        # Chunked (streaming) snapshot: (index, term, [chunk bytes...]).
+        # Exactly one of _snapshot/_snapshot_chunks is set — whichever
+        # persist path ran last wins.
+        self._snapshot_chunks: Optional[Tuple[int, int, List[bytes]]] = None
 
     # ------------------------------------------------------------- log part
     def first_index(self) -> int:
@@ -125,10 +136,26 @@ class InMemLogStore:
     def store_snapshot(self, index: int, term: int, data: bytes) -> None:
         with self._lock:
             self._snapshot = (index, term, data)
+            self._snapshot_chunks = None
 
     def latest_snapshot(self) -> Optional[Tuple[int, int, bytes]]:
         with self._lock:
             return self._snapshot
+
+    def store_snapshot_chunks(self, index: int, term: int, chunks) -> None:
+        """Consume a chunk iterator and install the snapshot ATOMICALLY on
+        success. The iterator is drained BEFORE any state changes, so a
+        torn stream (the producer raises mid-iteration — e.g. the
+        `raft.snapshot.chunk` failpoint) leaves the previous snapshot
+        fully intact."""
+        staged = [bytes(c) for c in chunks]
+        with self._lock:
+            self._snapshot_chunks = (index, term, staged)
+            self._snapshot = None
+
+    def latest_snapshot_chunks(self) -> Optional[Tuple[int, int, List[bytes]]]:
+        with self._lock:
+            return self._snapshot_chunks
 
     def close(self) -> None:
         pass
@@ -176,8 +203,43 @@ class FileLogStore(InMemLogStore):
                 self._stable = msgpack.unpackb(fh.read(), raw=False)
         if os.path.exists(self._snap_path):
             with open(self._snap_path, "rb") as fh:
-                idx, term, data = msgpack.unpackb(fh.read(), raw=False)
+                raw = fh.read()
+            if raw.startswith(_SNAP_MAGIC):
+                records = self._parse_snap_frames(raw)
+                if records:
+                    idx, term = msgpack.unpackb(records[0], raw=False)
+                    self._snapshot_chunks = (idx, term, records[1:])
+            else:  # legacy monolithic format
+                idx, term, data = msgpack.unpackb(raw, raw=False)
                 self._snapshot = (idx, term, data)
+
+    @staticmethod
+    def _parse_snap_frames(raw: bytes) -> List[bytes]:
+        """CRC-checked records of a chunked snapshot file; [] on any
+        corruption (the file was published atomically, so damage means
+        bit rot — discard rather than restore garbage)."""
+        records: List[bytes] = []
+        off, n = len(_SNAP_MAGIC), len(raw)
+        while off < n:
+            if off + 8 > n:
+                LOG.error("snapshot file: truncated frame header; "
+                          "discarding snapshot")
+                return []
+            (length,) = _FRAME.unpack_from(raw, off)
+            (crc,) = _FRAME.unpack_from(raw, off + 4)
+            end = off + 8 + length
+            if end > n:
+                LOG.error("snapshot file: truncated record; discarding "
+                          "snapshot")
+                return []
+            payload = raw[off + 8:end]
+            if zlib.crc32(payload) != crc:
+                LOG.error("snapshot file: CRC mismatch at offset %d; "
+                          "discarding snapshot", off)
+                return []
+            records.append(payload)
+            off = end
+        return records
 
     def _replay(self) -> None:
         self._load_side_files()
@@ -286,6 +348,43 @@ class FileLogStore(InMemLogStore):
             fh.flush()
             os.fsync(fh.fileno())
         os.replace(tmp, self._snap_path)
+
+    def store_snapshot_chunks(self, index: int, term: int, chunks) -> None:
+        """Streaming persist: each chunk is framed and written to the tmp
+        file AS IT ARRIVES, fsync'd once at the end, and published by one
+        atomic os.replace. A producer that raises mid-stream (torn
+        stream, injected chunk fault) leaves the tmp file unpublished and
+        the previous snapshot — in memory and on disk — intact. The chunk
+        LIST is retained in memory after publish (like the monolithic
+        blob) so InstallSnapshot can stream it to lagging peers without
+        re-reading the file; what streaming bounds is the ENCODE side —
+        no single chunk scales with store size."""
+        tmp = self._snap_path + ".tmp"
+        staged: List[bytes] = []
+        try:
+            with open(tmp, "wb") as fh:
+                fh.write(_SNAP_MAGIC)
+                meta = msgpack.packb((index, term), use_bin_type=True)
+                fh.write(_FRAME.pack(len(meta))
+                         + _FRAME.pack(zlib.crc32(meta)) + meta)
+                for chunk in chunks:
+                    chunk = bytes(chunk)
+                    fh.write(_FRAME.pack(len(chunk))
+                             + _FRAME.pack(zlib.crc32(chunk)) + chunk)
+                    staged.append(chunk)
+                fh.flush()
+                os.fsync(fh.fileno())
+        except BaseException:
+            try:
+                os.remove(tmp)
+            # lint: allow(swallow, best-effort tmp cleanup on a failed persist)
+            except OSError:
+                pass
+            raise
+        os.replace(tmp, self._snap_path)
+        with self._lock:
+            self._snapshot_chunks = (index, term, staged)
+            self._snapshot = None
 
     def close(self) -> None:
         self._fh.close()

@@ -72,7 +72,8 @@ class RaftNode:
         "_lock", "_role", "_term", "_voted_for", "_leader_id", "_peers",
         "_commit_index", "_last_applied", "_snap_index", "_snap_term",
         "_applied_since_snap", "_next_index", "_match_index", "_futures",
-        "_election_deadline", "_shutdown", "_electable", "_repl_conds")
+        "_election_deadline", "_shutdown", "_electable", "_repl_conds",
+        "_install_staging")
 
     def __init__(self, node_id: str, peers: List[str], log_store,
                  transport,
@@ -81,7 +82,9 @@ class RaftNode:
                  restore_fn: Optional[Callable[[bytes], None]] = None,
                  config: Optional[RaftConfig] = None,
                  on_leader_change: Optional[Callable[[bool], None]] = None,
-                 electable: bool = True):
+                 electable: bool = True,
+                 snapshot_stream_fn: Optional[Callable[[], Any]] = None,
+                 restore_stream_fn: Optional[Callable[[Any], None]] = None):
         self.id = node_id
         self.config = config or RaftConfig()
         self.log = log_store
@@ -89,6 +92,17 @@ class RaftNode:
         self.apply_fn = apply_fn            # (index, entry_type, data) -> Any
         self.snapshot_fn = snapshot_fn
         self.restore_fn = restore_fn
+        # Streaming snapshots (when both stream fns are provided): persist
+        # runs chunk-by-chunk on a dedicated thread OFF the apply loop
+        # (the capture is an O(1) MVCC pin under the locks; serialization
+        # streams against the pinned watermark while later entries keep
+        # applying), InstallSnapshot ships the same chunks as a sequence
+        # of bounded RPCs, and restore loads chunk-by-chunk into staging
+        # state with ONE atomic cutover — a stream torn at any chunk
+        # boundary leaves the previous snapshot and the live FSM intact.
+        self.snapshot_stream_fn = snapshot_stream_fn
+        # restore_stream_fn takes an iterable of raw chunk blobs (bytes).
+        self.restore_stream_fn = restore_stream_fn
         self.on_leader_change = on_leader_change
 
         self._lock = threading.RLock()
@@ -140,6 +154,14 @@ class RaftNode:
         # Event mirror of _shutdown for shutdown-aware sleeps: loops that
         # pace with a wait() must wake the instant shutdown() is called.
         self._stop_event = threading.Event()
+        # Streaming persist coordination: the apply loop signals the snap
+        # thread; take_snapshot() runs synchronously under the same mutex.
+        self._snap_wake = threading.Event()
+        self._snap_mutex = threading.Lock()
+        # In-flight chunked InstallSnapshot streams, keyed by
+        # (leader, index, term): ordered chunk buffers, discarded on any
+        # out-of-order arrival (the leader restarts the stream).
+        self._install_staging: Dict[Tuple[str, int, int], List[bytes]] = {}
         self._leader_events: "queue.Queue[Optional[bool]]" = queue.Queue()
         self._fsm_lock = threading.Lock()  # serializes apply_fn vs restore_fn
         self._apply_cond = threading.Condition(self._lock)
@@ -165,6 +187,33 @@ class RaftNode:
                              name=f"raft-notify-{self.id}")
         t.start()
         self._threads.append(t)
+        if self.snapshot_stream_fn is not None:
+            t = threading.Thread(target=self._snap_loop, daemon=True,
+                                 name=f"raft-snap-{self.id}")
+            t.start()
+            self._threads.append(t)
+
+    def _snap_loop(self) -> None:
+        """Dedicated streaming-persist thread: the apply loop only SIGNALS
+        when the threshold trips; the O(rows) serialization and disk write
+        happen here, against a pinned MVCC watermark, while applies keep
+        committing."""
+        while True:
+            self._snap_wake.wait()
+            # clear() BEFORE the shutdown check: clearing after could wipe
+            # a shutdown() wake that landed in between, parking this
+            # thread forever and stalling shutdown at its join. A cleared
+            # threshold wake costs nothing — the threshold is re-checked
+            # inside _snapshot_stream_once anyway.
+            self._snap_wake.clear()
+            with self._lock:
+                if self._shutdown:
+                    return
+            with self._snap_mutex:
+                try:
+                    self._snapshot_stream_once()
+                except Exception:
+                    LOG.exception("streaming snapshot persist failed")
 
     def _notify_loop(self) -> None:
         """Delivers leadership transitions serially, in order (reference:
@@ -192,6 +241,9 @@ class RaftNode:
                 fut.error = NotLeaderError(None)
                 fut.event.set()
             self._futures.clear()
+        # After _shutdown is visible: the snap thread wakes, observes it,
+        # and exits (set before would let it clear the event and re-park).
+        self._snap_wake.set()
         self.transport.deregister(self.id)
         if was_leader:
             self._leader_events.put(False)
@@ -207,19 +259,50 @@ class RaftNode:
                 t.join(timeout=max(0.1, deadline - time.monotonic()))
         self._threads = []
 
+    def _latest_snapshot_any(self) -> Optional[Tuple[str, int, int, Any]]:
+        """Newest durable snapshot in either representation:
+        ("chunks", index, term, [meta, chunk...]) or
+        ("blob", index, term, blob)."""
+        chunked = None
+        getter = getattr(self.log, "latest_snapshot_chunks", None)
+        if getter is not None:
+            chunked = getter()
+        blob = self.log.latest_snapshot()
+        if chunked is not None and (blob is None or chunked[0] >= blob[0]):
+            return ("chunks",) + chunked
+        if blob is not None:
+            return ("blob",) + blob
+        return None
+
     @requires_lock("_lock")
     def _restore_from_disk(self) -> None:
-        snap = self.log.latest_snapshot()
+        snap = self._latest_snapshot_any()
         if snap is not None:
-            index, term, blob = snap
-            meta = msgpack.unpackb(blob, raw=False)
+            kind, index, term, payload = snap
+            if kind == "chunks" and self.restore_stream_fn is None:
+                # Refuse the snapshot rather than advance the indices
+                # over a SKIPPED restore: the covered entries were
+                # compacted away, so claiming applied-through-index with
+                # an empty FSM would serve silently divergent state.
+                LOG.error("%s: chunked snapshot on disk but no stream "
+                          "restore configured; ignoring it and booting "
+                          "from the retained log only", self.id)
+                snap = None
+        if snap is not None:
+            kind, index, term, payload = snap
+            if kind == "chunks":
+                meta = msgpack.unpackb(payload[0], raw=False)
+            else:
+                meta = msgpack.unpackb(payload, raw=False)
             self._snap_index, self._snap_term = index, term
             self._commit_index = self._last_applied = index
             if meta.get("peers"):
                 self._peers = list(meta["peers"])
                 if self.id not in self._peers:
                     self._peers.append(self.id)
-            if self.restore_fn is not None:
+            if kind == "chunks":
+                self.restore_stream_fn(iter(payload[1:]))
+            elif self.restore_fn is not None:
                 self.restore_fn(meta["data"])
         # Config entries in the retained log tail may supersede the snapshot.
         for e in self.log.get_range(self.log.first_index(),
@@ -509,7 +592,7 @@ class RaftNode:
             need_snapshot = (self._snap_index > 0 and next_idx <= self._snap_index
                              and (first == 0 or next_idx < first))
             if need_snapshot:
-                snap = self.log.latest_snapshot()
+                snap = self._latest_snapshot_any()
                 if snap is None:
                     # Log compacted past next_idx but no snapshot on disk yet
                     # (store_snapshot in flight): retry on the next tick.
@@ -518,7 +601,7 @@ class RaftNode:
                 prev_idx = next_idx - 1
                 prev_term = self._term_at(prev_idx)
                 if prev_term is None:
-                    snap = self.log.latest_snapshot()
+                    snap = self._latest_snapshot_any()
                     if snap is None:
                         return
                     need_snapshot = True
@@ -529,17 +612,7 @@ class RaftNode:
                     commit = self._commit_index
 
         if need_snapshot and snap is not None:
-            s_index, s_term, blob = snap
-            resp = self.transport.send(peer, "raft.install_snapshot", {
-                "Term": term, "Leader": self.id,
-                "LastIndex": s_index, "LastTerm": s_term, "Data": blob,
-            })
-            with self._lock:
-                if resp["Term"] > self._term:
-                    self._step_down(resp["Term"])
-                    return
-                self._next_index[peer] = s_index + 1
-                self._match_index[peer] = s_index
+            self._send_snapshot(peer, term, snap)
             return
 
         payload = {
@@ -572,6 +645,54 @@ class RaftNode:
                     self._next_index[peer] = max(1, min(next_idx - 1, hint + 1))
                 else:
                     self._next_index[peer] = max(1, next_idx - 1)
+
+    def _send_snapshot(self, peer: str, term: int,
+                       snap: Tuple[str, int, int, Any]) -> None:
+        """Ship one snapshot to a lagging peer. Chunked snapshots stream
+        as a SEQUENCE of bounded InstallSnapshot RPCs (seq-numbered; the
+        follower stages them and installs atomically on the last chunk) —
+        a 1M-row store never rides one RPC. The `raft.install_snapshot`
+        failpoint sits on every chunk hop: drop = a lost chunk (the
+        follower's stream goes stale and the next round restarts it)."""
+        kind, s_index, s_term, payload = snap
+        if kind == "blob":
+            resp = self.transport.send(peer, "raft.install_snapshot", {
+                "Term": term, "Leader": self.id,
+                "LastIndex": s_index, "LastTerm": s_term, "Data": payload,
+            })
+            with self._lock:
+                if resp["Term"] > self._term:
+                    self._step_down(resp["Term"])
+                    return
+                self._next_index[peer] = s_index + 1
+                self._match_index[peer] = s_index
+            return
+        chunks = payload
+        total = len(chunks)
+        for seq, chunk in enumerate(chunks):
+            if failpoints.fire("raft.install_snapshot") == "drop":
+                raise TransportError(
+                    f"install_snapshot chunk {seq}/{total} to {peer} "
+                    "dropped (failpoint)")
+            resp = self.transport.send(peer, "raft.install_snapshot", {
+                "Term": term, "Leader": self.id,
+                "LastIndex": s_index, "LastTerm": s_term,
+                "Seq": seq, "Total": total, "Chunk": chunk,
+            })
+            with self._lock:
+                if resp["Term"] > self._term:
+                    self._step_down(resp["Term"])
+                    return
+                if self._role != LEADER or self._term != term:
+                    return
+            if resp.get("Reject"):
+                # Follower lost the stream (restart, reordering): give up
+                # this round; the replicator retries from chunk 0.
+                return
+        with self._lock:
+            if self._role == LEADER and self._term == term:
+                self._next_index[peer] = s_index + 1
+                self._match_index[peer] = s_index
 
     @requires_lock("_lock")
     def _leader_advance_commit(self) -> None:
@@ -785,9 +906,15 @@ class RaftNode:
                 self._step_down(req["Term"], leader=req["Leader"])
             self._leader_id = req["Leader"]
             self._reset_election_timer()
-        # _fsm_lock first (same order as the apply loop) so restore_fn can't
-        # interleave with an in-flight apply_fn on the same FSM.
-        with self._fsm_lock:
+        if "Chunk" in req:
+            return self._on_install_snapshot_chunk(req)
+        # _snap_mutex first (same order as every streaming-persist
+        # caller): a legacy blob install on a streaming-configured node
+        # must not interleave with an in-flight chunked persist in the
+        # shared snapshot tmp file, nor be republished-over by a lagging
+        # older persist. Then _fsm_lock (same order as the apply loop)
+        # so restore_fn can't interleave with an in-flight apply_fn.
+        with self._snap_mutex, self._fsm_lock:
             with self._lock:
                 index, term = req["LastIndex"], req["LastTerm"]
                 if index <= self._last_applied:
@@ -814,6 +941,111 @@ class RaftNode:
             if restore is not None:
                 restore(meta["data"])
         return {"Term": self.term}
+
+    def _on_install_snapshot_chunk(self, req: Dict[str, Any]
+                                   ) -> Dict[str, Any]:
+        """One hop of a streamed InstallSnapshot. Chunks stage in order;
+        anything out of order rejects the stream (the leader restarts it
+        from chunk 0). Only the FINAL chunk installs — and the install
+        itself is atomic: the FSM restore loads staging tables and cuts
+        over in one swap, so a stream torn at ANY chunk boundary leaves
+        the follower's state and prior snapshot untouched."""
+        key = (req["Leader"], req["LastIndex"], req["LastTerm"])
+        seq, total = int(req["Seq"]), int(req["Total"])
+        chunk = req["Chunk"]
+        with self._lock:
+            if req["LastIndex"] <= self._last_applied:
+                # Already covered locally; ack so the leader advances.
+                self._install_staging.pop(key, None)
+                return {"Term": self._term}
+            if seq == 0:
+                # A new stream supersedes every staged one: only one
+                # leader can be streaming at a time, so any other key is
+                # an abandoned stream (leader died mid-install) that
+                # would otherwise leak its chunks forever.
+                self._install_staging.clear()
+                self._install_staging[key] = [chunk]
+            else:
+                buf = self._install_staging.get(key)
+                if buf is None or len(buf) != seq:
+                    self._install_staging.pop(key, None)
+                    return {"Term": self._term, "Reject": True}
+                buf.append(chunk)
+            if seq != total - 1:
+                return {"Term": self._term}
+            chunks = self._install_staging.pop(key)
+        try:
+            self._finish_chunked_install(int(req["LastIndex"]),
+                                         int(req["LastTerm"]), chunks)
+        except Exception:
+            # Torn install (injected restore fault, bad chunk): prior
+            # state intact by construction; reject so the leader retries.
+            LOG.exception("%s: chunked snapshot install failed", self.id)
+            return {"Term": self.term, "Reject": True}
+        return {"Term": self.term}
+
+    def _finish_chunked_install(self, index: int, term: int,
+                                chunks: List[bytes]) -> None:
+        from nomad_tpu.telemetry import metrics
+
+        t0 = time.monotonic()
+        # _snap_mutex FIRST (the order every streaming-persist caller
+        # uses: _snap_mutex -> _fsm_lock -> _lock): an install running
+        # concurrently with the persist thread could otherwise interleave
+        # writes in the shared snapshot tmp file, have the persist's
+        # lagging publish overwrite this NEWER snapshot after the log was
+        # wiped, or have our Restore table swap invalidate the persist's
+        # pinned MVCC view mid-encode.
+        with self._snap_mutex, self._fsm_lock:
+            with self._lock:
+                if index <= self._last_applied:
+                    return
+                # Fire BEFORE any state mutation, like the monolithic
+                # path: an injected failure models a cleanly-rejected
+                # install, never a half-applied one.
+                if failpoints.fire("raft.snapshot.restore") == "drop":
+                    raise failpoints.FailpointError("raft.snapshot.restore")
+                meta = msgpack.unpackb(chunks[0], raw=False)
+                restore_stream = self.restore_stream_fn
+            if restore_stream is None:
+                # Refuse rather than wipe the log around a skipped FSM
+                # restore (silent permanent divergence): the reject makes
+                # the leader retry, and the operator sees why.
+                raise RuntimeError(
+                    "chunked snapshot received but no stream restore "
+                    "configured")
+            # 1) FSM cutover FIRST (atomic: staging tables swap in one
+            #    commit). If this raises, nothing below ran — log, disk
+            #    snapshot, and indices are all still the old world.
+            restore_stream(iter(chunks[1:]))
+            with self._lock:
+                # 2) In-memory indices (pure memory, cannot fail): once
+                #    the FSM holds the snapshot state, the apply loop
+                #    must never re-apply retained entries <= index onto
+                #    it, durable persist or not.
+                self._snap_index, self._snap_term = index, term
+                self._commit_index = max(self._commit_index, index)
+                self._last_applied = index
+                self._applied_since_snap = 0
+                if meta.get("peers"):
+                    self._set_peers_locked(meta["peers"])
+                # 3) Durable snapshot + log wipe, best-effort: a failed
+                #    persist (disk full) degrades like the persist
+                #    failpoint — the log is kept, this process is fully
+                #    consistent in memory, and a restart replays the old
+                #    snapshot + whatever log it has (the leader re-sends
+                #    the install for any gap).
+                try:
+                    self.log.store_snapshot_chunks(index, term, chunks)
+                    self.log.delete_range(self.log.first_index(),
+                                          self.log.last_index())
+                except Exception:
+                    LOG.exception(
+                        "%s: chunked snapshot installed in memory but "
+                        "durable persist failed; keeping the log",
+                        self.id)
+        metrics.measure_since(("nomad", "raft", "snapshot", "install_ms"),
+                              t0)
 
     # ----------------------------------------------------------- apply loop
     def _apply_loop(self) -> None:
@@ -869,8 +1101,15 @@ class RaftNode:
     # ------------------------------------------------------------ snapshots
     def _maybe_snapshot(self) -> None:
         with self._lock:
-            if (self.snapshot_fn is None
+            if ((self.snapshot_fn is None
+                 and self.snapshot_stream_fn is None)
                     or self._applied_since_snap < self.config.snapshot_threshold):
+                return
+            if self.snapshot_stream_fn is not None:
+                # Streaming mode: hand off to the dedicated persist
+                # thread — the apply loop pays one event set, nothing
+                # else. The thread re-checks the threshold itself.
+                self._snap_wake.set()
                 return
         # _fsm_lock first (same order as the apply loop / InstallSnapshot) so
         # the snapshot blob and its recorded index cannot tear across a
@@ -914,11 +1153,93 @@ class RaftNode:
             if keep_from > self.log.first_index():
                 self.log.delete_range(self.log.first_index(), keep_from - 1)
 
+    def _snapshot_stream_once(self) -> None:
+        """One streaming snapshot: pin the FSM at its applied index (an
+        O(1) MVCC watermark under the locks), then — with BOTH locks
+        released, applies continuing — encode and persist chunk by chunk.
+        The `raft.snapshot.chunk` failpoint sits on every chunk: any
+        injected fault (or torn stream) aborts the persist with the
+        previous snapshot fully intact, and the counter re-arms so the
+        next apply retries."""
+        from nomad_tpu.telemetry import metrics
+
+        with self._fsm_lock:
+            with self._lock:
+                if (self.snapshot_stream_fn is None
+                        or self._applied_since_snap
+                        < self.config.snapshot_threshold):
+                    return
+                if self._last_applied <= self._snap_index:
+                    self._applied_since_snap = 0
+                    return
+                index = self._last_applied
+                term = self._term_at(index) or self._term
+                peers = list(self._peers)
+                self._applied_since_snap = 0
+            # Still under _fsm_lock: the pin inside snapshot_stream_fn is
+            # taken with no apply interleaving, so watermark == index.
+            stream = self.snapshot_stream_fn()
+
+        t0 = time.monotonic()
+        n_chunks = [0]
+
+        def encoded():
+            yield msgpack.packb({"peers": peers}, use_bin_type=True)
+            for chunk in stream:
+                # drop = torn stream: the chunk never reaches the store,
+                # and a snapshot missing a chunk must never install —
+                # abort the whole persist (old snapshot kept).
+                if failpoints.fire("raft.snapshot.chunk") == "drop":
+                    raise failpoints.FailpointError(
+                        "raft.snapshot.chunk",
+                        "snapshot chunk dropped (torn stream)")
+                n_chunks[0] += 1
+                yield msgpack.packb(chunk, use_bin_type=True)
+
+        with self._lock:
+            if index <= self._snap_index:
+                # A newer snapshot landed since the pin (a chunked
+                # install — serialized by _snap_mutex, so never MID-
+                # persist, but possibly between wake and pin): never
+                # publish an older one over it.
+                return
+        try:
+            # Same durable-write seam as the monolithic path: an injected
+            # persist failure degrades gracefully (log kept, retry at the
+            # next apply), whichever representation is being written.
+            if failpoints.fire("raft.snapshot.persist") == "drop":
+                raise failpoints.FailpointError("raft.snapshot.persist")
+            self.log.store_snapshot_chunks(index, term, encoded())
+        except Exception:
+            with self._lock:
+                self._applied_since_snap = self.config.snapshot_threshold
+            LOG.exception("streaming snapshot persist failed at index %d; "
+                          "keeping the full log and retrying", index)
+            return
+        metrics.incr_counter(("nomad", "raft", "snapshot", "chunks"),
+                             n_chunks[0])
+        metrics.measure_since(("nomad", "raft", "snapshot", "persist_ms"),
+                              t0)
+        with self._lock:
+            if index <= self._snap_index:
+                return
+            self._snap_index, self._snap_term = index, term
+            keep_from = max(self.log.first_index(),
+                            index - self.config.trailing_logs + 1)
+            if keep_from > self.log.first_index():
+                self.log.delete_range(self.log.first_index(), keep_from - 1)
+
     def take_snapshot(self) -> int:
         """Force a snapshot now; returns its index (reference: the snapshot
         path exercised by fsm tests, nomad/fsm.go:430)."""
         with self._lock:
             self._applied_since_snap = self.config.snapshot_threshold
-        self._maybe_snapshot()
+        if self.snapshot_stream_fn is not None:
+            # Synchronous streaming persist, serialized against the snap
+            # thread so two persists never interleave in the tmp file.
+            with self._snap_mutex:
+                self._snapshot_stream_once()
+        else:
+            self._maybe_snapshot()
         with self._lock:
             return self._snap_index

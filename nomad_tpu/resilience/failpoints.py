@@ -64,7 +64,19 @@ KNOWN_SITES: Dict[str, str] = {
                            "commit without their placement)",
     "raft.append_entries": "raft: leader->peer AppendEntries send",
     "raft.fsync": "raft: durable log append fsync",
+    "raft.install_snapshot": "raft: one chunk hop of a streamed "
+                             "InstallSnapshot send (error=failed send; "
+                             "delay=slow install; drop=lost chunk — the "
+                             "follower's staged stream goes stale, rejects, "
+                             "and the leader restarts from chunk 0; a "
+                             "partial stream must never install)",
     "raft.request_vote": "raft: candidate->peer RequestVote send",
+    "raft.snapshot.chunk": "raft: one chunk of a streaming snapshot "
+                           "persist (error=failed chunk write; delay=slow "
+                           "persist; drop=torn stream — the persist aborts "
+                           "wholesale, the PREVIOUS snapshot stays intact "
+                           "on disk and in memory, and the threshold "
+                           "counter re-arms so the next apply retries)",
     "raft.snapshot.persist": "raft: state snapshot persist to the log store",
     "raft.snapshot.restore": "raft/state: FSM restore from snapshot blob",
     "state.store.commit": "server: columnar sweep-batch bulk commit (fires "

@@ -93,21 +93,28 @@ class BlockedEvals:
             self._capacity_ch = _queue.Queue(maxsize=8096)
 
     # ----------------------------------------------------------------- block
-    def block(self, ev: Evaluation) -> None:
-        self._process_block(ev, "")
+    def block(self, ev: Evaluation, age: float = 0.0) -> None:
+        """``age`` seeds the first-enqueue timestamp (monotonic) for an
+        eval entering from OUTSIDE the broker — the warm-failover restore
+        passes the timetable-derived original enqueue time so a blocked
+        eval that rode out an election keeps its true queue age."""
+        self._process_block(ev, "", age=age)
 
     def reblock(self, ev: Evaluation, token: str) -> None:
         """Block by an outstanding evaluation; carries its broker token."""
         self._process_block(ev, token)
 
-    def _process_block(self, ev: Evaluation, token: str) -> None:
+    def _process_block(self, ev: Evaluation, token: str,
+                       age: float = 0.0) -> None:
         # Queue-age carry: read BEFORE taking our lock (consistent
         # blocked->broker lock order everywhere else in this file). A
         # fresh blocked eval (new ID) inherits its parent's first-enqueue
-        # time; a reblocked eval still owns its own entry.
+        # time; a reblocked eval still owns its own entry. An explicit
+        # seed (warm-failover restore) wins only when the broker has no
+        # memory of the eval at all.
         age = (self.eval_broker.queue_age(ev.ID)
                or (self.eval_broker.queue_age(ev.PreviousEval)
-                   if ev.PreviousEval else None) or 0.0)
+                   if ev.PreviousEval else None) or age or 0.0)
         with self._lock:
             if not self._enabled:
                 return

@@ -163,7 +163,8 @@ class BrokerStats:
 class EvalBroker:
     _concurrency = guarded_by(
         "_lock", "_enabled", "_evals", "_job_evals", "_blocked", "_ready",
-        "_unack", "_requeue", "_time_wait", "stats", "_ages", "_slo")
+        "_unack", "_requeue", "_time_wait", "stats", "_ages",
+        "_age_slack", "_slo")
 
     def __init__(self, nack_timeout: float = 60.0, delivery_limit: int = 3,
                  qos: Optional[QoSConfig] = None):
@@ -189,6 +190,13 @@ class EvalBroker:
         # never reset behind fresh arrivals, and ack-time wait vs the tier
         # deadline feeds the SLO-burn rings below.
         self._ages: Dict[str, float] = {}
+        # Warm-failover witness slack per eval: the first-enqueue seed a
+        # new leader derives from the replicated timetable errs OLDER by
+        # up to one witness interval (good for ordering — the eval keeps
+        # its place — but it must not count as deadline burn the eval
+        # may never have suffered). ack subtracts it from the SLO-burn
+        # wait, turning the burn sample into a LOWER bound of true wait.
+        self._age_slack: Dict[str, float] = {}
         # Per-tier ring of recent completions: True = blew its deadline.
         self._slo: List[Deque[bool]] = [
             deque(maxlen=(qos.burn_window if qos_enabled(qos) else 1))
@@ -224,6 +232,7 @@ class EvalBroker:
             self._requeue.clear()
             self._time_wait.clear()
             self._ages.clear()
+            self._age_slack.clear()
             self.stats = BrokerStats()
             self._cond.notify_all()
 
@@ -507,12 +516,17 @@ class EvalBroker:
         unack.nack_timer.cancel()
         job_id = unack.eval.JobID
         enq_time = self._ages.pop(eval_id, 0.0)
+        slack = self._age_slack.pop(eval_id, 0.0)
         if qos_enabled(self.qos) and enq_time:
             # SLO burn: did this eval's whole broker residency (first
             # enqueue -> ack, spanning redeliveries) blow its tier
             # deadline? Admission control sheds lower tiers on this.
+            # Minus the failover witness slack: a restored eval's seed
+            # errs older by up to one timetable interval, and counting
+            # that as burn would saturate the rings (and shed tiers)
+            # after every election on a long-lived cluster.
             tier = self.qos.tier_of(unack.eval.Priority)
-            waited = time.monotonic() - enq_time
+            waited = time.monotonic() - enq_time - slack
             self._slo[tier].append(waited > self.qos.deadlines_s[tier])
 
         self.stats.TotalUnacked -= 1
@@ -566,6 +580,16 @@ class EvalBroker:
                 self._enqueue_locked(unack.eval, unack.eval.Type)
 
     # ------------------------------------------------------ QoS introspection
+    def seed_age_slack(self, slack: Dict[str, float]) -> None:
+        """Record per-eval witness slack for restored evals (see
+        _age_slack). Seeded once per eval — an existing entry (an eval
+        that rode TWO elections accumulates only its first, larger
+        slack) is kept."""
+        with self._lock:
+            for eid, s in slack.items():
+                if s > 0.0:
+                    self._age_slack.setdefault(eid, s)
+
     def queue_age(self, eval_id: str) -> Optional[float]:
         """Monotonic timestamp of the eval's FIRST enqueue (preserved
         across Nack redeliveries), or None once acked/unknown."""

@@ -40,6 +40,24 @@ from nomad_tpu.structs.structs import (
 
 logger = logging.getLogger("nomad.fsm")
 
+# Streaming-snapshot chunk bound: objects (or columnar rows) per chunk.
+# Small enough that one chunk's encode/persist never stalls the apply
+# loop noticeably; large enough that a 1M-row store is ~hundreds of
+# chunks, not tens of thousands.
+SNAPSHOT_CHUNK_ITEMS = 2048
+
+
+def _slice_segment(seg: Dict[str, Any], lo: int, hi: int) -> Dict[str, Any]:
+    """Row-slice one serialized SweepSegment. Each slice restores as its
+    own segment; every read surface (by id/node/job/eval, dumps, client
+    maps) is the union over segments, so the split is read-equivalent."""
+    out = dict(seg)
+    for key in ("AllocIDs", "Names", "NodeIDs"):
+        out[key] = seg[key][lo:hi]
+    if seg.get("TGIdx"):
+        out["TGIdx"] = seg["TGIdx"][lo:hi]
+    return out
+
 
 class MessageType(enum.IntEnum):
     """(reference: structs.go:40-57 MessageType constants)"""
@@ -361,28 +379,118 @@ class FSM:
             "timetable": self.timetable.serialize(),
         }
 
-    def restore(self, data: Dict[str, Any]) -> None:
-        """(reference: fsm.go:444-551)"""
+    def snapshot_chunks(self, chunk_items: int = SNAPSHOT_CHUNK_ITEMS):
+        """Stream the FSM state as BOUNDED chunks (the streaming-snapshot
+        persist path). The MVCC snapshot is pinned EAGERLY — before this
+        returns — so the caller can capture the watermark under the apply
+        lock and then iterate entirely off the apply path: chunks resolve
+        through the pinned watermark while later raft entries keep
+        committing. Each chunk is one small dict (a header, or up to
+        `chunk_items` objects of one table); an oversized columnar segment
+        is sliced by rows into several read-equivalent segments so no
+        single chunk scales with sweep size."""
+        snap = self.state.snapshot()
+        timetable = self.timetable.serialize()
+
+        def batched(kind, items):
+            for i in range(0, len(items), chunk_items):
+                yield {"kind": kind, "items": items[i:i + chunk_items]}
+
+        def gen():
+            yield {
+                "kind": "header",
+                "indexes": {t: snap.get_index(t)
+                            for t in ("nodes", "jobs", "evals", "allocs",
+                                      "periodic_launch", "services")},
+                "timetable": timetable,
+            }
+            yield from batched("nodes", [to_dict(n) for n in snap.nodes()])
+            yield from batched("jobs", [to_dict(j) for j in snap.jobs()])
+            yield from batched("evals", [to_dict(e) for e in snap.evals()])
+            chain_allocs, col_segments = snap.alloc_dump()
+            yield from batched("allocs", [to_dict(a) for a in chain_allocs])
+            # Columnar segments: group whole segments up to chunk_items
+            # rows per chunk; slice a lone over-large segment by rows
+            # (each slice restores as its own segment — identical on
+            # every read surface, `alloc_dump` partition included).
+            group: list = []
+            rows = 0
+            for seg in col_segments:
+                n = len(seg["AllocIDs"])
+                if n > chunk_items:
+                    if group:
+                        yield {"kind": "columnar_allocs", "items": group}
+                        group, rows = [], 0
+                    for i in range(0, n, chunk_items):
+                        yield {"kind": "columnar_allocs",
+                               "items": [_slice_segment(seg, i,
+                                                        i + chunk_items)]}
+                    continue
+                if rows + n > chunk_items and group:
+                    yield {"kind": "columnar_allocs", "items": group}
+                    group, rows = [], 0
+                group.append(seg)
+                rows += n
+            if group:
+                yield {"kind": "columnar_allocs", "items": group}
+            yield from batched(
+                "periodic_launches",
+                [to_dict(p) for p in snap.periodic_launches()])
+            yield from batched("services",
+                               [to_dict(s) for s in snap.services()])
+
+        return gen()
+
+    def restore_chunks(self, chunks) -> None:
+        """Chunk-by-chunk restore with a SINGLE atomic cutover: every chunk
+        loads into the Restore's staging tables; only the final commit()
+        swaps them in. An iterator that raises (torn stream, injected
+        chunk fault, killed install) leaves the live store — and the
+        timetable — bit-identical to its pre-restore state."""
         r = self.state.restore()
-        for n in data.get("nodes", ()):
-            r.node_restore(from_dict(Node, n))
-        for j in data.get("jobs", ()):
-            r.job_restore(from_dict(Job, j))
-        for e in data.get("evals", ()):
-            r.eval_restore(from_dict(Evaluation, e))
-        for a in data.get("allocs", ()):
-            r.alloc_restore(from_dict(Allocation, a))
-        for seg in data.get("columnar_allocs", ()):
-            r.columnar_restore(seg)
-        for p in data.get("periodic_launches", ()):
-            r.periodic_launch_restore(from_dict(PeriodicLaunch, p))
-        for s in data.get("services", ()):
-            r.service_restore(from_dict(ServiceRegistration, s))
-        for t, idx in data.get("indexes", {}).items():
-            r.index_restore(t, idx)
+        timetable = None
+        loaders = {
+            "nodes": (Node, r.node_restore),
+            "jobs": (Job, r.job_restore),
+            "evals": (Evaluation, r.eval_restore),
+            "allocs": (Allocation, r.alloc_restore),
+            "periodic_launches": (PeriodicLaunch, r.periodic_launch_restore),
+            "services": (ServiceRegistration, r.service_restore),
+        }
+        for chunk in chunks:
+            kind = chunk.get("kind")
+            if kind == "header":
+                for t, idx in (chunk.get("indexes") or {}).items():
+                    r.index_restore(t, idx)
+                timetable = chunk.get("timetable")
+            elif kind == "columnar_allocs":
+                for seg in chunk.get("items", ()):
+                    r.columnar_restore(seg)
+            elif kind in loaders:
+                cls, load = loaders[kind]
+                for item in chunk.get("items", ()):
+                    load(from_dict(cls, item) if isinstance(item, dict)
+                         else item)
+            else:
+                raise ValueError(f"unknown snapshot chunk kind {kind!r}")
         r.commit()
-        if data.get("timetable"):
-            self.timetable.deserialize(data["timetable"])
+        if timetable:
+            self.timetable.deserialize(timetable)
+
+    def restore(self, data: Dict[str, Any]) -> None:
+        """(reference: fsm.go:444-551) One code path with the chunked
+        restore: a monolithic snapshot dict is just a stream of
+        one-table chunks."""
+        def gen():
+            yield {"kind": "header", "indexes": data.get("indexes", {}),
+                   "timetable": data.get("timetable")}
+            for kind in ("nodes", "jobs", "evals", "allocs",
+                         "columnar_allocs", "periodic_launches", "services"):
+                items = list(data.get(kind, ()))
+                if items:
+                    yield {"kind": kind, "items": items}
+
+        self.restore_chunks(gen())
 
 
 _HANDLERS = {

@@ -301,7 +301,16 @@ class Server:
                 self.revoke_leadership()
 
     def establish_leadership(self) -> None:
-        """(reference: leader.go:107-170)"""
+        """(reference: leader.go:107-170)
+
+        WARM failover: everything a leader term needs is re-seeded from
+        the replicated store instead of starting cold — broker queue ages
+        from the FSM timetable (_restore_evals), node-tensor usage
+        resynced against committed allocs, and the device arrays + the
+        refresh programs the ChainArbiter's first window would otherwise
+        compile mid-serving (README "Failover & streaming snapshots").
+        The whole establishment is timed as nomad.server.failover.*."""
+        t_establish = time.monotonic()
         self._leader = True
         # The leader's scheduling capacity is its pipelined workers; routed
         # workers stand down first (reference intent: leader.go:110-116).
@@ -322,13 +331,17 @@ class Server:
 
         self._restore_evals()
         self._restore_periodic_dispatcher()
+        self._warm_failover_state()
 
         # Workers. Pipelined workers share ONE chain arbiter per
         # leadership term: their windows interleave on a single coherent
         # device usage chain (worker B's kernels see worker A's in-flight
         # placements) instead of each keeping a private chain that the
         # plan applier then bounces. Fresh per term — a prior term's
-        # taint/pending state must not leak into the new leader's chain.
+        # taint/pending state must not leak into the new leader's chain
+        # — but WARM: _warm_failover_state resynced the node tensor and
+        # pre-uploaded its device arrays, so the arbiter's first acquire
+        # chains on committed usage that is already device-resident.
         from nomad_tpu.tensor.node_table import ChainArbiter
         arbiter = ChainArbiter(self.tindex.nt)
         schedulers = list(self.config.enabled_schedulers) + [JobTypeCore]
@@ -371,6 +384,36 @@ class Server:
         self._start_loop(self.blocked_evals.unblock_failed,
                          self.config.failed_eval_unblock_interval)
         self._start_loop(self._emit_stats, 1.0)
+        metrics.measure_since(("nomad", "server", "failover",
+                               "establish_ms"), t_establish)
+
+    def _warm_failover_state(self) -> None:
+        """Re-seed device-side leader state from the replicated store.
+
+        A follower's tensor was fed incrementally by FSM applies (and
+        rebuilt by TensorIndex.on_restore after a chunked snapshot
+        install), but its usage can drift across an election window and
+        its device arrays were never uploaded — a cold first window pays
+        the full-table transfer plus the dirty-row refresh compiles in
+        the middle of the recovery storm. Resync + pre-warm here, while
+        the brand-new term has no windows in flight. Dev mode skips the
+        device warm-up (every unit-test Server would pay XLA compiles);
+        the resync is cheap and always runs."""
+        fixed = self.tindex.resync_usage(self.state)
+        metrics.incr_counter(("nomad", "server", "failover",
+                              "usage_resync_rows"), fixed)
+        if fixed:
+            logger.warning("warm failover: corrected %d drifted node-tensor "
+                           "rows from the replicated store", fixed)
+        if hasattr(self.raft, "node"):  # replicated mode only
+            t0 = time.monotonic()
+            try:
+                self.tindex.nt.warm_device()
+            except Exception:
+                logger.exception("warm failover: device warm-up failed; "
+                                 "first window will pay the upload")
+            metrics.measure_since(("nomad", "server", "failover",
+                                   "warm_ms"), t0)
 
     def revoke_leadership(self) -> None:
         """(reference: leader.go:390-431)"""
@@ -512,12 +555,59 @@ class Server:
     # ------------------------------------------------------- leader restores
     def _restore_evals(self) -> None:
         """Re-hydrate broker + blocked from replicated state
-        (reference: leader.go:176-202)."""
+        (reference: leader.go:176-202) — WARM: each eval's first-enqueue
+        age re-seeds from the FSM timetable's witness of its CreateIndex
+        (the replicated index->wallclock map), so QoS tier aging and SLO
+        burn keep measuring from the ORIGINAL enqueue across an election
+        instead of resetting every queued eval to age zero. The timetable
+        witnesses at a bounded granularity, so the seed errs OLDER —
+        conservative for ORDERING (the eval can only promote sooner,
+        never lose its place behind fresh arrivals) — and the witness
+        spread rides along as SLO-burn slack so the same error cannot
+        count as deadline burn the eval may never have suffered (one
+        300s-granularity interval would otherwise saturate every tier's
+        burn ring after each election and trip admission shedding)."""
+        now_wall = time.time()
+        now_mono = time.monotonic()
+
+        def age_seed(ev: Evaluation) -> Tuple[float, float]:
+            """(monotonic first-enqueue seed, witness slack seconds)."""
+            witnessed = self.timetable.nearest_time(ev.CreateIndex)
+            if not witnessed:
+                return 0.0, 0.0
+            upper = self.timetable.nearest_time_after(ev.CreateIndex) \
+                or now_wall
+            # Map the replicated wall anchor onto this process's
+            # monotonic clock (the broker's _ages domain).
+            seed = now_mono - max(0.0, now_wall - witnessed)
+            slack = max(0.0, min(upper, now_wall) - witnessed)
+            return seed, slack
+
+        ready: Dict[str, Tuple[Evaluation, str]] = {}
+        ages: Dict[str, float] = {}
+        slacks: Dict[str, float] = {}
+        blocked = 0
         for ev in self.state.evals():
             if ev.should_enqueue():
-                self.eval_broker.enqueue(ev)
+                ready[ev.ID] = (ev, "")
+                seed, slack = age_seed(ev)
+                if seed:
+                    ages[ev.ID] = seed
+                    slacks[ev.ID] = slack
             elif ev.should_block():
-                self.blocked_evals.block(ev)
+                seed, slack = age_seed(ev)
+                self.blocked_evals.block(ev, age=seed)
+                if slack:
+                    slacks[ev.ID] = slack
+                blocked += 1
+        if ready:
+            self.eval_broker.enqueue_all(ready, ages=ages)
+        if slacks:
+            self.eval_broker.seed_age_slack(slacks)
+        metrics.incr_counter(("nomad", "server", "failover",
+                              "evals_restored"), len(ready))
+        metrics.incr_counter(("nomad", "server", "failover",
+                              "blocked_restored"), blocked)
 
     def _restore_periodic_dispatcher(self) -> None:
         """(reference: leader.go:204-243)"""

@@ -49,6 +49,18 @@ class TimeTable:
                     return t
             return 0.0
 
+    def nearest_time_after(self, index: int) -> float:
+        """Earliest witness at or after `index` — an UPPER bound on when
+        the index was applied (0.0 if nothing that new was witnessed).
+        Paired with nearest_time this brackets an index's wall time to
+        one witness interval; the failover age re-seed uses the spread
+        as burn slack."""
+        with self._lock:
+            for idx, t in reversed(self._table):  # oldest first
+                if idx >= index:
+                    return t
+            return 0.0
+
     def serialize(self) -> List[Tuple[int, float]]:
         with self._lock:
             return list(self._table)

@@ -1156,33 +1156,52 @@ class StateSnapshot(_ReadAPI):
 
 class Restore:
     """Bulk loader used by FSM snapshot restore (reference: state_store.go
-    Restore/NodeRestore/JobRestore/...)."""
+    Restore/NodeRestore/JobRestore/...).
+
+    ATOMIC CUTOVER: every *_restore call writes into STAGING structures
+    owned by this Restore, never into the live store. `commit()` swaps the
+    staged tables in under one lock hold. A restore abandoned mid-stream —
+    a torn snapshot chunk, an injected fault, a killed install — therefore
+    leaves the live store bit-identical to its pre-restore state; readers
+    never observe a half-loaded snapshot."""
 
     def __init__(self, store: StateStore):
         self._store = store
         self._max_index = 0
+        # Staging mirrors of every structure a snapshot populates.
+        self._tables: Dict[str, _Table] = {t: _Table() for t in TABLES}
+        self._member_sets: Dict[str, Dict[str, Set[str]]] = {
+            name: {} for name in _MEMBER_INDEXES}
+        self._table_index: Dict[str, int] = {}
+        self._col_segments: List[SweepSegment] = []
+        self._col_by_job: Dict[str, List[SweepSegment]] = {}
+        self._col_by_eval: Dict[str, List[SweepSegment]] = {}
+        self._committed = False
 
     def _bump(self, index: int) -> None:
         self._max_index = max(self._max_index, index)
 
+    def _member_add(self, index_name: str, key: str, obj_id: str) -> None:
+        self._member_sets[index_name].setdefault(key, set()).add(obj_id)
+
     def node_restore(self, node: Node) -> None:
-        self._store._tables["nodes"].write(node.ModifyIndex, node.ID, node)
+        self._tables["nodes"].write(node.ModifyIndex, node.ID, node)
         self._bump(node.ModifyIndex)
 
     def job_restore(self, job: Job) -> None:
-        self._store._tables["jobs"].write(job.ModifyIndex, job.ID, job)
+        self._tables["jobs"].write(job.ModifyIndex, job.ID, job)
         self._bump(job.ModifyIndex)
 
     def eval_restore(self, ev: Evaluation) -> None:
-        self._store._tables["evals"].write(ev.ModifyIndex, ev.ID, ev)
-        self._store._member_add("eval_job", ev.JobID, ev.ID)
+        self._tables["evals"].write(ev.ModifyIndex, ev.ID, ev)
+        self._member_add("eval_job", ev.JobID, ev.ID)
         self._bump(ev.ModifyIndex)
 
     def alloc_restore(self, alloc: Allocation) -> None:
-        self._store._tables["allocs"].write(alloc.ModifyIndex, alloc.ID, alloc)
-        self._store._member_add("alloc_node", alloc.NodeID, alloc.ID)
-        self._store._member_add("alloc_job", alloc.JobID, alloc.ID)
-        self._store._member_add("alloc_eval", alloc.EvalID, alloc.ID)
+        self._tables["allocs"].write(alloc.ModifyIndex, alloc.ID, alloc)
+        self._member_add("alloc_node", alloc.NodeID, alloc.ID)
+        self._member_add("alloc_job", alloc.JobID, alloc.ID)
+        self._member_add("alloc_eval", alloc.EvalID, alloc.ID)
         self._bump(alloc.ModifyIndex)
 
     def columnar_restore(self, seg_data: Dict[str, Any]) -> None:
@@ -1191,34 +1210,59 @@ class Restore:
         explodes into per-alloc objects."""
         seg = (seg_data if isinstance(seg_data, SweepSegment)
                else SweepSegment.deserialize(seg_data))
-        store = self._store
-        store._col_segments.append(seg)
-        store._col_unindexed.append(seg)
-        store._col_by_job.setdefault(seg.job_id, []).append(seg)
-        store._col_by_eval.setdefault(seg.eval_id, []).append(seg)
-        store._has_col = True
+        self._col_segments.append(seg)
+        self._col_by_job.setdefault(seg.job_id, []).append(seg)
+        self._col_by_eval.setdefault(seg.eval_id, []).append(seg)
         self._bump(seg.index)
 
     def periodic_launch_restore(self, launch: PeriodicLaunch) -> None:
-        self._store._tables["periodic_launch"].write(launch.ModifyIndex,
-                                                     launch.ID, launch)
+        self._tables["periodic_launch"].write(launch.ModifyIndex,
+                                              launch.ID, launch)
         self._bump(launch.ModifyIndex)
 
     def service_restore(self, reg) -> None:
-        self._store._tables["services"].write(reg.ModifyIndex, reg.ID, reg)
-        self._store._member_add("service_name", reg.ServiceName, reg.ID)
-        self._store._member_add("service_node", reg.NodeID, reg.ID)
-        self._store._member_add("service_alloc", reg.AllocID, reg.ID)
+        self._tables["services"].write(reg.ModifyIndex, reg.ID, reg)
+        self._member_add("service_name", reg.ServiceName, reg.ID)
+        self._member_add("service_node", reg.NodeID, reg.ID)
+        self._member_add("service_alloc", reg.AllocID, reg.ID)
         self._bump(reg.ModifyIndex)
 
     def index_restore(self, table: str, index: int) -> None:
-        self._store._table_index[table] = index
+        self._table_index[table] = index
         self._bump(index)
 
     def commit(self) -> None:
+        """Swap the staged snapshot in as THE store state, atomically with
+        respect to readers, then wake every blocking query (a restore can
+        change anything) and tell listeners to rebuild their derived state
+        (the device-resident node tensor re-seeds from the store — its
+        incremental feed never saw the staged writes)."""
         store = self._store
+        if self._committed:
+            raise RuntimeError("restore already committed")
+        self._committed = True
         with store._lock:
-            if self._max_index > store._latest_index:
-                store._latest_index = self._max_index
+            store._tables = self._tables
+            store._member_sets = self._member_sets
+            store._table_index = self._table_index
             for t in TABLES:
                 store._table_index.setdefault(t, 0)
+            store._col_segments = self._col_segments
+            store._col_by_job = self._col_by_job
+            store._col_by_eval = self._col_by_eval
+            store._col_unindexed = list(self._col_segments)
+            store._col_alloc_index = {}
+            store._col_node_index = {}
+            store._has_col = bool(self._col_segments)
+            if self._max_index > store._latest_index:
+                store._latest_index = self._max_index
+            # Every blocking query must re-read. Blocking queries
+            # register FINE-GRAINED items only (Item(job=...),
+            # Item(alloc_node=...)), so table-level notifies would strand
+            # them until their max-wait expiry: wake everyone.
+            store._notify.notify_all()
+            listeners = list(store._listeners)
+        for cb in listeners:
+            on_restore = getattr(cb, "on_restore", None)
+            if on_restore is not None:
+                on_restore(store)

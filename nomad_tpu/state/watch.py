@@ -106,3 +106,13 @@ class NotifyGroup:
                         fired.update(evs)
         for ev in fired:
             ev.set()
+
+    def notify_all(self) -> None:
+        """Wake EVERY registered waiter. For whole-store events — a
+        snapshot restore swaps every table, so any blocked query's
+        object may have changed regardless of which keys it watches.
+        O(waiters), and restores are rare."""
+        with self._lock:
+            fired = {ev for evs in self._waiters.values() for ev in evs}
+        for ev in fired:
+            ev.set()

@@ -19,7 +19,7 @@ from nomad_tpu.structs import Allocation, Node
 
 import numpy as np
 
-from .node_table import NodeTensor, alloc_vec
+from .node_table import NodeTensor, alloc_vec, resources_vec
 
 # shared_elig's per-job view caches are unbounded across a long-lived
 # server (one entry per job id ever swept); past this many entries the
@@ -75,16 +75,22 @@ class TensorIndex:
                 self._elig_cache = (ver, elig)
         return elig
 
+    def _seed_from(self, state) -> None:
+        """Seed the tensor from any read API: every node a row, usage =
+        the non-terminal allocs. The ONE copy of the seeding semantics
+        (attach / from_state / on_restore all build through here)."""
+        for node in state.nodes():
+            self.nt.upsert_node(node)
+        for alloc in state.allocs():
+            if not alloc.terminal_status():
+                self.nt.add_alloc_usage(alloc)
+
     @staticmethod
     def attach(store: StateStore) -> "TensorIndex":
         """Production mode: subscribe to store changes and stay in sync."""
         idx = TensorIndex()
         idx.attached = True
-        for node in store.nodes():
-            idx.nt.upsert_node(node)
-        for alloc in store.allocs():
-            if not alloc.terminal_status():
-                idx.nt.add_alloc_usage(alloc)
+        idx._seed_from(store)
         # The index object itself is the listener: _emit prefers its
         # on_change_batch; __call__ keeps the per-event contract.
         store.add_change_listener(idx)
@@ -94,12 +100,52 @@ class TensorIndex:
     def from_state(state) -> "TensorIndex":
         """One-shot build from any read API (snapshot) — test/simple mode."""
         idx = TensorIndex()
-        for node in state.nodes():
-            idx.nt.upsert_node(node)
+        idx._seed_from(state)
+        return idx
+
+    def on_restore(self, store) -> None:
+        """Listener hook fired by Restore.commit() after a snapshot
+        restore swapped the store's tables wholesale: the incremental
+        change feed never saw the staged writes, so the tensor rebuilds
+        from the restored world. Row identities change (row_epoch bumps
+        inside reset), forcing in-flight usage chains to rebase."""
+        self.nt.reset()
+        self._seed_from(store)
+
+    def resync_usage(self, state) -> int:
+        """Warm-failover usage re-seed: recompute every node's usage from
+        the replicated store (reserved + live alloc vectors), correct any
+        row that drifted, and reconcile membership (a node the change
+        feed missed is upserted; a departed one is removed). Returns the
+        number of corrected rows — a new leader term calls this before
+        serving so its placement kernels never start on drifted usage."""
+        nt = self.nt
+        nodes = list(state.nodes())
+        live_by_node = {}
         for alloc in state.allocs():
             if not alloc.terminal_status():
-                idx.nt.add_alloc_usage(alloc)
-        return idx
+                live_by_node.setdefault(alloc.NodeID, []).append(alloc)
+        fixed = 0
+        with nt._lock:
+            seen = set()
+            for node in nodes:
+                seen.add(node.ID)
+                if node.ID not in nt.row_of:
+                    nt.upsert_node(node)
+                    fixed += 1
+            for node_id in [n for n in nt.row_of if n not in seen]:
+                nt.remove_node(node_id)
+                fixed += 1
+            for node in nodes:
+                row = nt.row_of[node.ID]
+                expected = resources_vec(node.Reserved).copy()
+                for alloc in live_by_node.get(node.ID, ()):
+                    expected += alloc_vec(alloc)
+                if not np.allclose(nt.usage[row], expected, atol=1e-3):
+                    nt.usage[row] = expected
+                    nt._usage_dirty.add(row)
+                    fixed += 1
+        return fixed
 
     def _on_change(self, kind: str, old, new) -> None:
         if kind == "node":

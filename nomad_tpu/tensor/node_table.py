@@ -236,6 +236,31 @@ class NodeTensor:
             self.row_epoch += 1
             self.node_version += 1
 
+    def reset(self) -> None:
+        """Drop every row in place (a snapshot restore replaced the world
+        and the incremental feed never saw the staged writes). Mirrors are
+        zeroed, all rows freed, and BOTH epochs bump so every derived
+        consumer — usage chains, shared eligibility, cached row-id arrays
+        — rebuilds against the restored population. Mesh/sharding and the
+        vocabularies survive: ids are append-only and stay valid."""
+        with self._lock:
+            self.capacity[:] = 0.0
+            self.score_cap[:] = 1.0
+            self.usage[:] = 0.0
+            self.ready[:] = False
+            self.class_ids[:] = 0
+            self.dc_ids[:] = -1
+            self.row_of.clear()
+            self.node_of = [None] * self.n_rows
+            self._node_id_arr = None
+            self._free = list(range(self.n_rows - 1, -1, -1))
+            self._reserved_cache.clear()
+            self._dirty_rows.clear()
+            self._usage_dirty.clear()
+            self._resized = True  # full re-upload on next device_arrays
+            self.row_epoch += 1
+            self.node_version += 1
+
     def add_alloc_usage(self, alloc: Allocation) -> None:
         self._apply_usage(alloc, +1.0)
 

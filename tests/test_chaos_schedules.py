@@ -713,3 +713,201 @@ job "post-storm" {
         finally:
             client.shutdown()
             srv.shutdown()
+
+
+class TestLeaderFailoverSchedule:
+    """ISSUE 13 tentpole gate: the leader dies mid-storm — including
+    mid-sweep-commit (`state.store.commit` armed at the kill) and
+    mid-snapshot-persist (a streaming persist in flight, every chunk
+    slowed by `raft.snapshot.chunk`) — and failover must be a BOUNDED,
+    measured event: a new leader within the election bound, no lost
+    evals, no duplicate allocs, no oversubscription, per-tier SLO burn
+    bounded through the election, and the survivors' streaming-snapshot
+    machinery still running (a chunked snapshot lands during the storm).
+    """
+
+    N_NODES = 24
+    N_JOBS = 36
+    KILL_AT = 14
+    TIERS = (80, 20, 50)  # round-robin job priorities (high/low/normal)
+
+    def _boot(self, name, join=None):
+        from nomad_tpu.gossip import GossipConfig
+        from nomad_tpu.qos import QoSConfig
+        from nomad_tpu.raft import RaftConfig
+
+        cs = ClusterServer(ServerConfig(
+            node_id="", num_schedulers=1, bootstrap_expect=3,
+            scheduler_window=8,
+            # Election-scale deadlines: the burn bound below asserts the
+            # failover stays well inside them, not that completions are
+            # sub-second on a loaded CI box.
+            qos=QoSConfig(enabled=True, deadlines_s=(10.0, 30.0, 120.0))))
+        cs.connect([], raft_config=RaftConfig(
+            heartbeat_interval=0.02, election_timeout_min=0.08,
+            election_timeout_max=0.16, apply_timeout=5.0,
+            snapshot_threshold=30, trailing_logs=32))
+        cs.start()
+        cs.enable_gossip(name, join=join,
+                         gossip_config=GossipConfig.fast())
+        return cs
+
+    def _cluster(self):
+        from test_cluster_chaos import _gaddr as gaddr
+
+        nodes = [self._boot("f0")]
+        nodes.append(self._boot("f1", join=[gaddr(nodes[0])]))
+        nodes.append(self._boot("f2", join=[gaddr(nodes[0])]))
+        return nodes
+
+    def test_leader_kill_mid_storm_bounded_recovery(self):
+        commit_fired_before = failpoints.snapshot().get(
+            "state.store.commit", {}).get("fired", 0)
+        nodes = self._cluster()
+        live = list(nodes)
+        try:
+            assert wait_for(lambda: leader_of(live) is not None,
+                            timeout=30)
+            for _ in range(self.N_NODES):
+                _rpc_retry(live, "Node.Register",
+                           {"Node": to_dict(mock.node())})
+            jobs = []
+            for i in range(self.N_JOBS):
+                job = make_job()
+                job.Priority = self.TIERS[i % len(self.TIERS)]
+                jobs.append(job)
+            eval_ids = []
+            recovery_s = None
+            with ChaosSchedule(name="leader-failover") \
+                    .arm(0.0, "raft.snapshot.chunk=delay(0.005)") as sched:
+                sched.join(2.0)
+                for i, job in enumerate(jobs):
+                    if i == self.KILL_AT:
+                        # Mid-sweep-commit: the NEXT columnar commit —
+                        # wherever the election leaves it — dies once.
+                        failpoints.arm_from_spec(
+                            "state.store.commit=error:count=1")
+                        victim = leader_of(live)
+                        assert victim is not None
+                        live.remove(victim)
+                        t_kill = time.monotonic()
+                        victim.shutdown()
+                        assert wait_for(
+                            lambda: leader_of(live) is not None,
+                            timeout=30, msg="post-kill election")
+                        recovery_s = time.monotonic() - t_kill
+                    resp = _rpc_retry(live, "Job.Register",
+                                      {"Job": to_dict(job)})
+                    eval_ids.append(resp["EvalID"])
+                    time.sleep(0.01)
+
+                def settled():
+                    ldr = leader_of(live)
+                    return ldr is not None and _all_terminal(
+                        ldr.server.state, eval_ids)
+
+                assert wait_for(settled, timeout=120, interval=0.25,
+                                msg="storm terminal through the election")
+
+            # Bounded recovery: the measured leader gap, not a vibe.
+            assert recovery_s is not None and recovery_s < 30.0, recovery_s
+
+            ldr = leader_of(live)
+            state = ldr.server.state
+            # No lost evals, no duplicate allocs, no oversubscription —
+            # through a leader kill + a killed bulk commit.
+            assert_invariants(state, jobs, per_job=PER_JOB,
+                              eval_ids=eval_ids)
+            assert failpoints.snapshot().get("state.store.commit", {}).get(
+                "fired", 0) - commit_fired_before >= 1, \
+                "the mid-commit fault never landed"
+
+            # Bounded per-tier SLO burn through the election: the new
+            # leader's high tier stayed inside its 10s deadline for at
+            # least half its completions (ages ride the warm re-seed, so
+            # an unbounded election would show up here).
+            burn = ldr.server.eval_broker.slo_burn()
+            assert burn[0] <= 0.5, f"high-tier SLO burn {burn}"
+            assert all(b <= 0.9 for b in burn), burn
+
+            # The storm crossed the streaming-snapshot threshold: the
+            # new leader persisted a CHUNKED snapshot while serving (the
+            # slowed chunk seam fired), and its apply loop kept up.
+            assert failpoints.snapshot().get(
+                "raft.snapshot.chunk", {}).get("fired", 0) >= 1
+            assert wait_for(
+                lambda: leader_of(live) is not None
+                and leader_of(live).server.raft.node.log
+                .latest_snapshot_chunks() is not None,
+                timeout=30, msg="streaming snapshot landed mid-storm")
+        finally:
+            for n in nodes:
+                try:
+                    n.shutdown()
+                except Exception:
+                    pass
+
+    def test_leader_kill_mid_snapshot_persist(self):
+        """The kill lands WHILE the leader is streaming a snapshot to
+        its log store (every chunk slowed, persist forced in a side
+        thread): the cluster must elect, keep serving, and lose nothing
+        — and the dying persist must not wedge shutdown."""
+        import threading as _threading
+
+        nodes = self._cluster()
+        live = list(nodes)
+        try:
+            assert wait_for(lambda: leader_of(live) is not None,
+                            timeout=30)
+            for _ in range(12):
+                _rpc_retry(live, "Node.Register",
+                           {"Node": to_dict(mock.node())})
+            jobs = [make_job() for _ in range(8)]
+            eval_ids = [
+                _rpc_retry(live, "Job.Register",
+                           {"Job": to_dict(job)})["EvalID"]
+                for job in jobs]
+
+            def settled():
+                ldr = leader_of(live)
+                return ldr is not None and _all_terminal(
+                    ldr.server.state, eval_ids)
+
+            assert wait_for(settled, timeout=60, interval=0.1,
+                            msg="pre-kill storm terminal")
+
+            victim = leader_of(live)
+            with ChaosSchedule(name="mid-persist-kill") \
+                    .arm(0.0, "raft.snapshot.chunk=delay(0.03)") as sched:
+                sched.join(2.0)
+                persist = _threading.Thread(
+                    target=victim.server.raft.node.take_snapshot,
+                    name="test-persist", daemon=True)
+                persist.start()
+                time.sleep(0.06)  # a couple of chunks into the stream
+                live.remove(victim)
+                victim.shutdown()
+                persist.join(timeout=30)
+                assert not persist.is_alive(), \
+                    "mid-persist shutdown wedged the snapshot thread"
+                assert wait_for(lambda: leader_of(live) is not None,
+                                timeout=30, msg="post-kill election")
+                post = make_job()
+                post_eval = _rpc_retry(live, "Job.Register",
+                                       {"Job": to_dict(post)})["EvalID"]
+                assert wait_for(
+                    lambda: (ldr := leader_of(live)) is not None
+                    and _all_terminal(ldr.server.state,
+                                      eval_ids + [post_eval]),
+                    timeout=60, interval=0.1,
+                    msg="post-kill job served")
+            ldr = leader_of(live)
+            assert_invariants(ldr.server.state, jobs + [post],
+                              per_job=PER_JOB,
+                              eval_ids=eval_ids + [post_eval])
+        finally:
+            for n in nodes:
+                try:
+                    n.shutdown()
+                except Exception:
+                    pass

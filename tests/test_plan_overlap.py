@@ -251,8 +251,12 @@ class TestContentionStorm:
         try:
             first = queue.enqueue(_make_plan(nodes, 10))
             assert in_flight.wait(20)  # apply #1 parked mid-consensus
-            laters = [queue.enqueue(_make_plan(nodes, 10))
-                      for _ in range(3)]
+            # One ATOMIC window (what workers do): per-plan enqueues let
+            # the applier wake between them, verify a 1-plan group, and
+            # park joining apply #1 with overlapped stuck below 3 — the
+            # last wall-clock-scheduling dependence this test had.
+            laters = queue.enqueue_all([_make_plan(nodes, 10)
+                                        for _ in range(3)])
             # The overlap: with apply #1 still in flight, the next group
             # verifies against the optimistic snapshot.
             assert wait_for(lambda: applier.stats["overlapped"] >= 3,

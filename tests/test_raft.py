@@ -478,3 +478,207 @@ class TestNativeLogStore:
         st = native(str(tmp_path))
         assert st.last_index() > 0
         st.close()
+
+
+class StreamFSM(AppendFSM):
+    """AppendFSM with the streaming-snapshot seam: state streams as
+    bounded chunks (4 entries each), restore stages and cuts over only
+    when the whole stream arrived."""
+
+    CHUNK = 4
+
+    def snapshot_chunks(self):
+        with self.lock:
+            items = list(self.applied)
+
+        def gen():
+            for i in range(0, len(items), self.CHUNK):
+                yield items[i:i + self.CHUNK]
+        return gen()
+
+    def restore_chunks(self, chunks):
+        staged = []
+        for c in chunks:
+            staged.extend(tuple(x) for x in c)
+        with self.lock:
+            self.applied = staged
+
+
+def make_stream_cluster(n, transport=None, configs=None, stores=None):
+    """make_cluster, but every node runs the STREAMING snapshot path
+    (chunked persist thread, chunked InstallSnapshot, staged restore)."""
+    transport = transport or InMemTransport()
+    ids = [f"s{i}" for i in range(n)]
+    nodes, fsms = [], []
+    for i, nid in enumerate(ids):
+        fsm = StreamFSM()
+
+        def restore_stream(raws, fsm=fsm):
+            fsm.restore_chunks(
+                msgpack.unpackb(b, raw=False) for b in raws)
+
+        node = RaftNode(
+            node_id=nid, peers=list(ids),
+            log_store=(stores[i] if stores else InMemLogStore()),
+            transport=BoundTransport(transport, nid),
+            apply_fn=fsm.apply, snapshot_fn=fsm.snapshot,
+            restore_fn=fsm.restore,
+            snapshot_stream_fn=fsm.snapshot_chunks,
+            restore_stream_fn=restore_stream,
+            config=(configs[i] if configs else FAST))
+        nodes.append(node)
+        fsms.append(fsm)
+    for node in nodes:
+        node.start()
+    return transport, nodes, fsms
+
+
+class TestStreamingSnapshot:
+    """ISSUE 13 tentpole: chunked snapshot persist/restore + chunked
+    InstallSnapshot, with the `raft.snapshot.chunk` /
+    `raft.install_snapshot` failpoints proving a torn stream can never
+    tear state."""
+
+    @pytest.fixture(autouse=True)
+    def _heal(self):
+        from nomad_tpu.resilience import failpoints
+        failpoints.disarm_all()
+        yield
+        failpoints.disarm_all()
+
+    def test_streaming_persist_restart_recovers(self, tmp_path):
+        """A chunked snapshot lands on disk in the NTS1 framed format and
+        a restart restores from it chunk-by-chunk."""
+        store = FileLogStore(str(tmp_path / "raft"))
+        _, nodes, fsms = make_stream_cluster(1, stores=[store])
+        try:
+            assert wait_for(lambda: nodes[0].is_leader())
+            for i in range(30):
+                nodes[0].apply_command(cmd(i))
+            snap_index = nodes[0].take_snapshot()
+            assert snap_index > 0
+            chunked = store.latest_snapshot_chunks()
+            assert chunked is not None and chunked[0] == snap_index
+            # Meta chunk + ceil(30/4) data chunks: genuinely streamed.
+            assert len(chunked[2]) >= 8
+            applied = [v for _, v in fsms[0].applied]
+        finally:
+            shutdown_all(nodes)
+        store.close()
+
+        with open(str(tmp_path / "raft" / "snapshot.mp"), "rb") as fh:
+            assert fh.read(4) == b"NTS1"
+        store2 = FileLogStore(str(tmp_path / "raft"))
+        _, nodes2, fsms2 = make_stream_cluster(1, stores=[store2])
+        try:
+            assert wait_for(lambda: nodes2[0].is_leader())
+            assert wait_for(
+                lambda: [v for _, v in fsms2[0].applied] == applied)
+        finally:
+            shutdown_all(nodes2)
+        store2.close()
+
+    def test_torn_chunk_stream_keeps_previous_snapshot(self, tmp_path):
+        """`raft.snapshot.chunk` drop = torn persist stream: the persist
+        aborts wholesale, the PREVIOUS snapshot stays intact on disk and
+        in memory, the log is NOT truncated, and the re-armed threshold
+        retries once healed."""
+        from nomad_tpu.resilience import failpoints
+
+        store = FileLogStore(str(tmp_path / "raft"))
+        _, nodes, fsms = make_stream_cluster(1, stores=[store])
+        try:
+            assert wait_for(lambda: nodes[0].is_leader())
+            for i in range(10):
+                nodes[0].apply_command(cmd(i))
+            first_snap = nodes[0].take_snapshot()
+            assert first_snap > 0
+            before = store.latest_snapshot_chunks()
+            with open(str(tmp_path / "raft" / "snapshot.mp"), "rb") as fh:
+                disk_before = fh.read()
+
+            for i in range(10, 20):
+                nodes[0].apply_command(cmd(i))
+            fired_before = failpoints.snapshot().get(
+                "raft.snapshot.chunk", {}).get("fired", 0)
+            failpoints.arm_from_spec("raft.snapshot.chunk=drop:count=1")
+            first_idx = nodes[0].log.first_index()
+            torn = nodes[0].take_snapshot()
+            # The persist aborted: snapshot index unmoved, prior chunked
+            # snapshot intact in memory AND on disk, log kept.
+            assert torn == first_snap
+            assert store.latest_snapshot_chunks() == before
+            with open(str(tmp_path / "raft" / "snapshot.mp"), "rb") as fh:
+                assert fh.read() == disk_before
+            assert nodes[0].log.first_index() == first_idx
+            assert failpoints.snapshot()["raft.snapshot.chunk"][
+                "fired"] - fired_before == 1
+
+            # Healed (count=1 self-disarmed): the next persist lands.
+            healed = nodes[0].take_snapshot()
+            assert healed > first_snap
+            assert store.latest_snapshot_chunks()[0] == healed
+        finally:
+            shutdown_all(nodes)
+        store.close()
+
+    def test_snapshot_file_corruption_discarded_not_restored(self,
+                                                             tmp_path):
+        """Bit rot in a published chunked snapshot file fails the CRC and
+        the whole snapshot is DISCARDED at load — boot falls back to log
+        replay rather than restoring garbage."""
+        import os
+        path = str(tmp_path / "raft")
+        store = FileLogStore(path)
+        snap_file = os.path.join(path, "snapshot.mp")
+        store.store_snapshot_chunks(
+            5, 1, [msgpack.packb((5, 1)), b"chunk-a", b"chunk-b"])
+        assert store.latest_snapshot_chunks() is not None
+        store.close()
+        with open(snap_file, "r+b") as fh:
+            fh.seek(-2, 2)
+            fh.write(b"\xff")
+        store2 = FileLogStore(path)
+        assert store2.latest_snapshot_chunks() is None
+        assert store2.latest_snapshot() is None
+        store2.close()
+
+    def test_chunked_install_snapshot_catches_up_lagger(self):
+        """A follower behind a compacted log catches up through the
+        SEQUENCE of bounded InstallSnapshot RPCs — including surviving a
+        dropped chunk hop (`raft.install_snapshot`), which must restart
+        the stream rather than install a partial snapshot."""
+        from nomad_tpu.resilience import failpoints
+
+        cfgs = [RaftConfig(heartbeat_interval=0.02,
+                           election_timeout_min=0.06,
+                           election_timeout_max=0.12,
+                           snapshot_threshold=10, trailing_logs=2)
+                for _ in range(3)]
+        transport, nodes, fsms = make_stream_cluster(3, configs=cfgs)
+        try:
+            assert wait_for(lambda: leader_of(nodes) is not None)
+            leader = leader_of(nodes)
+            lag = [n for n in nodes if n is not leader][0]
+            transport.take_down(lag.id)
+            for i in range(30):
+                leader.apply_command(cmd(i))
+            leader.take_snapshot()
+            assert leader.log.first_index() > 1
+            assert leader.log.latest_snapshot_chunks() is not None
+            # One chunk hop of the install stream is black-holed: the
+            # follower's staged stream must go stale and restart, never
+            # install partially.
+            failpoints.arm_from_spec("raft.install_snapshot=drop:count=1")
+            transport.bring_up(lag.id)
+            fsm = fsms[nodes.index(lag)]
+            assert wait_for(
+                lambda: [v for _, v in fsm.applied][-1:] == [29],
+                timeout=15)
+            assert failpoints.snapshot()[
+                "raft.install_snapshot"]["fired"] >= 1
+            # Exactly the stream's content, in order, nothing doubled.
+            vals = [v for _, v in fsm.applied]
+            assert vals == sorted(set(vals))
+        finally:
+            shutdown_all(nodes)

@@ -128,6 +128,24 @@ FAILOVER_NODES = int(os.environ.get("BENCH_FAILOVER_NODES", 96))
 FAILOVER_JOBS = int(os.environ.get("BENCH_FAILOVER_JOBS", 90))
 FAILOVER_PER_JOB = int(os.environ.get("BENCH_FAILOVER_PER_JOB", 4))
 RUN_FAILOVER = os.environ.get("BENCH_FAILOVER", "1") != "0"
+# config7_federation (bench_federation_storm, ISSUE 14): a mixed-priority
+# storm CONCENTRATED in one region of a real 3-region federated cluster
+# (gossip + cross-region forwarding + follower-snapshot workers + per-
+# region QoS), A/B'd against the all-on-leader baseline — ONE region
+# holding the same total fleet, the same total storm, and the same total
+# worker count on a single leader (the pre-federation shape the tentpole
+# scales out). Reps interleaved with ALTERNATING within-pair order,
+# max-of-reps (this box's cgroup quota punishes whoever runs second).
+# Records per-region evals/s, cross-region forward p99, per-region
+# high-tier p99. Parity-style exit-2 gate: zero lost evals, no duplicate
+# allocs, storm-free regions' high-tier p99 within the high SLO
+# deadline, and the federated side actually sharing snapshots.
+FED_NODES = int(os.environ.get("BENCH_FED_NODES", 48))    # per region
+FED_JOBS = int(os.environ.get("BENCH_FED_JOBS", 48))      # storm region
+FED_QUIET_HIGH = int(os.environ.get("BENCH_FED_QUIET_HIGH", 6))
+FED_PER_JOB = int(os.environ.get("BENCH_FED_PER_JOB", 4))
+FED_REPS = int(os.environ.get("BENCH_FED_REPS", 3))
+RUN_FED = os.environ.get("BENCH_FED", "1") != "0"
 
 
 def _apply_smoke():
@@ -142,6 +160,7 @@ def _apply_smoke():
     global SLO_NODES, SLO_LOW, SLO_HIGH, SLO_REPS
     global SVC_AB_NODES, SVC_AB_EVALS, SVC_AB_REPS, RUN_MESH
     global FAILOVER_NODES, FAILOVER_JOBS
+    global FED_NODES, FED_JOBS, FED_QUIET_HIGH, FED_REPS
     N_NODES = min(N_NODES, 512)
     N_PLACEMENTS = min(N_PLACEMENTS, 2000)   # 40 evals @ PER_EVAL=50
     N_REPS = min(N_REPS, 3)
@@ -179,6 +198,17 @@ def _apply_smoke():
     # full 90-job storm is the slow-gated shape. A few seconds.
     FAILOVER_NODES = min(FAILOVER_NODES, 24)
     FAILOVER_JOBS = min(FAILOVER_JOBS, 24)
+    # The federation storm STAYS on at smoke scale: its zero-loss /
+    # no-duplicate / quiet-region-p99 gate is the only bench-side check
+    # of the cross-region forwarding + follower-snapshot path. A few
+    # seconds of budget (4 single-raft servers, tiny storms).
+    FED_NODES = min(FED_NODES, 12)
+    # >= 4 windows of backlog in the storm region (window=8): snapshot
+    # REUSE only exists once dequeues stop chasing fresh registrations,
+    # and the gate requires proving it happened.
+    FED_JOBS = min(FED_JOBS, 27)
+    FED_QUIET_HIGH = min(FED_QUIET_HIGH, 3)
+    FED_REPS = min(FED_REPS, 2)
     # The 1M mesh A/B is slow-gated OUT of smoke (its subprocess compile
     # alone blows the budget); the mesh path's correctness coverage is
     # tier-1 (equivalence gate + collective audit + chaos schedule).
@@ -995,6 +1025,432 @@ def bench_failover_storm():
                 pass
 
 
+def bench_federation_storm():
+    """config7_federation (ISSUE 14): a mixed-priority storm concentrated
+    in ONE region of a real 3-region federated cluster — cross-region
+    forwarding at ingress (two thirds of the storm arrives through the
+    other regions' edges), follower-snapshot workers, per-region QoS —
+    A/B'd against the all-on-leader baseline: the SAME three servers as
+    ONE global raft domain (the pre-federation config5_multidc shape),
+    where every commit replicates through one consensus group and every
+    worker, commit, and watch rides its single leader. Same total
+    fleet, same job multiset, same server count — the delta is the
+    topology: region-local authority vs global consensus. Reps
+    interleaved with ALTERNATING within-pair order, max-of-reps on
+    total evals/s.
+
+    Records per-region evals/s, cross-region forward latency
+    percentiles, and per-region high-tier submit->terminal p99. Gate
+    (exit-2, fail-after-emit like placement parity): zero lost evals,
+    zero duplicate allocs, every job at exactly its asked-for live
+    allocs in its HOME region only, the storm-free regions' high-tier
+    p99 within the high SLO deadline, and the federated side proving it
+    actually shared snapshots (SnapshotSource reuse > 0)."""
+    from nomad_tpu import mock
+    from nomad_tpu.federation import FederationConfig
+    from nomad_tpu.gossip import GossipConfig
+    from nomad_tpu.qos import QoSConfig
+    from nomad_tpu.qos.admission import QoSBackpressureError
+    from nomad_tpu.raft import RaftConfig
+    from nomad_tpu.rpc.cluster import ClusterServer
+    from nomad_tpu.server import ServerConfig
+    from nomad_tpu.structs import to_dict
+    from nomad_tpu.structs.structs import (
+        EvalStatusCancelled,
+        EvalStatusComplete,
+        EvalStatusFailed,
+    )
+
+    terminal = (EvalStatusComplete, EvalStatusFailed, EvalStatusCancelled)
+    raft_cfg = RaftConfig(heartbeat_interval=0.02,
+                          election_timeout_min=0.08,
+                          election_timeout_max=0.16, apply_timeout=5.0)
+    # Election-free storm, but a throttled bench box: election-free
+    # deadlines would burn the high ring on compute alone. The quiet
+    # regions are gated against deadlines_s[0].
+    deadlines = (5.0, 15.0, 60.0)
+    storm_region = "east"
+    quiet_regions = ("west", "north")
+    regions = (storm_region,) + quiet_regions
+    tiers = (80, 20, 50)
+
+    def gaddr(cs):
+        ml = cs.membership.memberlist
+        return f"{ml.addr}:{ml.port}"
+
+    def boot(name, region, n_workers, fed, expect=1, join=None):
+        cs = ClusterServer(ServerConfig(
+            node_id="", region=region, num_schedulers=n_workers,
+            scheduler_window=8, bootstrap_expect=expect,
+            # Mock nodes never heartbeat; multi-minute A/B reps must not
+            # watch the fleet expire mid-rep (same treatment as every
+            # standalone served bench).
+            min_heartbeat_ttl=24 * 3600.0, heartbeat_grace=24 * 3600.0,
+            # DEVICE chain on both sides: N-worker overlap is a property
+            # of the device-chained architecture (async dispatch +
+            # GIL-releasing fetches); the host-numpy fallback would
+            # swallow every window into GIL-bound Python where the
+            # leader's 3 workers and the federation's 3 regions can only
+            # ever tie (same treatment as the worker_scaling sweep).
+            host_placement=False,
+            # Tiered queues + per-region SLO tracking ON; burn-shed
+            # disarmed (burn can never exceed 1.0): warmup compiles blow
+            # tier deadlines and would poison the burn ring into
+            # shedding the first timed rep. The shed paths have their
+            # own gates (tests/test_federation.py, slo_storm's probes).
+            qos=QoSConfig(enabled=True, deadlines_s=deadlines,
+                          burn_shed=1.1),
+            federation=fed))
+        cs.connect([], raft_config=raft_cfg)
+        cs.start()
+        cs.enable_gossip(name, join=join,
+                         gossip_config=GossipConfig.fast())
+        return cs
+
+    class _Edge:
+        """One federated region server as a submission/read target."""
+
+        def __init__(self, cs):
+            self.cs = cs
+
+        def handle(self, method, body):
+            return self.cs.endpoints.handle(method, body)
+
+        def eval_by_id(self, eid):
+            return self.cs.server.state.eval_by_id(eid)
+
+        def allocs_by_job(self, job_id):
+            return self.cs.server.state.allocs_by_job(job_id)
+
+    class _Domain:
+        """The baseline's 3-server raft domain as the same target shape:
+        submits retry across servers (an election mid-storm is the
+        domain's problem, not the client's), reads go to the current
+        leader's replicated store."""
+
+        def __init__(self, servers):
+            self.servers = servers
+
+        def leader(self):
+            for cs in self.servers:
+                try:
+                    if (cs.server is not None and cs.server.is_leader()
+                            and cs.server._leader):
+                        return cs
+                except Exception:
+                    pass
+            return None
+
+        def handle(self, method, body, attempts=150, delay=0.05):
+            # The failover bench's retry shape: any server may answer;
+            # an election or in-flight leader hop retries (backpressure
+            # included — submit() counts it via its own layer when the
+            # edge is a single region server; here the pooled domain
+            # just keeps trying, which is what a real client pool does).
+            last = None
+            for _ in range(attempts):
+                targets = list(self.servers)
+                random.shuffle(targets)
+                for cs in targets:
+                    try:
+                        return cs.endpoints.handle(method, dict(body))
+                    except Exception as exc:
+                        last = exc
+                time.sleep(delay)
+            raise last if last is not None \
+                else RuntimeError("no servers")
+
+        def eval_by_id(self, eid):
+            ldr = self.leader()
+            return None if ldr is None \
+                else ldr.server.state.eval_by_id(eid)
+
+        def allocs_by_job(self, job_id):
+            ldr = self.leader()
+            return [] if ldr is None \
+                else ldr.server.state.allocs_by_job(job_id)
+
+    def submit(edge, job, attempts=40):
+        """One registration through a submission target; a QoS/remote-
+        shed 429 — raised locally at the edge or crossing the forward
+        wire as a typed RPCError — retries like the API client would
+        (shed is backpressure, not loss)."""
+        from nomad_tpu.rpc.pool import RPCError
+
+        sheds = 0
+        for _ in range(attempts):
+            try:
+                return edge.handle(
+                    "Job.Register", {"Job": to_dict(job)}), sheds
+            except QoSBackpressureError:
+                sheds += 1
+            except RPCError as exc:
+                if exc.remote_type != "QoSBackpressureError":
+                    raise
+                sheds += 1
+            time.sleep(0.1)
+        raise RuntimeError("registration shed past retry budget")
+
+    sides = {}
+    all_servers = []
+    out = {"regions": list(regions), "nodes_per_region": FED_NODES,
+           "storm_jobs": FED_JOBS, "quiet_high_jobs": FED_QUIET_HIGH,
+           "per_job": FED_PER_JOB, "reps": FED_REPS,
+           "high_deadline_s": deadlines[0]}
+    try:
+        # ---- boot both sides (live simultaneously, like every A/B here)
+        fed_nodes = {}
+        # Staleness bound matched to this box's window cadence (~0.3s a
+        # window on the throttled CPU, with multi-hundred-ms GC/noise
+        # stalls between them): the source must plausibly serve two
+        # consecutive windows or the "shared snapshot" side degrades to
+        # a fresh pin per window. reject_after_s scales with it.
+        fed_cfg = dict(enabled=True, max_staleness_s=1.5,
+                       reject_after_s=10.0)
+        first = boot("fed-east", storm_region, 1,
+                     FederationConfig(**fed_cfg))
+        fed_nodes[storm_region] = first
+        for r in quiet_regions:
+            fed_nodes[r] = boot(f"fed-{r}", r, 1,
+                                FederationConfig(**fed_cfg),
+                                join=[gaddr(first)])
+        all_servers.extend(fed_nodes.values())
+        sides["federated"] = {r: _Edge(cs)
+                              for r, cs in fed_nodes.items()}
+        # The all-on-leader baseline: the SAME THREE SERVERS as one
+        # global raft domain — every commit replicates to two followers
+        # over real RPC, all workers run on whichever server leads.
+        base_servers = [boot("base-0", storm_region, len(regions),
+                             None, expect=len(regions))]
+        for i in (1, 2):
+            base_servers.append(boot(f"base-{i}", storm_region,
+                                     len(regions), None,
+                                     expect=len(regions),
+                                     join=[gaddr(base_servers[0])]))
+        all_servers.extend(base_servers)
+        domain = _Domain(base_servers)
+        sides["leader"] = {storm_region: domain}
+        for cs in fed_nodes.values():
+            deadline = time.monotonic() + 30
+            while not cs.server.is_leader():
+                if time.monotonic() > deadline:
+                    raise RuntimeError("region never elected")
+                time.sleep(0.02)
+        deadline = time.monotonic() + 30
+        while domain.leader() is None:
+            if time.monotonic() > deadline:
+                raise RuntimeError("baseline domain never elected")
+            time.sleep(0.02)
+        # Gossip convergence: every federated region must know the rest
+        # before the first cross-region forward.
+        deadline = time.monotonic() + 30
+        while any(
+                not fed_nodes[r].membership.region_servers(other)
+                for r in regions for other in regions if other != r):
+            if time.monotonic() > deadline:
+                raise RuntimeError("regions never converged")
+            time.sleep(0.05)
+        # ---- fleets: each region its own; the baseline domain ALL of it
+        for r in regions:
+            for node in build_nodes(FED_NODES):
+                fed_nodes[r].endpoints.handle(
+                    "Node.Register", {"Node": to_dict(node)})
+        for node in build_nodes(FED_NODES * len(regions)):
+            domain.handle("Node.Register", {"Node": to_dict(node)})
+
+        def storm_plan(side):
+            """The rep's job multiset: (job, home region, edge server).
+            Same shapes/priorities on both sides; the baseline's home is
+            always its one region and every submit is local."""
+            cluster = sides[side]
+            fed = side == "federated"
+            plan = []
+            for i in range(FED_JOBS):
+                job = build_job(FED_PER_JOB)
+                job.Priority = tiers[i % len(tiers)]
+                home = storm_region
+                edge = regions[i % len(regions)] if fed else storm_region
+                job.Region = home if fed else ""
+                plan.append((job, home, cluster[edge], cluster[home]))
+            for r in quiet_regions:
+                home = r if fed else storm_region
+                for _ in range(FED_QUIET_HIGH):
+                    job = build_job(FED_PER_JOB)
+                    job.Priority = 80
+                    job.Region = home if fed else ""
+                    plan.append((job, home,
+                                 cluster[home], cluster[home]))
+            return plan
+
+        def run_rep(side, fwd_lats, tier_lats, shed_count):
+            """Submit one full storm CONCURRENTLY (one submitter lane
+            per edge server — wire hops overlap scheduling, as real
+            clients would — the same lane count on both sides), drain
+            it, and return (total_rate, per_region_rate, rep_checks)."""
+            import threading as _threading
+
+            plan = storm_plan(side)
+            # Same submit concurrency on BOTH sides (3 client lanes);
+            # only the fed side's entries carry cross-region edges.
+            lanes: dict = {}
+            for i, entry in enumerate(plan):
+                lanes.setdefault(i % len(regions), []).append(entry)
+            submit_t, eval_home, eval_meta = {}, {}, {}
+            meta_lock = _threading.Lock()
+
+            def lane(entries):
+                for job, home, edge, home_cs in entries:
+                    ts = time.monotonic()
+                    resp, sheds = submit(edge, job)
+                    now = time.monotonic()
+                    with meta_lock:
+                        shed_count[0] += sheds
+                        if edge is not home_cs:
+                            fwd_lats.append(now - ts)
+                        eid = resp["EvalID"]
+                        submit_t[eid] = ts
+                        eval_home[eid] = home
+                        eval_meta[eid] = (job, home_cs)
+
+            t0 = time.monotonic()
+            threads = [_threading.Thread(target=lane, args=(ents,),
+                                         name=f"fed-submit-{i}")
+                       for i, ents in enumerate(lanes.values())]
+            for t in threads:
+                t.start()
+            lat, done_at = {}, {}
+            deadline = time.monotonic() + 180
+            while time.monotonic() < deadline:
+                now = time.monotonic()
+                with meta_lock:
+                    pending = [(eid, meta)
+                               for eid, meta in eval_meta.items()
+                               if eid not in lat]
+                for eid, (job, home_cs) in pending:
+                    ev = home_cs.eval_by_id(eid)
+                    if ev is not None and ev.Status in terminal:
+                        lat[eid] = now - submit_t[eid]
+                        done_at[eid] = now
+                if (not any(t.is_alive() for t in threads)
+                        and len(lat) == len(eval_meta)):
+                    break
+                time.sleep(0.02)
+            for t in threads:
+                t.join(timeout=10)
+            t_total = (max(done_at.values()) - t0) if done_at else 0.0
+            lost = len(submit_t) - len(lat)
+            per_region = {}
+            for eid in lat:
+                per_region.setdefault(eval_home[eid], 0)
+                per_region[eval_home[eid]] += 1
+                job, home_cs = eval_meta[eid]
+                tier_lats.setdefault(eval_home[eid], {}).setdefault(
+                    job.Priority, []).append(lat[eid])
+            placed, dup, misplaced, all_ids = 0, 0, 0, set()
+            for eid, (job, home_cs) in eval_meta.items():
+                live = [a for a in home_cs.allocs_by_job(job.ID)
+                        if not a.terminal_status()]
+                placed += len(live)
+                for a in live:
+                    if a.ID in all_ids:
+                        dup += 1
+                    all_ids.add(a.ID)
+                if side == "federated":
+                    for r, cs in fed_nodes.items():
+                        if r != eval_home[eid] \
+                                and cs.server.state.job_by_id(job.ID):
+                            misplaced += 1
+            checks = {"lost": lost, "dup": dup, "placed": placed,
+                      "expected": len(plan) * FED_PER_JOB,
+                      "misplaced": misplaced}
+            rate = round(len(lat) / t_total, 2) if t_total else 0.0
+            rates_r = {r: round(n / t_total, 2) if t_total else 0.0
+                       for r, n in sorted(per_region.items())}
+            return rate, rates_r, checks
+
+        # ---- warm both sides (compile/caches), then interleaved reps
+        for side in ("federated", "leader"):
+            run_rep(side, [], {}, [0])
+        _tune_gc()
+        rates = {"federated": [], "leader": []}
+        region_rates = {"federated": [], "leader": []}
+        fwd_lats, shed_count = [], [0]
+        tier_lats = {"federated": {}, "leader": {}}
+        checks_all = []
+        for rep in range(FED_REPS):
+            order = (("federated", "leader") if rep % 2 == 0
+                     else ("leader", "federated"))
+            for side in order:
+                rate, rates_r, checks = run_rep(
+                    side, fwd_lats if side == "federated" else [],
+                    tier_lats[side], shed_count)
+                rates[side].append(rate)
+                region_rates[side].append(rates_r)
+                checks["side"] = side
+                checks_all.append(checks)
+                _freeze_heap()
+
+        def tier_pct(side):
+            name = {80: "high", 20: "low", 50: "normal"}
+            return {r: {name[p]: _pctiles_ms(v)
+                        for p, v in sorted(by_prio.items())}
+                    for r, by_prio in sorted(tier_lats[side].items())}
+
+        fed_srcs = {r: cs.server.fed_source.stats()
+                    for r, cs in fed_nodes.items()}
+        quiet_p99 = max(
+            float(np.percentile(
+                tier_lats["federated"].get(r, {}).get(80) or [0.0], 99))
+            for r in quiet_regions)
+        lost = sum(c["lost"] for c in checks_all)
+        dup = sum(c["dup"] for c in checks_all)
+        misplaced = sum(c["misplaced"] for c in checks_all)
+        placed_ok = all(c["placed"] == c["expected"] for c in checks_all)
+        reused = sum(s["Reused"] for s in fed_srcs.values())
+        out.update({
+            "federated": {
+                "evals_sec": max(rates["federated"]),
+                "rep_rates": rates["federated"],
+                "per_region_evals_sec": region_rates["federated"],
+                "tier_latency_ms": tier_pct("federated"),
+                "snapshot_sources": fed_srcs,
+                "forward_latency_ms": _pctiles_ms(fwd_lats),
+                "forwards": len(fwd_lats),
+                "backpressure_sheds": shed_count[0],
+            },
+            "leader": {
+                "evals_sec": max(rates["leader"]),
+                "rep_rates": rates["leader"],
+                "tier_latency_ms": tier_pct("leader"),
+            },
+            "speedup": (speedup := (round(max(rates["federated"])
+                                          / max(rates["leader"]), 3)
+                                    if max(rates["leader"]) else None)),
+            "quiet_high_p99_ms": round(quiet_p99 * 1e3, 2),
+            "gate": {
+                "ok": (lost == 0 and dup == 0 and misplaced == 0
+                       and placed_ok and reused > 0
+                       and quiet_p99 <= deadlines[0]
+                       and speedup is not None and speedup >= 1.0),
+                "lost_evals": lost,
+                "duplicate_allocs": dup,
+                "misplaced_jobs": misplaced,
+                "placed_ok": placed_ok,
+                "snapshot_reuse": reused,
+                "quiet_high_p99_within_slo": quiet_p99 <= deadlines[0],
+                "beats_all_on_leader": speedup is not None
+                and speedup >= 1.0,
+            },
+        })
+        return out
+    finally:
+        for cs in all_servers:
+            try:
+                cs.shutdown()
+            except Exception:
+                pass
+
+
 def build_plain_job(per_eval=PER_EVAL):
     """BASELINE config 2's shape: resource-only bin-packing, no constraint
     checkers at all."""
@@ -1782,6 +2238,13 @@ def main(argv=None):
     if RUN_FAILOVER:
         detail["failover_storm"] = (failover := bench_failover_storm())
 
+    # config7_federation: 3-region federated storm vs the all-on-leader
+    # baseline, zero-loss / no-duplicate / quiet-region-SLO gated.
+    fed_storm = None
+    if RUN_FED:
+        detail["config7_federation"] = (fed_storm :=
+                                        bench_federation_storm())
+
     detail["placement_parity"] = (parity := bench_placement_parity())
 
     result = {
@@ -1816,6 +2279,15 @@ def main(argv=None):
         # never lose or duplicate work. Same fail-after-emit contract.
         sys.stderr.write(
             f"FAILOVER STORM GATE FAILED: {json.dumps(failover)}\n")
+        sys.exit(2)
+    if fed_storm is not None and not fed_storm["gate"]["ok"]:
+        # Federation gate: forwarding/routing may add hops but must
+        # never lose or duplicate work, a quiet region's high tier must
+        # hold its SLO through another region's storm, and the
+        # follower-snapshot source must actually be exercised. Same
+        # fail-after-emit contract.
+        sys.stderr.write(
+            f"FEDERATION STORM GATE FAILED: {json.dumps(fed_storm)}\n")
         sys.exit(2)
     svc_store = store["service_window"]
     if (svc_store["storm_group"]["commit_speedup"] or 0) < STORE_SVC_GATE:

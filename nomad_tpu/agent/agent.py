@@ -56,6 +56,10 @@ class AgentConfig:
     # QoS knobs (server { qos { ... } }), materialized into a QoSConfig
     # at server boot; {} / enabled=false leaves QoS off.
     qos: Dict[str, Any] = field(default_factory=dict)
+    # Federation knobs (server { federation { ... } }), materialized
+    # into a FederationConfig at server boot; {} / enabled=false leaves
+    # federation off (README "Federation").
+    federation: Dict[str, Any] = field(default_factory=dict)
     node_class: str = ""
     meta: Dict[str, str] = field(default_factory=dict)
     options: Dict[str, str] = field(default_factory=dict)
@@ -110,6 +114,22 @@ def _qos_from_config(raw: Dict[str, Any]):
         if tuple_key in kwargs:
             kwargs[tuple_key] = tuple(kwargs[tuple_key])
     return QoSConfig(**kwargs)
+
+
+def _federation_from_config(raw: Dict[str, Any]):
+    """Materialize the server{federation{...}} dict into a
+    FederationConfig (None when absent — federation off). Unknown keys
+    fail loudly at boot, same contract as the qos block."""
+    if not raw:
+        return None
+    from nomad_tpu.federation import FederationConfig
+
+    known = {f for f in FederationConfig.__dataclass_fields__}
+    unknown = set(raw) - known
+    if unknown:
+        raise ValueError(
+            f"unknown federation config keys: {sorted(unknown)}")
+    return FederationConfig(**raw)
 
 
 class LogRing(logging.Handler):
@@ -261,6 +281,7 @@ class Agent:
             pipelined_scheduling=self.config.pipelined_scheduling,
             scheduler_mesh=self.config.scheduler_mesh,
             qos=_qos_from_config(self.config.qos),
+            federation=_federation_from_config(self.config.federation),
             dev_mode=True,
         )
         self.server = Server(sconf)
@@ -282,6 +303,7 @@ class Agent:
             pipelined_scheduling=self.config.pipelined_scheduling,
             scheduler_mesh=self.config.scheduler_mesh,
             qos=_qos_from_config(self.config.qos),
+            federation=_federation_from_config(self.config.federation),
             bootstrap_expect=self.config.bootstrap_expect,
         )
         self.cluster = ClusterServer(sconf, bind_addr=self.config.bind_addr,

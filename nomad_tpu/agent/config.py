@@ -88,6 +88,10 @@ def config_from_dict(data: dict) -> AgentConfig:
     # passed through as a plain dict and materialized into a QoSConfig by
     # the agent (README "QoS & SLO serving" documents each knob).
     cfg.qos = dict(server.get("qos") or {})
+    # Federation knobs (server { federation { enabled = true
+    # max_staleness_s = 0.25 ... } }); same pass-through contract —
+    # unknown keys fail at server boot (README "Federation").
+    cfg.federation = dict(server.get("federation") or {})
 
     telemetry = data.get("telemetry") or {}
     cfg.statsd_addr = telemetry.get("statsd_address", cfg.statsd_addr)

@@ -836,8 +836,22 @@ def route(agent, method: str, path: str, query, get_body):
         col_stats = getattr(state, "columnar_stats", None)
         if col_stats is not None:
             store_out = col_stats()
+        # Federation block: local snapshot-source behavior (reuse vs
+        # refresh, current age), parked foreign-region evals, and the
+        # polled per-region health view (README "Federation").
+        fed_out: Dict[str, Any] = {"Enabled": False}
+        if getattr(srv, "fed_health", None) is not None:
+            fed_out = {
+                "Enabled": True,
+                "Region": srv.config.region,
+                "Snapshots": (srv.fed_source.stats()
+                              if srv.fed_source is not None else None),
+                "ForeignParked": srv.eval_broker.foreign_count(),
+                "Regions": srv.fed_health.snapshot(),
+            }
         return {"Workers": workers, "ByWorker": by_worker,
-                "Totals": totals, "QoS": qos_out, "Store": store_out}, None
+                "Totals": totals, "QoS": qos_out, "Store": store_out,
+                "Federation": fed_out}, None
 
     if path == "/v1/agent/metrics":
         # In-memory telemetry snapshot (reference shape: go-metrics

@@ -1,0 +1,33 @@
+"""Federated multi-region scheduling (ISSUE 14 / ROADMAP item 3).
+
+Three layers over the single-leader serving pipeline (README
+"Federation"):
+
+1. **Follower-snapshot scheduling** (snapshots.py) — workers place
+   against staleness-bounded shared snapshots of their LOCAL replica
+   instead of all pinning fresh watermarks on the leader's live store;
+   the plan applier's optimistic re-verification (plus an explicit
+   staleness reject) keeps the Omega model sound across replicas.
+2. **Region-local placement + cross-region forwarding** (routing.py) —
+   each region is its own raft domain with its own node table and
+   TensorIndex; a job whose Region differs forwards at ingress, before
+   any raft write, through a retrying/breaker-guarded/deduped WAN hop.
+3. **Federated QoS** (qos.py) — per-region tier queues with a polled
+   global admission/SLO-burn view, so one region's storm sheds in ITS
+   region and cross-region forwards into a shedding region bounce at
+   the local edge.
+
+Everything is behind ``ServerConfig(federation=FederationConfig(
+enabled=True))``; the default (None) path is bit-identical to the
+pre-federation pipeline (tests/test_federation_equivalence.py).
+"""
+
+from .config import FederationConfig, federation_enabled  # noqa: F401
+from .qos import FederationHealth, health_payload  # noqa: F401
+from .routing import (  # noqa: F401
+    FORWARD_DEDUPED,
+    ForwardDedup,
+    NoRegionPathError,
+    RegionForwarder,
+)
+from .snapshots import SnapshotSource, StaleSnapshotError  # noqa: F401

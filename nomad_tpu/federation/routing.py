@@ -1,0 +1,277 @@
+"""Cross-region forwarding, hardened (ISSUE 14 satellite).
+
+The original ``Endpoints._forward_region`` was a single raw
+``pool.call`` to one random peer of the target region: a dead WAN link
+meant the submitter ate a raw ConnError, a dead peer was re-picked on
+every call, and a response lost AFTER delivery could not be retried
+safely (a replayed Job.Register would mint a duplicate evaluation).
+
+:class:`RegionForwarder` fixes all three per the repo's resilience
+conventions:
+
+- **RetryPolicy** drives the attempt loop (decorrelated jitter, bounded
+  attempts) across the region's peer set — a different peer per attempt
+  when gossip knows more than one.
+- A per-peer **CircuitBreaker** (the rpcproxy quarantine pattern,
+  resilience/retry.py) sidelines a dead region server so it costs one
+  probe per reset window instead of one timeout per forward.
+- Every forwarded WRITE is stamped with a ``ForwardID``; the receiving
+  region's :class:`ForwardDedup` replays the stored response for a
+  retried ID instead of re-executing the handler — so the ambiguous
+  failure (request delivered, response lost on the WAN) retries to
+  EXACTLY-ONCE registration, no duplicate evals. The cache is
+  in-memory/best-effort by design: it converts the *common* retry race
+  into exactly-once; a simultaneous receiving-leader failover falls back
+  to at-least-once, which the broker's per-job serialization and the
+  duplicate-blocked-eval reaper already tolerate.
+
+Failure seam ``rpc.forward_region`` (KNOWN_SITES): ``error`` = link
+failed before the request left (safe retry), ``delay`` = slow WAN hop,
+``drop`` = request DELIVERED but the response black-holed — the
+ambiguous half that exercises the dedupe path. The chaos schedule in
+tests/test_chaos_schedules.py kills a region link mid-forward and
+asserts exactly-once registration.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import OrderedDict
+from typing import Any, Callable, Dict, List, Optional
+
+from nomad_tpu.analysis import guarded_by
+from nomad_tpu.resilience import failpoints
+from nomad_tpu.resilience.retry import Backoff, CircuitBreaker, RetryPolicy
+from nomad_tpu.structs import generate_uuid
+from nomad_tpu.telemetry import metrics
+
+from .config import FederationConfig
+
+# Writes that may be replayed by a forward retry and must therefore
+# dedupe on the receiving side (reads are naturally idempotent).
+FORWARD_DEDUPED = frozenset({
+    "Job.Register", "Job.Deregister", "Job.Evaluate", "Periodic.Force",
+})
+
+# Bounded replay memory on the receiving side. A retry lands within the
+# forwarder's attempt loop (seconds); 4096 entries is hours of headroom
+# at any realistic cross-region write rate.
+_DEDUP_CAP = 4096
+
+
+class ForwardDedup:
+    """Receiving-side replay cache: ForwardID -> stored response.
+
+    Entries are two-state: IN-PROGRESS (the first delivery is still
+    executing its handler — a `threading.Event` parks replays) and DONE
+    (response stored). The in-progress state closes the race the cache
+    exists for: a retry whose original request is STILL running (the WAN
+    broke after delivery, the retry landed before the raft apply
+    finished) must wait for that execution's answer, not start a second
+    concurrent one."""
+
+    _concurrency = guarded_by("_lock", "_seen")
+
+    # Sentinel wrapper so a stored None response is distinguishable from
+    # an in-progress event.
+    class _Running:
+        __slots__ = ("event",)
+
+        def __init__(self):
+            self.event = threading.Event()
+
+    def __init__(self, cap: int = _DEDUP_CAP):
+        self._lock = threading.Lock()
+        self._seen: "OrderedDict[str, Any]" = OrderedDict()
+        self._cap = cap
+
+    def begin(self, forward_id: str, timeout: float = 30.0):
+        """(hit, response). A miss RESERVES the id — the caller MUST
+        resolve it with put() (success) or abort() (handler raised). A
+        replay arriving while the original delivery is still executing
+        parks until it resolves: put -> replay answers from the cache;
+        abort -> the replay takes over the reservation and re-executes
+        (the original never committed). A wait past `timeout` raises —
+        surfacing an error to the submitter is safe, re-executing a
+        possibly-committing write is not."""
+        deadline = time.monotonic() + timeout
+        while True:
+            with self._lock:
+                if forward_id not in self._seen:
+                    self._seen[forward_id] = self._Running()
+                    while len(self._seen) > self._cap:
+                        # Never evict a running entry: its event is the
+                        # replay-parking contract (cap >> plausible
+                        # concurrent forwards).
+                        oldest = next(iter(self._seen))
+                        if isinstance(self._seen[oldest], self._Running):
+                            break
+                        self._seen.popitem(last=False)
+                    return False, None
+                entry = self._seen[forward_id]
+                if not isinstance(entry, self._Running):
+                    self._seen.move_to_end(forward_id)
+                    metrics.incr_counter(("nomad", "rpc", "forward",
+                                          "dedup_hit"))
+                    return True, entry
+                waiter = entry.event
+            remaining = deadline - time.monotonic()
+            if remaining <= 0 or not waiter.wait(remaining):
+                raise RuntimeError(
+                    f"forward {forward_id} replayed while the original "
+                    f"delivery is still executing")
+
+    def get(self, forward_id: str):
+        """(hit, response) for a RESOLVED entry — hit distinguishes a
+        stored None response; an in-progress entry reads as a miss."""
+        with self._lock:
+            if forward_id in self._seen:
+                entry = self._seen[forward_id]
+                if not isinstance(entry, self._Running):
+                    self._seen.move_to_end(forward_id)
+                    metrics.incr_counter(("nomad", "rpc", "forward",
+                                          "dedup_hit"))
+                    return True, entry
+            return False, None
+
+    def put(self, forward_id: str, response) -> None:
+        with self._lock:
+            prior = self._seen.get(forward_id)
+            self._seen[forward_id] = response
+            self._seen.move_to_end(forward_id)
+            while len(self._seen) > self._cap:
+                oldest = next(iter(self._seen))
+                if isinstance(self._seen[oldest], self._Running):
+                    break
+                self._seen.popitem(last=False)
+        if isinstance(prior, self._Running):
+            prior.event.set()
+
+    def abort(self, forward_id: str) -> None:
+        """Clear a reservation whose handler raised: parked replays wake
+        and RE-EXECUTE (nothing committed; at-least-once is correct)."""
+        with self._lock:
+            prior = self._seen.pop(forward_id, None)
+        if isinstance(prior, self._Running):
+            prior.event.set()
+
+
+class NoRegionPathError(Exception):
+    """No live, non-quarantined server is known for the target region."""
+
+
+class RegionForwarder:
+    """Retrying, breaker-guarded cross-region RPC forwarding."""
+
+    _concurrency = guarded_by("_lock", "_breakers")
+
+    def __init__(self, pool, route: Callable[[str], List[str]],
+                 fed: Optional[FederationConfig] = None):
+        """``route(region)`` returns every known live rpc addr of the
+        region (the gossip peer table; a static single-addr router wraps
+        into a one-element list). Remote-shed health is NOT consulted
+        here — the ingress endpoint gates through
+        AdmissionController.admit_forward BEFORE calling forward()."""
+        self.pool = pool
+        self.route = route
+        self.fed = fed or FederationConfig()
+        self._lock = threading.Lock()
+        self._breakers: Dict[str, CircuitBreaker] = {}
+
+    def _breaker(self, addr: str) -> CircuitBreaker:
+        with self._lock:
+            br = self._breakers.get(addr)
+            if br is None:
+                br = self._breakers[addr] = CircuitBreaker(
+                    failure_threshold=self.fed.forward_breaker_threshold,
+                    reset_timeout=self.fed.forward_breaker_reset_s)
+            return br
+
+    def _pick(self, region: str, tried: set) -> Optional[str]:
+        """Next candidate: an untried breaker-admitted peer first, then
+        a tried-but-admitted one (a transient link error retries the
+        SAME peer when the region has only one). None when every known
+        peer is quarantined — failing fast IS the breaker working; the
+        half-open probe re-admits one call per reset window."""
+        addrs = self.route(region) or []
+        for addr in addrs:
+            if addr not in tried and self._breaker(addr).allow():
+                return addr
+        for addr in addrs:
+            if self._breaker(addr).allow():
+                return addr
+        return None
+
+    def breaker_state(self, addr: str) -> str:
+        return self._breaker(addr).state
+
+    def forward(self, region: str, method: str,
+                body: Dict[str, Any]) -> Any:
+        """Forward one RPC to a server of ``region``. Writes are stamped
+        with a ForwardID (once, surviving retries) so the receiving side
+        can dedupe a replay; transport failures retry across peers, remote
+        handler errors surface immediately (they ARE the answer)."""
+        from nomad_tpu.rpc.pool import ConnError, RPCError
+
+        body = dict(body)
+        if method in FORWARD_DEDUPED and not body.get("ForwardID"):
+            body["ForwardID"] = generate_uuid()
+        tried: set = set()
+        t0 = time.monotonic()
+        metrics.incr_counter(("nomad", "rpc", "forward", "request"))
+
+        def attempt():
+            addr = self._pick(region, tried)
+            if addr is None:
+                known = self.route(region) or []
+                raise NoRegionPathError(
+                    f"no path to region {region}"
+                    + (f" ({len(known)} peer(s) quarantined)"
+                       if known else ""))
+            tried.add(addr)
+            breaker = self._breaker(addr)
+            try:
+                act = failpoints.fire("rpc.forward_region")
+                if act == "error":
+                    # Link failed before the request left: the safe-retry
+                    # half of the seam.
+                    raise ConnError(
+                        f"region link to {addr} failed (failpoint)")
+                resp = self.pool.call(addr, method, body)
+                if act == "drop":
+                    # Request DELIVERED, response black-holed: the
+                    # ambiguous WAN failure. The retry replays the same
+                    # ForwardID and the receiver's dedupe answers it.
+                    raise ConnError(
+                        f"region link to {addr} dropped mid-forward "
+                        f"(failpoint)")
+            except RPCError:
+                # The remote handler ran and answered with an error —
+                # that IS the forward's result; never retried, and the
+                # link itself is healthy.
+                breaker.record_success()
+                raise
+            except (ConnError, OSError, TimeoutError,
+                    failpoints.FailpointError):
+                breaker.record_failure()
+                metrics.incr_counter(("nomad", "rpc", "forward", "retry"))
+                raise
+            breaker.record_success()
+            return resp
+
+        policy = RetryPolicy(
+            max_attempts=max(1, self.fed.forward_attempts),
+            backoff=Backoff(base=0.01, cap=0.25),
+            retry_on=(ConnError, OSError, TimeoutError,
+                      failpoints.FailpointError))
+        try:
+            return policy.call(attempt)
+        except NoRegionPathError:
+            metrics.incr_counter(("nomad", "rpc", "forward", "fail"))
+            raise
+        except Exception:
+            metrics.incr_counter(("nomad", "rpc", "forward", "fail"))
+            raise
+        finally:
+            metrics.measure_since(("nomad", "rpc", "forward"), t0)

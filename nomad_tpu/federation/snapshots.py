@@ -1,0 +1,128 @@
+"""Follower-snapshot scheduling: staleness-bounded snapshots for workers.
+
+The pre-federation pipeline takes a fresh ``state.snapshot()`` per window
+per worker — every one a live-store lock round pinning a new MVCC
+watermark, all on the leader. The reference's Omega model (PAPER.md:
+optimistically-concurrent workers placing against state *snapshots*) says
+scheduling READS don't need the live store at all: the plan applier
+re-verifies every placement against settled state before commit, so a
+worker may place against any snapshot that (a) contains the eval's own
+release point and (b) is younger than a staleness bound.
+
+:class:`SnapshotSource` is that bound made concrete. One instance serves
+all workers of a server against that server's LOCAL replica — the leader's
+own store in dev/leader mode, the follower's replicated store for
+distributed workers (whose dequeue RPC already returns a per-eval release
+floor instead of the leader's latest index when federation is on, see
+EvalBroker.release_floor) — so scheduling reads leave the leader entirely.
+A snapshot is shared across windows and workers until it ages past
+``max_staleness_s`` or a caller needs a newer watermark; the observed age
+is recorded per handout as ``nomad.federation.staleness_ms``.
+
+A plan built from a sourced snapshot carries its birth time
+(``plan._fed_born``); the plan applier rejects plans older than
+``reject_after_s`` with :class:`StaleSnapshotError` and the worker nacks,
+so the broker redelivers the eval exactly once onto a fresh snapshot —
+the same machinery killed windows and chaos faults ride.
+
+``pin()`` is the deliberate-staleness test seam: the equivalence gate
+pins a pre-aged snapshot to prove the reject/redeliver path end to end.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Optional, Tuple
+
+from nomad_tpu.analysis import guarded_by
+from nomad_tpu.telemetry import metrics
+
+from .config import FederationConfig
+
+
+class StaleSnapshotError(Exception):
+    """A plan was built against a snapshot older than the federation
+    staleness bound and rejected by the plan applier before verification.
+    Retryable by REDELIVERY, not in place: the worker nacks, the broker
+    redelivers the eval exactly once, and the re-run dequeues a fresh
+    snapshot from the source."""
+
+
+class SnapshotSource:
+    """Shared, staleness-bounded scheduling snapshots over one replica."""
+
+    _concurrency = guarded_by("_lock", "_snap", "_born", "_pinned",
+                              "reused", "refreshed")
+
+    def __init__(self, state, fed: FederationConfig,
+                 clock=time.monotonic):
+        self.state = state
+        self.fed = fed
+        self.clock = clock
+        self._lock = threading.Lock()
+        self._snap = None
+        self._born: float = 0.0
+        # (snapshot, born) pinned by tests to force deliberate staleness;
+        # get() serves it unconditionally until unpin().
+        self._pinned: Optional[Tuple[object, float]] = None
+        self.reused = 0
+        self.refreshed = 0
+
+    def get(self, min_index: int = 0) -> Tuple[object, float]:
+        """A scheduling snapshot whose watermark covers ``min_index`` and
+        whose age is within the staleness bound — shared when possible,
+        refreshed otherwise. Returns ``(snapshot, born)``; callers stamp
+        ``born`` onto the plans they build from it."""
+        with self._lock:
+            if self._pinned is not None:
+                snap, born = self._pinned
+                self._observe(born)
+                return snap, born
+            now = self.clock()
+            snap = self._snap
+            if (snap is None
+                    or now - self._born > self.fed.max_staleness_s
+                    or snap.watermark < min_index):
+                self._snap = snap = self.state.snapshot()
+                self._born = now
+                self.refreshed += 1
+                metrics.incr_counter(
+                    ("nomad", "federation", "snapshot_refresh"))
+            else:
+                self.reused += 1
+                metrics.incr_counter(
+                    ("nomad", "federation", "snapshot_reuse"))
+            self._observe(self._born)
+            return snap, self._born
+
+    def _observe(self, born: float) -> None:
+        metrics.add_sample(("nomad", "federation", "staleness_ms"),
+                           (self.clock() - born) * 1e3)
+
+    def pin(self, snap, born: Optional[float] = None) -> None:
+        """Test seam: serve exactly this (snapshot, born) until unpin().
+        ``born`` defaults to now; pass an old timestamp to simulate a
+        worker placing against a snapshot far past the staleness bound."""
+        with self._lock:
+            self._pinned = (snap, born if born is not None else self.clock())
+
+    def unpin(self) -> None:
+        with self._lock:
+            self._pinned = None
+            # Drop the cache too: the next get() observes fresh state
+            # immediately instead of a snapshot predating the pin window.
+            self._snap = None
+
+    def invalidate(self) -> None:
+        """Drop the cached snapshot (leadership change / restore): the
+        next get() re-snapshots the — possibly rebuilt — store."""
+        with self._lock:
+            self._snap = None
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {"Reused": self.reused, "Refreshed": self.refreshed,
+                    "AgeMs": round((self.clock() - self._born) * 1e3, 2)
+                    if self._snap is not None else None,
+                    "Pinned": self._pinned is not None}

@@ -46,13 +46,26 @@ class QoSBackpressureError(Exception):
 
 
 class AdmissionController:
-    """Backlog/SLO-burn admission policy over the broker's tier state."""
+    """Backlog/SLO-burn admission policy over the broker's tier state.
+
+    Federated mode additionally consults the polled global view
+    (federation/qos.py FederationHealth): a cross-region forward whose
+    HOME region is already shedding the submission's tier is shed at
+    THIS edge — same typed error, no WAN hop — so a storm region sheds
+    its own load (local ``admit``) while remote edges stop feeding it
+    (``admit_forward``), and no other region's high tier ever waits on
+    a doomed forward."""
 
     def __init__(self, qos: Optional[QoSConfig], broker,
-                 counters: Optional[QoSCounters] = None):
+                 counters: Optional[QoSCounters] = None,
+                 fed=None, fed_health=None):
         self.qos = qos
         self.broker = broker
         self.counters = counters or QoSCounters()
+        # FederationConfig + FederationHealth (both None when federation
+        # is off — admit_forward is then a no-op, bit-identical path).
+        self.fed = fed
+        self.fed_health = fed_health
 
     def _shed(self, tier: int, reason: str,
               retry_after: float) -> "QoSBackpressureError":
@@ -89,3 +102,20 @@ class AdmissionController:
                         f"deadline)", 1.0)
         self.counters.incr("admitted")
         metrics.incr_counter(("nomad", "qos", "admission", "admit"))
+
+    def admit_forward(self, region: str, priority: int) -> None:
+        """Gate one cross-region forward against the target region's
+        cached health; raises :class:`QoSBackpressureError` to shed at
+        the local edge. No-op unless QoS + federation remote-shed are on
+        and a fresh health entry exists (stale/unknown = forward and let
+        the home region decide)."""
+        if not qos_enabled(self.qos) or self.fed_health is None:
+            return
+        if self.fed is None or not getattr(self.fed, "remote_shed", False):
+            return
+        tier = self.qos.tier_of(priority)
+        reason = self.fed_health.region_shedding(region, tier)
+        if reason is not None:
+            self.counters.incr("forward_shed")
+            metrics.incr_counter(("nomad", "rpc", "forward", "shed"))
+            raise QoSBackpressureError(TIER_NAMES[tier], reason, 1.0)

@@ -121,7 +121,7 @@ class QoSCounters:
 
     FIELDS = ("admitted", "shed", "delayed",
               "preempt_attempts", "preempt_placed", "preempt_evictions",
-              "window_cuts")
+              "window_cuts", "forward_shed")
 
     def __init__(self) -> None:
         self._lock = threading.Lock()

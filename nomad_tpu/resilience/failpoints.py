@@ -88,6 +88,15 @@ KNOWN_SITES: Dict[str, str] = {
                           "batch)",
     "server.blocked.unblock": "server: blocked-evals capacity wakeup "
                               "(drop=lost wakeup event)",
+    "rpc.forward_region": "rpc: one cross-region forward attempt "
+                          "(federation/routing.py; error=link failed "
+                          "before send — safe retry onto another region "
+                          "peer; delay=slow WAN hop; drop=request "
+                          "DELIVERED but response lost — the ambiguous "
+                          "failure: the retry replays the same ForwardID "
+                          "and the receiving region's dedupe cache must "
+                          "answer it, yielding exactly-once registration "
+                          "and no duplicate evals)",
     "rpc.pool.call": "rpc: pooled client call over the wire",
     "sched.system.emit": "scheduler: system sweep's bulk placement emit "
                          "(kill a sweep before anything is submitted; the "

@@ -82,6 +82,14 @@ class ClusterServer:
         self.endpoints.region_router = self.membership.region_router
         self.endpoints.region_lister = self.membership.region_lister
         self.endpoints.membership = self.membership
+        if getattr(self.server, "fed_health", None) is not None:
+            # Federation: the leader's health loop polls every other
+            # region's Federation.Health through the membership plane's
+            # WAN pool into the shared view (federation/qos.py).
+            health = self.server.fed_health
+            membership = self.membership
+            self.server.fed_poll = (
+                lambda: membership.poll_federation_health(health))
         self.membership.start()
         if join:
             self.membership.retry_join(join)

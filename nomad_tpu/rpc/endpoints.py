@@ -16,6 +16,14 @@ import threading
 import time
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
+from nomad_tpu.federation import (
+    FORWARD_DEDUPED,
+    ForwardDedup,
+    NoRegionPathError,
+    RegionForwarder,
+    federation_enabled,
+    health_payload,
+)
 from nomad_tpu.raft.node import NotLeaderError
 from nomad_tpu.resilience import failpoints
 from nomad_tpu.state.watch import Item
@@ -34,9 +42,10 @@ from .pool import ConnPool, DroppedRPCError, RPCError
 
 MAX_BLOCK_TIME = 300.0  # reference: rpc.go:33-47 maxQueryTime
 
-
-class NoRegionPathError(Exception):
-    pass
+# NoRegionPathError moved to federation/routing.py with the hardened
+# forwarder; re-exported here so existing callers keep importing it from
+# the endpoint module.
+__all__ = ["Endpoints", "NoRegionPathError", "blocking_query"]
 
 
 def blocking_query(state, items: List[Item], min_index: int,
@@ -132,9 +141,17 @@ class Endpoints:
             "Agent.Members": self.agent_members,
             "Agent.Join": self.agent_join,
             "Agent.ForceLeave": self.agent_force_leave,
+            "Federation.Health": self.federation_health,
         }
         # populated by ClusterServer.enable_gossip (server/membership.py)
         self.membership = None
+        # Cross-region forwarding (federation/routing.py): retrying +
+        # breaker-guarded + write-deduped. The forwarder is built lazily
+        # so it picks up gossip membership and the server's federation
+        # config once wired; the dedupe cache answers replayed forwarded
+        # writes (ForwardID) on the receiving side.
+        self._forwarder: Optional[RegionForwarder] = None
+        self._forward_dedup = ForwardDedup()
 
     # Read RPCs that forward to the leader unless the caller passes
     # AllowStale (reference: every endpoint's `if done, err := s.forward(...)`
@@ -188,20 +205,68 @@ class Endpoints:
                     and not self.server.is_leader()):
                 return self._forward_leader(method, body,
                                             NotLeaderError(None))
+            # Forwarded-write replay dedupe (federation/routing.py): a
+            # cross-region retry whose original attempt WAS delivered
+            # (response lost on the WAN) replays its ForwardID; answer
+            # from the cache instead of re-executing — exactly-once
+            # registration, no duplicate evals. Keyed lookups only when
+            # the body carries an ID, so un-forwarded traffic never pays.
+            fid = (body.get("ForwardID")
+                   if method in FORWARD_DEDUPED else None)
+            if fid:
+                # begin() RESERVES the id: a replay landing while this
+                # delivery is still executing parks on the reservation
+                # instead of re-executing the write concurrently (the
+                # ambiguous-WAN race), and answers from the cache once
+                # this execution resolves. put/abort below MUST resolve
+                # every reservation.
+                hit, cached = self._forward_dedup.begin(fid)
+                if hit:
+                    return cached
             try:
-                return self._methods[method](body)
-            except NotLeaderError as exc:
-                return self._forward_leader(method, body, exc)
+                try:
+                    result = self._methods[method](body)
+                except NotLeaderError as exc:
+                    result = self._forward_leader(method, body, exc)
+            except BaseException:
+                if fid:
+                    # Nothing committed from this delivery's point of
+                    # view: parked replays wake and re-execute.
+                    self._forward_dedup.abort(fid)
+                raise
+            if fid:
+                self._forward_dedup.put(fid, result)
+            return result
         finally:
             metrics.measure_since(("nomad", "rpc", method), start)
 
+    # ---------------------------------------------- cross-region forwarding
+    def _fed(self):
+        """The server's FederationConfig (None = federation off)."""
+        return getattr(self.server, "fed", None)
+
+    def _region_candidates(self, region: str) -> List[str]:
+        """Every known live server of a region — gossip's view when
+        federated; the static router (tests / manual wiring) degrades to
+        a single candidate."""
+        if self.membership is not None:
+            return self.membership.region_servers(region)
+        addr = self.region_router(region) if self.region_router else None
+        return [addr] if addr else []
+
+    def _get_forwarder(self) -> RegionForwarder:
+        if self._forwarder is None:
+            self._forwarder = RegionForwarder(
+                self.pool, self._region_candidates, fed=self._fed())
+        return self._forwarder
+
     def _forward_region(self, region: str, method: str,
                         body: Dict[str, Any]) -> Any:
-        """(reference: forwardRegion, rpc.go:223-242)"""
-        addr = self.region_router(region) if self.region_router else None
-        if addr is None:
-            raise NoRegionPathError(f"no path to region {region}")
-        return self.pool.call(addr, method, body)
+        """(reference: forwardRegion, rpc.go:223-242 — hardened: retries
+        across region peers under RetryPolicy, per-peer CircuitBreaker
+        quarantine, ForwardID-deduped writes, `rpc.forward_region`
+        failpoint. See federation/routing.py.)"""
+        return self._get_forwarder().forward(region, method, body)
 
     def _forward_leader(self, method: str, body: Dict[str, Any],
                         exc: NotLeaderError) -> Any:
@@ -278,6 +343,20 @@ class Endpoints:
     # ------------------------------------------------------------------ job
     def job_register(self, body) -> Dict[str, Any]:
         job = from_dict(Job, body["Job"])
+        # Region-local authority (federation): a job whose home Region
+        # differs from this server's forwards at ingress, BEFORE any
+        # raft write — the job, its eval, and its allocs are owned by
+        # the home region's raft domain. The remote-shed check consults
+        # the cached federation health view first so a forward into a
+        # region already shedding this tier bounces at the local edge
+        # (typed 429-retryable) without paying the WAN hop.
+        fed = self._fed()
+        local = self.server.config.region
+        if (federation_enabled(fed) and job.Region
+                and job.Region != local):
+            self.server.admit_forward(job.Region, job.Priority)
+            return self._forward_region(job.Region, "Job.Register",
+                                        dict(body, Region=job.Region))
         # Collected BEFORE the register mutates the job: warnings must
         # reach the submitter even when nothing else is wrong (reference
         # shape: JobRegisterResponse.Warnings). Best-effort: the schema
@@ -354,6 +433,19 @@ class Endpoints:
                 "Index": state.get_index("evals")}
 
     def job_evaluate(self, body) -> Dict[str, Any]:
+        fed = self._fed()
+        if federation_enabled(fed):
+            # A job living in another region (pre-federation data, or a
+            # caller that skipped the Region query param) re-evaluates in
+            # its HOME region — forwarded before any raft write, like
+            # registration.
+            job = self.server.state.job_by_id(body["JobID"])
+            local = self.server.config.region
+            if (job is not None and job.Region
+                    and job.Region != local):
+                self.server.admit_forward(job.Region, job.Priority)
+                return self._forward_region(job.Region, "Job.Evaluate",
+                                            dict(body, Region=job.Region))
         eval_id, index = self.server.job_evaluate(body["JobID"])
         if eval_id:
             trace.link("eval", eval_id)
@@ -505,9 +597,18 @@ class Endpoints:
         # preceded this dequeue (ModifyIndex alone misses plans committed
         # after this eval was CREATED but before it was dequeued — a
         # duplicate eval would double-place its job from a stale follower
-        # replica).
+        # replica). Under federation the broker's per-eval RELEASE FLOOR
+        # replaces the global latest index: per-job serialization makes
+        # it a sufficient bound, and a follower worker then only waits
+        # for replication up to the floor instead of chasing the
+        # leader's every mid-storm commit (follower-snapshot scheduling).
+        wait_index = None
+        if ev is not None:
+            wait_index = self.server.eval_broker.release_floor(ev.ID)
+        if wait_index is None:
+            wait_index = self.server.state.latest_index()
         return {"Eval": to_dict(ev) if ev else None, "Token": token,
-                "WaitIndex": self.server.state.latest_index()}
+                "WaitIndex": wait_index}
 
     def eval_ack(self, body) -> Dict[str, Any]:
         if not self.server.eval_broker.enabled():
@@ -631,6 +732,13 @@ class Endpoints:
         if self.region_lister is not None:
             return sorted(self.region_lister())
         return [self.server.config.region]
+
+    def federation_health(self, body) -> Dict[str, Any]:
+        """This region's QoS tier health (depths, SLO burn, admission
+        thresholds, node count) — polled cross-region by federation
+        leaders to build the global admission/SLO-burn view
+        (federation/qos.py)."""
+        return health_payload(self.server)
 
     # --------------------------------------------------------------- system
     def system_gc(self, body) -> Dict[str, Any]:

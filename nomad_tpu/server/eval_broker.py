@@ -33,10 +33,14 @@ from typing import Deque, Dict, List, Optional, Tuple
 from nomad_tpu.analysis import guarded_by, requires_lock
 from nomad_tpu.qos.tiers import N_TIERS, TIER_NAMES, QoSConfig, qos_enabled
 from nomad_tpu.structs import Evaluation, generate_uuid
-from nomad_tpu.telemetry import trace
+from nomad_tpu.telemetry import metrics, trace
 from nomad_tpu.timerwheel import TimerHandle, wheel
 
 FAILED_QUEUE = "_failed"
+
+# Bound on the federation foreign-region park (see _enqueue_locked): a
+# safety-net diagnostic for misdirected writes, evicted oldest-first.
+FOREIGN_PARK_CAP = 4096
 
 
 class NotOutstandingError(Exception):
@@ -164,7 +168,8 @@ class EvalBroker:
     _concurrency = guarded_by(
         "_lock", "_enabled", "_evals", "_job_evals", "_blocked", "_ready",
         "_unack", "_requeue", "_time_wait", "stats", "_ages",
-        "_age_slack", "_slo")
+        "_age_slack", "_slo", "_floors", "_foreign", "_region",
+        "_index_source")
 
     def __init__(self, nack_timeout: float = 60.0, delivery_limit: int = 3,
                  qos: Optional[QoSConfig] = None):
@@ -201,7 +206,34 @@ class EvalBroker:
         self._slo: List[Deque[bool]] = [
             deque(maxlen=(qos.burn_window if qos_enabled(qos) else 1))
             for _ in range(N_TIERS)]
+        # Federation (set_federation; both None/"" when federation is
+        # off, leaving every path below bit-identical to pre-federation
+        # behavior):
+        # - _floors: eval id -> store index at the moment the eval
+        #   became READY (its release point). A follower-snapshot worker
+        #   only needs its replica caught up to THIS, not to the
+        #   leader's global latest index: per-job serialization means no
+        #   plan for the eval's job can commit after its release, so a
+        #   snapshot at the floor can never double-place — the Omega
+        #   soundness bound that lets a shared snapshot serve a whole
+        #   storm burst.
+        # - _foreign: evals whose Region differs from the local one,
+        #   parked instead of served — a region must never dequeue work
+        #   it has no nodes for (ingress forwarding makes these orphans
+        #   by construction; parking + the counter is the safety net).
+        self._index_source = None
+        self._region = ""
+        self._floors: Dict[str, int] = {}
+        self._foreign: Dict[str, Evaluation] = {}
         self.stats = BrokerStats()
+
+    def set_federation(self, region: str, index_source) -> None:
+        """Arm federation routing: evals release-stamp a snapshot floor
+        from ``index_source`` (the local store's latest_index) and evals
+        of a different region park instead of entering the ready queues."""
+        with self._lock:
+            self._region = region
+            self._index_source = index_source
 
     def _queue(self) -> _PriorityQueue:
         return _PriorityQueue(self.qos)
@@ -233,6 +265,8 @@ class EvalBroker:
             self._time_wait.clear()
             self._ages.clear()
             self._age_slack.clear()
+            self._floors.clear()
+            self._foreign.clear()
             self.stats = BrokerStats()
             self._cond.notify_all()
 
@@ -290,6 +324,25 @@ class EvalBroker:
     def _enqueue_locked(self, ev: Evaluation, queue: str) -> None:
         if not self._enabled:
             return
+        if self._region and ev.Region and ev.Region != self._region:
+            # Region-aware routing: this region has no nodes for the
+            # eval's job — park it rather than hand it to a local
+            # scheduler that can only fail it into a blocked eval no
+            # capacity change here will ever unblock. Ingress forwarding
+            # keeps these from existing at all; the park is the safety
+            # net for pre-federation data and misdirected writes.
+            if ev.ID not in self._foreign:
+                self._foreign[ev.ID] = ev
+                metrics.incr_counter(("nomad", "federation",
+                                      "foreign_evals"))
+                # The park is a bounded DIAGNOSTIC, not an authority:
+                # nothing ever serves these locally, so a leader fed a
+                # steady stream of misdirected writes must not grow the
+                # dict (and pin dead Evaluations) for its whole term —
+                # evict oldest-first past the cap (insertion-ordered).
+                while len(self._foreign) > FOREIGN_PARK_CAP:
+                    self._foreign.pop(next(iter(self._foreign)))
+            return
         # First-enqueue memory: a Nack redelivery or blocked requeue keeps
         # the original timestamp (setdefault), so tier aging and SLO burn
         # see the eval's TRUE queue age, not its latest re-entry.
@@ -301,6 +354,12 @@ class EvalBroker:
             self._blocked.setdefault(ev.JobID, _PriorityQueue()).push(ev)
             self.stats.TotalBlocked += 1
             return
+        if self._index_source is not None:
+            # Release floor (federation): the store index at the moment
+            # this eval enters a ready queue. Overwritten on every
+            # re-entry (nack redelivery, blocked promotion) — the newest
+            # release point is the sound snapshot bound.
+            self._floors[ev.ID] = self._index_source()
         self._ready.setdefault(queue, self._queue()).push(ev, enq_time)
         self.stats.TotalReady += 1
         sched = self.stats.ByScheduler.setdefault(
@@ -517,6 +576,7 @@ class EvalBroker:
         job_id = unack.eval.JobID
         enq_time = self._ages.pop(eval_id, 0.0)
         slack = self._age_slack.pop(eval_id, 0.0)
+        self._floors.pop(eval_id, None)
         if qos_enabled(self.qos) and enq_time:
             # SLO burn: did this eval's whole broker residency (first
             # enqueue -> ack, spanning redeliveries) blow its tier
@@ -578,6 +638,26 @@ class EvalBroker:
                 self._enqueue_locked(unack.eval, FAILED_QUEUE)
             else:
                 self._enqueue_locked(unack.eval, unack.eval.Type)
+
+    # ------------------------------------------------- federation accessors
+    def release_floor(self, eval_id: str) -> Optional[int]:
+        """The store index at which this eval entered the ready queue
+        (federation snapshot floor), or None when federation is off —
+        callers then fall back to the pre-federation global latest
+        index, keeping the disabled path bit-identical."""
+        with self._lock:
+            return self._floors.get(eval_id)
+
+    def foreign_parked(self) -> List[Evaluation]:
+        """Evals parked as foreign-region (never served locally)."""
+        with self._lock:
+            return list(self._foreign.values())
+
+    def foreign_count(self) -> int:
+        """len(foreign_parked()) without copying the dict — the stats
+        loop and sched-stats endpoint only want the number."""
+        with self._lock:
+            return len(self._foreign)
 
     # ------------------------------------------------------ QoS introspection
     def seed_age_slack(self, slack: Dict[str, float]) -> None:

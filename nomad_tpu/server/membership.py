@@ -217,9 +217,41 @@ class ServerMembership:
             return None
         return random.choice(parts).rpc_addr
 
+    def region_servers(self, region: str) -> List[str]:
+        """Every live server addr of a region — the hardened region
+        forwarder's candidate set (federation/routing.py tries them in
+        breaker-admitted order instead of one random pick)."""
+        with self._lock:
+            addrs = [p.rpc_addr for p in self.peers.get(region, {}).values()
+                     if p.status in ("alive", "suspect")]
+        random.shuffle(addrs)  # spread forwards across region peers
+        return addrs
+
     def region_lister(self) -> List[str]:
         with self._lock:
             return sorted(r for r, servers in self.peers.items() if servers)
+
+    def poll_federation_health(self, health) -> None:
+        """One poll round of every OTHER region's Federation.Health into
+        the shared view (federation/qos.py). Called from the leader's
+        federation loop; a region that doesn't answer simply ages out of
+        the view (stale = assume healthy). The local region's entry is
+        filled by the caller from its own broker — no RPC round trip."""
+        for region in self.region_lister():
+            if region == self.region:
+                continue
+            for addr in self.region_servers(region):
+                try:
+                    payload = self._pool.call(addr, "Federation.Health",
+                                              {}, timeout=2.0)
+                except (OSError, ConnError, TimeoutError) as exc:
+                    LOG.debug("%s: federation health poll of %s (%s) "
+                              "failed: %s", self.gossip_name, region,
+                              addr, exc)
+                    continue
+                if payload:
+                    health.update(region, payload)
+                break
 
     def local_servers(self) -> List[ServerParts]:
         with self._lock:

@@ -62,6 +62,7 @@ from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
+from nomad_tpu.federation import StaleSnapshotError
 from nomad_tpu.resilience import failpoints
 from nomad_tpu.scheduler.context import EvalContext
 from nomad_tpu.scheduler.generic_sched import (
@@ -95,7 +96,7 @@ from nomad_tpu.structs.structs import (
 )
 
 from .fsm import MessageType
-from .worker import DEQUEUE_TIMEOUT, Worker
+from .worker import DEQUEUE_TIMEOUT, Worker, stamp_fed_born
 
 logger = logging.getLogger("nomad.worker.pipelined")
 
@@ -124,6 +125,9 @@ STATS_COUNTERS = (
     "mesh_shards",     # device count of the serving mesh (gauge)
     "mesh_cert_miss",  # warm windows whose exactness certificate failed
     #                    (window nacked + chain tainted -> cold redispatch)
+    "fed_stale",       # windows nacked for a stale federation snapshot
+    #                    (applier StaleSnapshotError -> exactly-once
+    #                    redelivery onto a fresh snapshot)
 )
 STATS_TIMERS_MS = (
     "t_lease_ms",        # waiting for the shared chain-lease (ChainArbiter)
@@ -219,6 +223,9 @@ class _WindowWork:
     chain_seq: int = 0          # chain position (arbiter finish barrier)
     mesh_flags: Optional[list] = None  # warm-window exactness certificates
     #                            (device scalars; drain fetches + enforces)
+    fed_born: Optional[float] = None   # federation snapshot birth time
+    #                            (stamped onto the window's plans; None
+    #                             when federation is off)
 
 
 def _prep_sig(job, place, batch: bool) -> Optional[tuple]:
@@ -555,10 +562,19 @@ class PipelinedWorker(Worker):
             batch = [(ev, t) for ev, t in batch if ev.ID not in stale_ids]
         if not batch:
             return None
-        self._wait_for_index(max(
-            [ev.ModifyIndex for ev, _ in batch]
-            + [getattr(self, "_window_wait_index", 0)]))
-        snap = self.raft.fsm.state.snapshot()
+        min_index = max([ev.ModifyIndex for ev, _ in batch]
+                        + [getattr(self, "_window_wait_index", 0)])
+        self._wait_for_index(min_index)
+        if self.fed_source is not None:
+            # Follower-snapshot scheduling: the window places against the
+            # shared staleness-bounded snapshot instead of pinning a
+            # fresh watermark on the live store per window per worker.
+            # The applier re-verifies (and staleness-rejects) so a stale
+            # snapshot costs a redelivery, never a bad commit.
+            snap, fed_born = self.fed_source.get(min_index)
+        else:
+            snap = self.raft.fsm.state.snapshot()
+            fed_born = None
         t0 = time.perf_counter()
 
         nt = self.tindex.nt
@@ -714,7 +730,8 @@ class PipelinedWorker(Worker):
         self.stats["slow"] += len(slow)
         work = _WindowWork(fast=fast, slow=slow, published=bool(fast),
                            chain_seq=lease.seq,
-                           mesh_flags=mesh_flags or None)
+                           mesh_flags=mesh_flags or None,
+                           fed_born=fed_born)
         # Build the drain plan NOW: the compaction kernels dispatch async
         # behind the window's placement kernels and their (much smaller)
         # outputs start copying to the host immediately, so the drain
@@ -911,6 +928,7 @@ class PipelinedWorker(Worker):
                 rec.fallback = True  # nothing placeable; let sync path decide
                 continue
             rec.plan.EvalToken = rec.token
+            stamp_fed_born(rec.plan, work.fed_born)
             submit.append(rec)
         # ONE broker lock round re-arms every submitting eval's deadline
         # and surfaces redeliveries; ONE queue lock round enqueues the
@@ -951,6 +969,15 @@ class PipelinedWorker(Worker):
                 # Raises on timeout or applier rejection (stale token):
                 # only THIS eval falls back, not the whole window.
                 result = rec.pending.wait(timeout=30.0)
+            except StaleSnapshotError:
+                # The applier rejected the window's snapshot as over the
+                # federation staleness bound — every plan of the window
+                # shares it, so the WHOLE window fails: the build-loop
+                # handler nacks every eval and taints the chain, and the
+                # broker's exactly-once redelivery re-runs them against a
+                # fresh snapshot (the same machinery as a killed window).
+                self.stats["fed_stale"] += 1
+                raise
             except Exception:
                 logger.debug("plan for eval %s not committed; re-running"
                              " per-eval", rec.ev.ID)

@@ -429,11 +429,16 @@ class PlanApplier:
     def __init__(self, plan_queue: PlanQueue, raft: DevRaft,
                  eval_broker: Optional[EvalBroker] = None,
                  pool_size: Optional[int] = None, tindex=None,
-                 qos_counters=None):
+                 qos_counters=None, fed=None):
         self.plan_queue = plan_queue
         self.raft = raft
         self.eval_broker = eval_broker
         self.tindex = tindex
+        # FederationConfig (None = federation off): plans stamped with a
+        # snapshot birth time (`_fed_born`, worker-side) older than
+        # fed.reject_after_s at verify time are rejected outright — the
+        # Omega staleness backstop (see federation/snapshots.py).
+        self.fed = fed
         # QoS flow counters (qos/tiers.py QoSCounters): preempt_placed /
         # preempt_evictions are counted HERE, at commit, so rejected
         # preemption plans never inflate the "landed" numbers.
@@ -706,6 +711,26 @@ class PlanApplier:
             if token is None or (plan.EvalToken and token != plan.EvalToken):
                 pending.respond(None, RuntimeError(
                     f"plan for evaluation {plan.EvalID} has stale token"))
+                self.stats["rejected"] += 1
+                return None
+        born = getattr(plan, "_fed_born", None)
+        if (born is not None and self.fed is not None
+                and self.fed.reject_after_s > 0):
+            # Follower-snapshot staleness backstop: a plan built against
+            # a snapshot far past the dequeue-side bound (a wedged or
+            # deliberately-pinned source) is rejected BEFORE verification
+            # — the worker nacks, the broker redelivers the eval exactly
+            # once, and the re-run places against a fresh snapshot.
+            age = time.monotonic() - born
+            if age > self.fed.reject_after_s:
+                from nomad_tpu.federation import StaleSnapshotError
+
+                metrics.incr_counter(("nomad", "federation",
+                                      "stale_plans"))
+                pending.respond(None, StaleSnapshotError(
+                    f"plan for evaluation {plan.EvalID} built against a "
+                    f"{age * 1e3:.0f}ms-old snapshot (bound "
+                    f"{self.fed.reject_after_s * 1e3:.0f}ms)"))
                 self.stats["rejected"] += 1
                 return None
         try:

@@ -47,6 +47,13 @@ from nomad_tpu.structs.structs import (
     NodeStatusReady,
     valid_node_status,
 )
+from nomad_tpu.federation import (
+    FederationConfig,
+    FederationHealth,
+    SnapshotSource,
+    federation_enabled,
+    health_payload,
+)
 from nomad_tpu.qos import (
     AdmissionController,
     QoSConfig,
@@ -133,6 +140,15 @@ class ServerConfig:
     # pass QoSConfig(enabled=True, ...) to opt in (README "QoS & SLO
     # serving" documents every knob).
     qos: Optional["QoSConfig"] = None
+    # Federated multi-region scheduling (nomad_tpu/federation/):
+    # follower-snapshot workers against staleness-bounded shared
+    # snapshots, region-local placement with hardened cross-region
+    # forwarding at ingress, region-aware broker routing, and the
+    # per-region QoS health view. None (the default) keeps the served
+    # path bit-identical to pre-federation behavior; pass
+    # FederationConfig(enabled=True, ...) to opt in (README
+    # "Federation" documents every knob).
+    federation: Optional["FederationConfig"] = None
     # Replicated deployment (reference: nomad/config.go RaftConfig +
     # BootstrapExpect). node_id doubles as the raft/transport address.
     node_id: str = ""
@@ -204,13 +220,38 @@ class Server:
         self.eval_broker = EvalBroker(self.config.eval_nack_timeout,
                                       self.config.eval_delivery_limit,
                                       qos=self.qos)
+        # Federation (nomad_tpu/federation/): the shared staleness-
+        # bounded snapshot source workers schedule from, the per-region
+        # QoS health view, and the broker's region routing — all None /
+        # disarmed when federation is off, keeping every consumer's
+        # path bit-identical to pre-federation behavior.
+        self.fed = self.config.federation
+        if federation_enabled(self.fed):
+            # follower_snapshots=False is the bench's all-on-leader
+            # baseline arm: routing/forwarding/health identical, but
+            # workers pin fresh live-store watermarks per window.
+            self.fed_source = (SnapshotSource(self.state, self.fed)
+                               if self.fed.follower_snapshots else None)
+            self.fed_health = FederationHealth(self.fed)
+            self.eval_broker.set_federation(self.config.region,
+                                            self.state.latest_index)
+        else:
+            self.fed_source = None
+            self.fed_health = None
+        # Cross-region health poll hook: ClusterServer.enable_gossip
+        # points this at the membership plane's poll (needs the WAN
+        # pool); the leader loop drives it.
+        self.fed_poll = None
         self.admission = AdmissionController(self.qos, self.eval_broker,
-                                             self.qos_counters)
+                                             self.qos_counters,
+                                             fed=self.fed,
+                                             fed_health=self.fed_health)
         self.blocked_evals = BlockedEvals(self.eval_broker)
         self.plan_queue = PlanQueue()
         self.plan_applier = PlanApplier(self.plan_queue, self.raft,
                                         self.eval_broker, tindex=self.tindex,
-                                        qos_counters=self.qos_counters)
+                                        qos_counters=self.qos_counters,
+                                        fed=self.fed)
         # Owned by the FSM so it is persisted in snapshots and rebuilt from
         # apply on every replica (survives leader failover).
         self.timetable = self.fsm.timetable
@@ -272,6 +313,11 @@ class Server:
                        backend=backend)
             w.qos = self.qos
             w.qos_counters = self.qos_counters
+            # Follower-snapshot scheduling: routed workers place against
+            # the LOCAL replica through the shared staleness-bounded
+            # source (their dequeue RPC already returns the release
+            # floor, so the replica only waits to the floor).
+            w.fed_source = self.fed_source
             # Register under the leadership lock: an election landing here
             # must either see the worker (establish pauses it) or have
             # already set _leader (we pause it ourselves).
@@ -329,6 +375,11 @@ class Server:
         self.fsm.on_job_upsert = self.periodic.add
         self.fsm.on_job_delete = self.periodic.remove
 
+        if self.fed_source is not None:
+            # A new term may follow a snapshot restore that swapped the
+            # store's tables wholesale; drop the cached snapshot so the
+            # first window observes the restored world.
+            self.fed_source.invalidate()
         self._restore_evals()
         self._restore_periodic_dispatcher()
         self._warm_failover_state()
@@ -369,6 +420,7 @@ class Server:
             w.core_scheduler = self.core_sched
             w.qos = self.qos
             w.qos_counters = self.qos_counters
+            w.fed_source = self.fed_source
             w.start(name=f"worker-{i}")
             self.workers.append(w)
 
@@ -383,9 +435,30 @@ class Server:
                          self.config.node_gc_interval)
         self._start_loop(self.blocked_evals.unblock_failed,
                          self.config.failed_eval_unblock_interval)
+        if federation_enabled(self.fed):
+            self._start_loop(self._poll_federation_health,
+                             self.fed.health_interval_s)
         self._start_loop(self._emit_stats, 1.0)
         metrics.measure_since(("nomad", "server", "failover",
                                "establish_ms"), t_establish)
+
+    def _poll_federation_health(self) -> None:
+        """One leader-loop round of the federation health view: the
+        local region's entry straight from its own broker (no RPC), plus
+        every other region via the membership plane's Federation.Health
+        poll (fed_poll hook, wired by ClusterServer.enable_gossip)."""
+        if self.fed_health is None:
+            return
+        self.fed_health.update(self.config.region, health_payload(self))
+        if self.fed_poll is not None:
+            self.fed_poll()
+
+    def admit_forward(self, region: str, priority: int) -> None:
+        """Edge-shed gate for a cross-region forward (see
+        AdmissionController.admit_forward); raises QoSBackpressureError
+        before the WAN hop when the home region's cached health says the
+        tier would be shed there anyway."""
+        self.admission.admit_forward(region, priority)
 
     def _warm_failover_state(self) -> None:
         """Re-seed device-side leader state from the replicated store.
@@ -514,6 +587,9 @@ class Server:
                                   burn[tier])
             metrics.set_gauge(("nomad", "qos", "tier", "promoted"),
                               self.eval_broker.tier_promotions())
+        if federation_enabled(self.fed):
+            metrics.set_gauge(("nomad", "federation", "foreign_parked"),
+                              self.eval_broker.foreign_count())
 
     def _start_loop(self, fn, interval: float) -> None:
         def loop():
@@ -682,6 +758,7 @@ class Server:
             Type=JobTypeCore,
             TriggeredBy="scheduled",
             JobID=f"{kind}:{self.raft.last_index}",
+            Region=self._ev_region(None),
             Status=EvalStatusPending,
             ModifyIndex=self.raft.last_index,
         )
@@ -690,13 +767,31 @@ class Server:
     # ========================================================== endpoints ==
     # Job endpoint (reference: nomad/job_endpoint.go)
 
+    def _default_region(self, job: Job) -> None:
+        """THE one place a submitted job's empty Region defaults to this
+        server's — register and plan ingress both stamp through here, so
+        a job forwarded to its home region carries one consistent Region
+        on the job, its evals (_ev_region), and its allocs (which embed
+        the job) end to end."""
+        if not job.Region:
+            job.Region = self.config.region
+
+    def _ev_region(self, job: Optional[Job]) -> str:
+        """Home region stamped onto evaluations. Federation only — ""
+        (the pre-federation value) when disabled, keeping the default
+        path bit-identical."""
+        if not federation_enabled(self.fed):
+            return ""
+        if job is not None and job.Region:
+            return job.Region
+        return self.config.region
+
     def job_register(self, job: Job, enforce_index: Optional[int] = None,
                      trigger: str = EvalTriggerJobRegister
                      ) -> Tuple[str, int, int]:
         """Returns (eval_id, job_modify_index, index)."""
         job.init_fields()
-        if not job.Region:
-            job.Region = self.config.region
+        self._default_region(job)
         errs = job.validate()
         if errs:
             raise ValueError("; ".join(errs))
@@ -725,6 +820,7 @@ class Server:
             Type=job.Type,
             TriggeredBy=trigger,
             JobID=job.ID,
+            Region=self._ev_region(job),
             JobModifyIndex=index,
             Status=EvalStatusPending,
         )
@@ -746,8 +842,7 @@ class Server:
         from nomad_tpu.structs.diff import job_diff
 
         job.init_fields()
-        if not job.Region:
-            job.Region = self.config.region
+        self._default_region(job)
         errs = job.validate()
         if errs:
             raise ValueError("; ".join(errs))
@@ -831,6 +926,7 @@ class Server:
             Type=jtype,
             TriggeredBy=EvalTriggerJobDeregister,
             JobID=job_id,
+            Region=self._ev_region(job),
             JobModifyIndex=index,
             Status=EvalStatusPending,
         )
@@ -852,6 +948,7 @@ class Server:
             Type=job.Type,
             TriggeredBy=EvalTriggerJobRegister,
             JobID=job.ID,
+            Region=self._ev_region(job),
             JobModifyIndex=job.JobModifyIndex,
             Status=EvalStatusPending,
         )
@@ -954,6 +1051,7 @@ class Server:
             evals.append(Evaluation(
                 ID=generate_uuid(), Priority=priority, Type=jtype,
                 TriggeredBy=EvalTriggerNodeUpdate, JobID=alloc.JobID,
+                Region=self._ev_region(job),
                 NodeID=node_id, NodeModifyIndex=index,
                 Status=EvalStatusPending))
         for job in self.state.jobs_by_scheduler(JobTypeSystem):
@@ -962,6 +1060,7 @@ class Server:
             evals.append(Evaluation(
                 ID=generate_uuid(), Priority=job.Priority, Type=job.Type,
                 TriggeredBy=EvalTriggerNodeUpdate, JobID=job.ID,
+                Region=self._ev_region(job),
                 NodeID=node_id, NodeModifyIndex=index,
                 Status=EvalStatusPending))
         if evals:
@@ -1075,5 +1174,6 @@ class Server:
             ID=generate_uuid(), Priority=CoreJobPriority, Type=JobTypeCore,
             TriggeredBy="scheduled",
             JobID=f"{CoreJobForceGC}:{self.raft.last_index}",
+            Region=self._ev_region(None),
             Status=EvalStatusPending)
         self.eval_broker.enqueue(ev)

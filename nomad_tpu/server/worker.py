@@ -49,6 +49,21 @@ DEQUEUE_TIMEOUT = 0.5
 PLAN_WAIT = 30.0
 
 
+def stamp_fed_born(plan: Plan, born: Optional[float]) -> None:
+    """Stamp a federation snapshot's birth time onto a plan built from
+    it (the applier's staleness reject reads `plan._fed_born`) and
+    observe the plan's snapshot age — nomad.federation.staleness_ms, the
+    per-plan staleness signal. THE one stamping site for both the
+    classic worker and the pipelined window path; no-op when the plan
+    came from a direct live snapshot (born None, federation off or the
+    exact-path oracle)."""
+    if born is None:
+        return
+    plan._fed_born = born
+    metrics.add_sample(("nomad", "federation", "staleness_ms"),
+                       (time.monotonic() - born) * 1e3)
+
+
 class PartialPlanError(Exception):
     """A chunked plan sweep failed mid-sequence. Carries the results of
     every chunk whose wait completed BEFORE the failure, so callers can
@@ -84,6 +99,18 @@ class LocalBackend:
         # duplicate eval created before an earlier eval's plan committed
         # would schedule against pre-plan state and double-place the job
         # (the soak test's 6-of-3 duplication).
+        if ev is not None:
+            # Federation: the broker's release floor — the store index at
+            # which THIS eval became ready — is a sufficient (and much
+            # smaller) freshness bound: per-job serialization means no
+            # plan for the eval's job commits after its release, so a
+            # snapshot at the floor can never double-place. Lets shared
+            # follower snapshots serve whole storm bursts instead of
+            # chasing the leader's every commit. None when federation is
+            # off: the pre-federation global-latest bound below.
+            floor = self.eval_broker.release_floor(ev.ID)
+            if floor is not None:
+                return ev, token, floor
         return ev, token, self.raft.fsm.state.latest_index()
 
     def ack(self, eval_id: str, token: str) -> None:
@@ -286,6 +313,15 @@ class Worker:
         # Set by the server: handles `_core` GC evals (reference:
         # worker.go invokeScheduler -> scheduler.NewScheduler("_core")).
         self.core_scheduler = None
+        # Federation (set by the server when ServerConfig.federation is
+        # enabled): the shared staleness-bounded SnapshotSource this
+        # worker schedules from, and the birth time of the snapshot the
+        # CURRENT eval is placing against (stamped onto its plans so the
+        # applier can reject over-stale ones). None = federation off:
+        # every snapshot below is a direct live-store snapshot, the
+        # pre-federation path bit-for-bit.
+        self.fed_source = None
+        self._fed_born: Optional[float] = None
 
     # ------------------------------------------------------------- lifecycle
     def start(self, name: str = "worker") -> None:
@@ -331,8 +367,9 @@ class Worker:
                 with trace.resume(trace.linked("eval", ev.ID),
                                   "worker.process_eval",
                                   eval=ev.ID, type=ev.Type):
-                    self._wait_for_index(max(ev.ModifyIndex, wait_index))
-                    self._invoke_scheduler(ev, token)
+                    min_index = max(ev.ModifyIndex, wait_index)
+                    self._wait_for_index(min_index)
+                    self._invoke_scheduler(ev, token, min_index=min_index)
             except Exception:
                 # Leadership loss tears down the plan queue / broker under a
                 # mid-flight eval; drop quietly, redelivery handles the rest
@@ -360,8 +397,9 @@ class Worker:
             with trace.resume(trace.linked("eval", ev.ID),
                               "worker.process_eval",
                               eval=ev.ID, type=ev.Type):
-                self._wait_for_index(max(ev.ModifyIndex, wait_index))
-                self._invoke_scheduler(ev, token)
+                min_index = max(ev.ModifyIndex, wait_index)
+                self._wait_for_index(min_index)
+                self._invoke_scheduler(ev, token, min_index=min_index)
         except Exception:
             logger.exception("worker: failed to process eval %s", ev.ID)
             self._send_nack(ev.ID, token)
@@ -410,17 +448,30 @@ class Worker:
             metrics.measure_since(("nomad", "worker", "wait_for_index"),
                                   start)
 
-    def _invoke_scheduler(self, ev: Evaluation, token: str) -> None:
+    def _invoke_scheduler(self, ev: Evaluation, token: str,
+                          min_index: Optional[int] = None) -> None:
         """(reference: worker.go:246-283; timed per scheduler type like
         worker.go's invoke_scheduler MeasureSince). Resumes the eval's
         trace when not already inside it (the pipelined slow/fallback
-        path calls this without the run loop's ambient span)."""
+        path calls this without the run loop's ambient span).
+
+        ``min_index`` (the dequeue-time release floor) opts the eval
+        into the federation SnapshotSource: a run-loop eval may place
+        against the shared staleness-bounded snapshot, while fallback
+        re-runs (pipelined slow path — whose plan just failed against
+        possibly-stale state) pass None and always get a direct fresh
+        snapshot, preserving the exact-path oracle semantics."""
         start = time.monotonic()
         try:
             with trace.resume(trace.linked("eval", ev.ID),
                               "worker.invoke_scheduler",
                               eval=ev.ID, type=ev.Type):
-                self._snapshot = self.raft.fsm.state.snapshot()
+                if min_index is not None and self.fed_source is not None:
+                    self._snapshot, self._fed_born = \
+                        self.fed_source.get(min_index)
+                else:
+                    self._snapshot = self.raft.fsm.state.snapshot()
+                    self._fed_born = None
                 if ev.Type == "_core":
                     if self.core_scheduler is not None:
                         self.core_scheduler.process(ev)
@@ -453,10 +504,17 @@ class Worker:
             logger.exception("worker: nack failed for %s", eval_id)
 
     # --------------------------------------------------------- Planner seam
+    def _stamp_fed_born(self, plan: Plan) -> None:
+        """The current eval's snapshot birth time onto its plan. getattr:
+        harness code builds bare Workers via __new__ for backend-seam
+        tests."""
+        stamp_fed_born(plan, getattr(self, "_fed_born", None))
+
     def submit_plan(self, plan: Plan) -> Tuple[Optional[PlanResult], Optional[object]]:
         """(reference: worker.go:285-342)"""
         start = time.monotonic()
         plan.EvalToken = self._token
+        self._stamp_fed_born(plan)
         try:
             with trace.span("worker.submit_plan", eval=plan.EvalID):
                 result = self.backend.submit_plan(plan)
@@ -470,6 +528,10 @@ class Worker:
         if result is not None and result.RefreshIndex > 0:
             self._wait_for_index(result.RefreshIndex)
             state = self.raft.fsm.state.snapshot()
+            # The retry replans from a DIRECT fresh snapshot: its plans
+            # are born now, not at the original source handout.
+            if getattr(self, "_fed_born", None) is not None:
+                self._fed_born = time.monotonic()
         return result, state
 
     def plan_queue_depth(self) -> int:
@@ -498,6 +560,7 @@ class Worker:
         start = time.monotonic()
         for plan in plans:
             plan.EvalToken = self._token
+            self._stamp_fed_born(plan)
         partial = False
         try:
             with trace.span("worker.submit_plans", chunks=len(plans)):
@@ -546,6 +609,8 @@ class Worker:
         if refresh > 0:
             self._wait_for_index(refresh)
             state = self.raft.fsm.state.snapshot()
+            if getattr(self, "_fed_born", None) is not None:
+                self._fed_born = time.monotonic()
         return results, state
 
     def update_eval(self, ev: Evaluation) -> None:

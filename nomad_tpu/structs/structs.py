@@ -1085,6 +1085,11 @@ class Evaluation:
     Type: str = ""
     TriggeredBy: str = ""
     JobID: str = ""
+    # Home region of the eval's job (federation): stamped at creation
+    # when ServerConfig.federation is enabled so the broker can route
+    # region-aware; "" (the default, and the only value when federation
+    # is off) means region-agnostic — pre-federation behavior.
+    Region: str = ""
     JobModifyIndex: int = 0
     NodeID: str = ""
     NodeModifyIndex: int = 0
@@ -1150,6 +1155,7 @@ class Evaluation:
             Type=self.Type,
             TriggeredBy=EvalTriggerRollingUpdate,
             JobID=self.JobID,
+            Region=self.Region,
             JobModifyIndex=self.JobModifyIndex,
             Status=EvalStatusPending,
             Wait=wait,
@@ -1165,6 +1171,7 @@ class Evaluation:
             Type=self.Type,
             TriggeredBy=self.TriggeredBy,
             JobID=self.JobID,
+            Region=self.Region,
             JobModifyIndex=self.JobModifyIndex,
             Status=EvalStatusBlocked,
             PreviousEval=self.ID,

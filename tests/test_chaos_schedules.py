@@ -436,6 +436,94 @@ class TestServiceStoreCommitSchedule:
             srv.shutdown()
 
 
+class TestRegionForwardSchedule:
+    """ISSUE 14 site: the cross-region forward (rpc.forward_region,
+    federation/routing.py). A region link killed mid-forward — in BOTH
+    halves: before the request leaves (error) and after delivery with
+    the response lost (drop, the ambiguous WAN failure) — must yield
+    EXACTLY-ONCE registration in the home region: one job, ONE eval (no
+    duplicates from the replay), the full placement, and nothing in the
+    forwarding region."""
+
+    @staticmethod
+    def _boot_region(name, region, join=None):
+        from nomad_tpu.federation import FederationConfig
+        from nomad_tpu.gossip import GossipConfig
+
+        cs = ClusterServer(ServerConfig(
+            node_id="", region=region, num_schedulers=1,
+            scheduler_window=8, bootstrap_expect=1,
+            federation=FederationConfig(enabled=True)))
+        cs.connect([], raft_config=FAST)
+        cs.start()
+        cs.enable_gossip(name, join=join,
+                         gossip_config=GossipConfig.fast())
+        return cs
+
+    def test_link_killed_mid_forward_registers_exactly_once(self):
+        a = self._boot_region("a0", "alpha")
+        b = None
+        try:
+            assert wait_for(lambda: a.server.is_leader(), timeout=15)
+            b = self._boot_region(
+                "b0", "beta",
+                join=[f"{a.membership.memberlist.addr}:"
+                      f"{a.membership.memberlist.port}"])
+            assert wait_for(lambda: b.server.is_leader(), timeout=15)
+            assert wait_for(
+                lambda: b.membership.region_servers("alpha"), timeout=15)
+            for _ in range(4):
+                a.endpoints.handle("Node.Register",
+                                   {"Node": to_dict(mock.node())})
+
+            # Half 1: response lost AFTER delivery (drop) — the replay
+            # must dedupe on alpha's side.
+            job1 = make_job()
+            job1.Region = "alpha"
+            with ChaosSchedule(name="region-forward-drop") \
+                    .arm(0.0, "rpc.forward_region=drop:count=1") as sched:
+                sched.join(2.0)
+                resp1 = b.endpoints.handle("Job.Register",
+                                           {"Job": to_dict(job1)})
+            # Half 2: link failed BEFORE send (error) — plain retry.
+            job2 = make_job()
+            job2.Region = "alpha"
+            with ChaosSchedule(name="region-forward-error") \
+                    .arm(0.0, "rpc.forward_region=error:count=1") as sched:
+                sched.join(2.0)
+                resp2 = b.endpoints.handle("Job.Register",
+                                           {"Job": to_dict(job2)})
+            snap = failpoints.snapshot()
+            assert snap["rpc.forward_region"]["fired"] >= 2, \
+                "the forward seam never fired — site renamed?"
+
+            state = a.server.state
+            for job, resp in ((job1, resp1), (job2, resp2)):
+                assert resp["EvalID"], resp
+                # Exactly-once registration: ONE eval for the job in the
+                # home region (a replayed register would mint a second).
+                assert wait_for(
+                    lambda j=job: state.job_by_id(j.ID) is not None,
+                    timeout=15)
+                evals = state.evals_by_job(job.ID)
+                assert len(evals) == 1, [e.ID for e in evals]
+                assert evals[0].ID == resp["EvalID"]
+                assert evals[0].Region == "alpha"
+                # ...and the forwarding region owns nothing.
+                assert b.server.state.job_by_id(job.ID) is None
+                assert b.server.state.evals_by_job(job.ID) == []
+            assert wait_for(
+                lambda: _all_terminal(state,
+                                      [resp1["EvalID"], resp2["EvalID"]]),
+                timeout=30, msg="forwarded evals terminal")
+            assert_invariants(state, [job1, job2], per_job=PER_JOB,
+                              eval_ids=[resp1["EvalID"], resp2["EvalID"]])
+        finally:
+            if b is not None:
+                b.shutdown()
+            a.shutdown()
+
+
 class TestBlockedWakeupSchedule:
     """ROADMAP candidate site: the blocked-evals capacity wakeup. A lost
     wakeup event (dropped at the seam) strands parked evals ONLY until

@@ -1,0 +1,405 @@
+"""Federation unit tests: snapshot source, region forwarder, forward
+dedupe, health view, broker floors, and the admission edge-shed
+(ISSUE 14; the end-to-end gates live in test_federation_equivalence.py
+and the chaos schedule in test_chaos_schedules.py)."""
+
+import pytest
+
+from nomad_tpu.federation import (
+    FederationConfig,
+    FederationHealth,
+    ForwardDedup,
+    NoRegionPathError,
+    RegionForwarder,
+    SnapshotSource,
+)
+from nomad_tpu.qos import AdmissionController, QoSConfig, QoSCounters
+from nomad_tpu.qos.admission import QoSBackpressureError
+from nomad_tpu.resilience import failpoints
+from nomad_tpu.rpc.pool import ConnError, RPCError
+
+
+class _FakeState:
+    def __init__(self):
+        self.index = 0
+        self.snaps = 0
+
+    def latest_index(self):
+        return self.index
+
+    def snapshot(self):
+        self.snaps += 1
+
+        class Snap:
+            watermark = self.index
+        return Snap()
+
+
+class TestSnapshotSource:
+    def _source(self, max_staleness=1.0):
+        state = _FakeState()
+        clock = {"t": 100.0}
+        src = SnapshotSource(
+            state, FederationConfig(enabled=True,
+                                    max_staleness_s=max_staleness),
+            clock=lambda: clock["t"])
+        return state, clock, src
+
+    def test_reuse_within_bound_refresh_past_it(self):
+        state, clock, src = self._source(max_staleness=1.0)
+        s1, born1 = src.get()
+        s2, born2 = src.get()
+        assert s1 is s2 and born1 == born2
+        assert state.snaps == 1
+        clock["t"] += 1.5  # past the bound
+        s3, born3 = src.get()
+        assert s3 is not s1 and born3 > born1
+        assert state.snaps == 2
+        assert src.stats()["Reused"] == 1
+        assert src.stats()["Refreshed"] == 2
+
+    def test_min_index_forces_refresh(self):
+        state, clock, src = self._source()
+        s1, _ = src.get()
+        state.index = 7  # store moved past the cached watermark
+        s2, _ = src.get(min_index=7)
+        assert s2 is not s1 and s2.watermark == 7
+
+    def test_pin_serves_stale_until_unpin(self):
+        state, clock, src = self._source()
+        pinned = state.snapshot()
+        src.pin(pinned, born=clock["t"] - 50.0)
+        s, born = src.get(min_index=10**9)  # pin wins over every bound
+        assert s is pinned and born == clock["t"] - 50.0
+        src.unpin()
+        s2, _ = src.get()
+        assert s2 is not pinned
+
+
+class _FakePool:
+    """pool.call stub: scripted per-addr behaviors."""
+
+    def __init__(self, behaviors):
+        self.behaviors = dict(behaviors)  # addr -> callable(method, body)
+        self.calls = []
+
+    def call(self, addr, method, body, timeout=None):
+        self.calls.append((addr, method, dict(body)))
+        return self.behaviors[addr](method, body)
+
+
+class TestRegionForwarder:
+    def test_retries_next_peer_on_transport_error(self):
+        pool = _FakePool({
+            "dead:1": lambda m, b: (_ for _ in ()).throw(
+                ConnError("down")),
+            "live:1": lambda m, b: {"ok": True},
+        })
+        fwd = RegionForwarder(pool, lambda r: ["dead:1", "live:1"],
+                              fed=FederationConfig(enabled=True))
+        assert fwd.forward("west", "Job.Register", {}) == {"ok": True}
+        assert [a for a, _, _ in pool.calls] == ["dead:1", "live:1"]
+
+    def test_single_peer_retried_on_transient_error(self):
+        flaky = {"n": 0}
+
+        def behave(m, b):
+            flaky["n"] += 1
+            if flaky["n"] == 1:
+                raise ConnError("blip")
+            return {"ok": flaky["n"]}
+
+        pool = _FakePool({"only:1": behave})
+        fwd = RegionForwarder(pool, lambda r: ["only:1"],
+                              fed=FederationConfig(enabled=True))
+        assert fwd.forward("west", "Job.Register", {})["ok"] == 2
+
+    def test_forward_id_stamped_once_and_stable_across_retries(self):
+        flaky = {"n": 0}
+
+        def behave(m, b):
+            flaky["n"] += 1
+            if flaky["n"] == 1:
+                raise ConnError("blip")
+            return {}
+
+        pool = _FakePool({"a:1": behave})
+        fwd = RegionForwarder(pool, lambda r: ["a:1"],
+                              fed=FederationConfig(enabled=True))
+        fwd.forward("west", "Job.Register", {"Job": {}})
+        ids = {b["ForwardID"] for _, _, b in pool.calls}
+        assert len(ids) == 1 and ids != {None}
+        # Reads are not stamped.
+        pool2 = _FakePool({"a:1": lambda m, b: {}})
+        fwd2 = RegionForwarder(pool2, lambda r: ["a:1"],
+                               fed=FederationConfig(enabled=True))
+        fwd2.forward("west", "Job.List", {})
+        assert "ForwardID" not in pool2.calls[0][2]
+
+    def test_remote_error_not_retried(self):
+        pool = _FakePool({
+            "a:1": lambda m, b: (_ for _ in ()).throw(
+                RPCError("ValueError: bad job")),
+        })
+        fwd = RegionForwarder(pool, lambda r: ["a:1"],
+                              fed=FederationConfig(enabled=True))
+        with pytest.raises(RPCError):
+            fwd.forward("west", "Job.Register", {})
+        assert len(pool.calls) == 1  # the handler's answer IS the answer
+
+    def test_breaker_quarantines_dead_peer(self):
+        pool = _FakePool({
+            "dead:1": lambda m, b: (_ for _ in ()).throw(
+                ConnError("down")),
+        })
+        fed = FederationConfig(enabled=True, forward_attempts=2,
+                               forward_breaker_threshold=2,
+                               forward_breaker_reset_s=60.0)
+        fwd = RegionForwarder(pool, lambda r: ["dead:1"], fed=fed)
+        with pytest.raises(ConnError):
+            fwd.forward("west", "Job.Register", {})
+        assert fwd.breaker_state("dead:1") == "open"
+        # Quarantined: the next forward fails FAST with a typed
+        # no-path error instead of another connect timeout.
+        before = len(pool.calls)
+        with pytest.raises(NoRegionPathError):
+            fwd.forward("west", "Job.Register", {})
+        assert len(pool.calls) == before
+
+    def test_no_peers_is_no_path(self):
+        fwd = RegionForwarder(_FakePool({}), lambda r: [],
+                              fed=FederationConfig(enabled=True))
+        with pytest.raises(NoRegionPathError):
+            fwd.forward("nowhere", "Job.Register", {})
+
+    def test_drop_failpoint_delivers_then_retries(self):
+        """drop = the ambiguous failure: the request REACHES the region
+        (the call happens) but the response is lost; the retry replays
+        the same ForwardID."""
+        pool = _FakePool({"a:1": lambda m, b: {}})
+        fwd = RegionForwarder(pool, lambda r: ["a:1"],
+                              fed=FederationConfig(enabled=True))
+        failpoints.disarm_all()
+        try:
+            failpoints.arm("rpc.forward_region", "drop", count=1)
+            fwd.forward("west", "Job.Register", {"Job": {}})
+            assert len(pool.calls) == 2  # delivered twice...
+            assert pool.calls[0][2]["ForwardID"] \
+                == pool.calls[1][2]["ForwardID"]  # ...same identity
+        finally:
+            failpoints.disarm_all()
+
+
+class TestForwardDedup:
+    def test_replay_answers_from_cache(self):
+        d = ForwardDedup()
+        hit, _ = d.get("id-1")
+        assert not hit
+        d.put("id-1", {"EvalID": "e1"})
+        hit, resp = d.get("id-1")
+        assert hit and resp == {"EvalID": "e1"}
+
+    def test_lru_bound(self):
+        d = ForwardDedup(cap=2)
+        d.put("a", 1)
+        d.put("b", 2)
+        d.put("c", 3)
+        assert not d.get("a")[0]
+        assert d.get("b")[0] and d.get("c")[0]
+
+    def test_replay_during_execution_parks_until_put(self):
+        """The ambiguous-WAN race: a replay arriving while the ORIGINAL
+        delivery is still executing must wait for its answer, never
+        start a second concurrent execution."""
+        import threading
+
+        d = ForwardDedup()
+        hit, _ = d.begin("id-1")
+        assert not hit  # reserved by the "original delivery"
+        got = {}
+
+        def replay():
+            got["result"] = d.begin("id-1", timeout=10.0)
+
+        t = threading.Thread(target=replay)
+        t.start()
+        t.join(timeout=0.2)
+        assert t.is_alive(), "replay must park on the reservation"
+        d.put("id-1", {"EvalID": "e1"})
+        t.join(timeout=10)
+        assert not t.is_alive()
+        assert got["result"] == (True, {"EvalID": "e1"})
+
+    def test_abort_lets_replay_reexecute(self):
+        """A handler that raised committed nothing: the parked replay
+        takes over the reservation (miss) and re-executes."""
+        import threading
+
+        d = ForwardDedup()
+        assert d.begin("id-1") == (False, None)
+        got = {}
+
+        def replay():
+            got["result"] = d.begin("id-1", timeout=10.0)
+
+        t = threading.Thread(target=replay)
+        t.start()
+        t.join(timeout=0.2)
+        assert t.is_alive()
+        d.abort("id-1")
+        t.join(timeout=10)
+        assert not t.is_alive()
+        assert got["result"] == (False, None)  # replay now owns the id
+        d.put("id-1", "second-try")
+        assert d.get("id-1") == (True, "second-try")
+
+
+class TestFederationHealth:
+    def _view(self, ttl=10.0):
+        clock = {"t": 0.0}
+        fed = FederationConfig(enabled=True, health_ttl_s=ttl)
+        return clock, FederationHealth(fed, clock=lambda: clock["t"])
+
+    def test_shedding_on_remote_depth(self):
+        clock, h = self._view()
+        h.update("west", {"TierDepths": [0, 0, 5000],
+                          "SLOBurn": [0.0, 0.0, 0.0],
+                          "AdmitDepth": [0, 8192, 2048],
+                          "BurnShed": 0.5})
+        assert h.region_shedding("west", 2) is not None
+        assert h.region_shedding("west", 0) is None
+
+    def test_shedding_on_remote_burn(self):
+        clock, h = self._view()
+        h.update("west", {"TierDepths": [3, 0, 0],
+                          "SLOBurn": [0.9, 0.0, 0.0],
+                          "AdmitDepth": [0, 8192, 2048],
+                          "BurnShed": 0.5})
+        assert h.region_shedding("west", 2) is not None  # high burning
+        assert h.region_shedding("west", 0) is None
+
+    def test_stale_entry_assumed_healthy(self):
+        clock, h = self._view(ttl=5.0)
+        h.update("west", {"TierDepths": [0, 0, 5000],
+                          "SLOBurn": [0, 0, 0],
+                          "AdmitDepth": [0, 0, 1],
+                          "BurnShed": 0.5})
+        clock["t"] += 6.0
+        assert h.get("west") is None
+        assert h.region_shedding("west", 2) is None
+
+
+class _FakeBroker:
+    def __init__(self):
+        self.depths = [0, 0, 0]
+        self.burn = [0.0, 0.0, 0.0]
+
+    def tier_depths(self):
+        return list(self.depths)
+
+    def slo_burn(self):
+        return list(self.burn)
+
+
+class TestAdmitForward:
+    def test_sheds_on_remote_health(self):
+        fed = FederationConfig(enabled=True)
+        health = FederationHealth(fed)
+        health.update("west", {"TierDepths": [0, 0, 5000],
+                               "SLOBurn": [0, 0, 0],
+                               "AdmitDepth": [0, 8192, 2048],
+                               "BurnShed": 0.5})
+        counters = QoSCounters()
+        adm = AdmissionController(QoSConfig(enabled=True), _FakeBroker(),
+                                  counters, fed=fed, fed_health=health)
+        with pytest.raises(QoSBackpressureError):
+            adm.admit_forward("west", 10)  # low tier, remote backlog
+        adm.admit_forward("west", 90)      # high tier passes
+        assert counters.snapshot()["forward_shed"] == 1
+
+    def test_noop_without_federation(self):
+        adm = AdmissionController(QoSConfig(enabled=True), _FakeBroker(),
+                                  QoSCounters())
+        adm.admit_forward("west", 10)  # never raises
+
+
+class TestRegionStampEndToEnd:
+    """ISSUE 14 satellite: a job forwarded to its home region keeps
+    Region stamped consistently on the job, its evals, and its allocs
+    end to end — and the forward triggers on job.Region ALONE (no
+    Region query param), the ingress hole the one-helper
+    ``_default_region`` dedupe closes."""
+
+    def test_forwarded_job_keeps_region_on_job_evals_allocs(self):
+        from helpers import wait_for
+
+        from nomad_tpu import mock
+        from nomad_tpu.gossip import GossipConfig
+        from nomad_tpu.raft import RaftConfig
+        from nomad_tpu.rpc.cluster import ClusterServer
+        from nomad_tpu.server.server import ServerConfig
+        from nomad_tpu.structs import to_dict
+        from nomad_tpu.structs.structs import EvalStatusComplete
+
+        fast = RaftConfig(heartbeat_interval=0.02,
+                          election_timeout_min=0.08,
+                          election_timeout_max=0.16, apply_timeout=5.0)
+
+        def boot(name, region, join=None):
+            cs = ClusterServer(ServerConfig(
+                node_id="", region=region, num_schedulers=1,
+                scheduler_window=8, bootstrap_expect=1,
+                federation=FederationConfig(enabled=True)))
+            cs.connect([], raft_config=fast)
+            cs.start()
+            cs.enable_gossip(name, join=join,
+                             gossip_config=GossipConfig.fast())
+            return cs
+
+        a = boot("ra0", "alpha")
+        b = None
+        try:
+            assert wait_for(lambda: a.server.is_leader(), timeout=15)
+            b = boot("rb0", "beta",
+                     join=[f"{a.membership.memberlist.addr}:"
+                           f"{a.membership.memberlist.port}"])
+            assert wait_for(lambda: b.server.is_leader(), timeout=15)
+            assert wait_for(
+                lambda: b.membership.region_servers("alpha"), timeout=15)
+            for _ in range(3):
+                a.endpoints.handle("Node.Register",
+                                   {"Node": to_dict(mock.node())})
+            job = mock.job()
+            job.Region = "alpha"
+            job.TaskGroups[0].Count = 3
+            task = job.TaskGroups[0].Tasks[0]
+            task.Resources.CPU = 20
+            task.Resources.MemoryMB = 32
+            task.Resources.Networks = []
+            task.Services = []
+            if task.LogConfig is not None:
+                task.LogConfig.MaxFiles = 1
+                task.LogConfig.MaxFileSizeMB = 1
+            # NOTE: no Region query param — the forward keys off
+            # job.Region at ingress, before any raft write.
+            resp = b.endpoints.handle("Job.Register",
+                                      {"Job": to_dict(job)})
+            eid = resp["EvalID"]
+            state = a.server.state
+            stored = state.job_by_id(job.ID)
+            assert stored is not None and stored.Region == "alpha"
+            assert b.server.state.job_by_id(job.ID) is None
+            ev = state.eval_by_id(eid)
+            assert ev is not None and ev.Region == "alpha"
+            assert wait_for(
+                lambda: (e := state.eval_by_id(eid)) is not None
+                and e.Status == EvalStatusComplete, timeout=30)
+            allocs = state.allocs_by_job(job.ID)
+            assert len(allocs) == 3
+            for alloc in allocs:
+                assert alloc.Job is not None \
+                    and alloc.Job.Region == "alpha"
+        finally:
+            if b is not None:
+                b.shutdown()
+            a.shutdown()

@@ -1,0 +1,324 @@
+"""Federation equivalence gates (ISSUE 14 satellite).
+
+Three fixed-seed gates, same discipline as tests/test_qos.py and
+tests/test_columnar_store_equivalence.py:
+
+1. A follower-snapshot-scheduled storm (workers placing through the
+   staleness-bounded SnapshotSource) places IDENTICALLY to the
+   leader-scheduled oracle (fresh per-eval live-store snapshots): same
+   nodes, same scores — on both the synchronous exact path and the live
+   pipelined served path.
+2. A deliberately-staled snapshot (pinned far past the bound) gets its
+   plan REJECTED by the applier (StaleSnapshotError) and the eval
+   redelivered exactly once onto a fresh snapshot — no lost evals, no
+   duplicate allocs.
+3. ``federation=None`` is bit-identical to the pre-federation path
+   (placements, completion order, and the disarmed internals: no
+   release floors, no Region stamps, no plan birth stamps).
+"""
+
+import time
+
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.federation import FederationConfig, StaleSnapshotError
+from nomad_tpu.server.server import Server, ServerConfig
+from nomad_tpu.server.worker import Worker
+from nomad_tpu.structs import Evaluation, compute_node_class
+from nomad_tpu.structs.structs import EvalStatusComplete
+
+from helpers import wait_for  # noqa: E402
+
+
+def _build_fleet(n):
+    """Deterministic fleet: stable IDs and strictly distinct capacities
+    so binpack scores differ by far more than the tie-break noise and
+    placement argmaxes are reproducible across servers."""
+    nodes = []
+    for i in range(n):
+        node = mock.node()
+        node.ID = f"node-{i:03d}"
+        node.Name = f"node-{i:03d}"
+        node.Resources.CPU = 4000 + 100 * i
+        node.Reserved = None
+        compute_node_class(node)
+        nodes.append(node)
+    return nodes
+
+
+def _storm_job(jid, count=3, prio=50):
+    job = mock.job()
+    job.ID = jid
+    job.Name = jid
+    job.Priority = prio
+    tg = job.TaskGroups[0]
+    tg.Count = count
+    task = tg.Tasks[0]
+    task.Resources.CPU = 100
+    task.Resources.MemoryMB = 32
+    task.Resources.DiskMB = 10
+    task.Resources.Networks = []
+    task.Services = []
+    if task.LogConfig is not None:
+        task.LogConfig.MaxFiles = 1
+        task.LogConfig.MaxFileSizeMB = 1
+    return job
+
+
+def _placements_with_scores(srv, eval_ids):
+    """{alloc.Name: (NodeID, winning score)} over every eval's allocs."""
+    out = {}
+    for eid in eval_ids:
+        for a in srv.state.allocs_by_eval(eid):
+            score = None
+            if a.Metrics is not None and a.Metrics.Scores:
+                score = max(a.Metrics.Scores.values())
+            out[a.Name] = (a.NodeID, score)
+    return out
+
+
+def _assert_same_placements(a, b):
+    """Same alloc set, same nodes, same scores — scores compared within
+    the per-server tie-break noise (make_noise_vec, <= 1e-3), which
+    exists precisely to spread ties and differs between servers while
+    the argmax (the fleet's distinct capacities dominate) does not."""
+    assert sorted(a) == sorted(b)
+    for name in a:
+        node_a, score_a = a[name]
+        node_b, score_b = b[name]
+        assert node_a == node_b, (name, a[name], b[name])
+        if score_a is not None and score_b is not None:
+            assert abs(score_a - score_b) < 5e-3, (name, a[name], b[name])
+        else:
+            assert score_a == score_b, (name, a[name], b[name])
+
+
+def _run_storm_sync(federation):
+    """Fixed-order storm drained synchronously by one worker (no live
+    threads -> no timing nondeterminism). Returns (placements, order)."""
+    srv = Server(ServerConfig(num_schedulers=0, federation=federation,
+                              min_heartbeat_ttl=24 * 3600.0,
+                              heartbeat_grace=24 * 3600.0))
+    srv.establish_leadership()
+    try:
+        for node in _build_fleet(10):
+            srv.node_register(node)
+        eval_of = {}
+        for i in range(8):
+            eval_of[srv.job_register(_storm_job(f"job-{i}"))[0]] = \
+                f"job-{i}"
+        w = Worker(srv.raft, srv.eval_broker, srv.plan_queue,
+                   srv.blocked_evals, srv.tindex)
+        w.fed_source = srv.fed_source
+        order = []
+        seen = set()
+        for _ in range(len(eval_of) * 3):
+            if not w.process_one(timeout=0.05):
+                break
+            for eid, jid in eval_of.items():
+                e = srv.state.eval_by_id(eid)
+                if (e is not None and e.Status == EvalStatusComplete
+                        and eid not in seen):
+                    seen.add(eid)
+                    order.append(jid)
+        for eid, jid in eval_of.items():
+            e = srv.state.eval_by_id(eid)
+            assert e is not None and e.Status == EvalStatusComplete, \
+                (jid, e)
+        return _placements_with_scores(srv, list(eval_of)), order
+    finally:
+        srv.shutdown()
+
+
+def _run_storm_pipelined(federation, n_jobs=12):
+    """The same deterministic storm through the LIVE served path
+    (pipelined worker windows, plan applier, commit)."""
+    srv = Server(ServerConfig(num_schedulers=1, scheduler_window=8,
+                              federation=federation,
+                              min_heartbeat_ttl=24 * 3600.0,
+                              heartbeat_grace=24 * 3600.0))
+    srv.establish_leadership()
+    try:
+        for node in _build_fleet(10):
+            srv.node_register(node)
+        eval_ids = [srv.job_register(_storm_job(f"job-{i}"))[0]
+                    for i in range(n_jobs)]
+        assert wait_for(
+            lambda: all(
+                (e := srv.state.eval_by_id(eid)) is not None
+                and e.Status == EvalStatusComplete for eid in eval_ids),
+            timeout=30,
+            msg="pipelined federation storm completes")
+        return _placements_with_scores(srv, eval_ids)
+    finally:
+        srv.shutdown()
+
+
+FED = FederationConfig(enabled=True)
+
+
+class TestFollowerSnapshotOracle:
+    """Gate 1: snapshot-source scheduling == fresh-snapshot oracle."""
+
+    def test_sync_storm_matches_leader_oracle(self):
+        fed, order_fed = _run_storm_sync(FED)
+        oracle, order_oracle = _run_storm_sync(None)
+        _assert_same_placements(fed, oracle)
+        assert order_fed == order_oracle
+
+    def test_pipelined_storm_matches_leader_oracle(self):
+        fed = _run_storm_pipelined(FED)
+        oracle = _run_storm_pipelined(None)
+        _assert_same_placements(fed, oracle)
+
+    def test_source_actually_shared(self):
+        """The federated storm must actually exercise snapshot reuse —
+        otherwise gate 1 proves nothing about follower snapshots."""
+        srv = Server(ServerConfig(num_schedulers=0, federation=FED))
+        srv.establish_leadership()
+        try:
+            for node in _build_fleet(4):
+                srv.node_register(node)
+            eids = [srv.job_register(_storm_job(f"job-{i}", count=1))[0]
+                    for i in range(6)]
+            w = Worker(srv.raft, srv.eval_broker, srv.plan_queue,
+                       srv.blocked_evals, srv.tindex)
+            w.fed_source = srv.fed_source
+            for _ in range(12):
+                if not w.process_one(timeout=0.05):
+                    break
+            for eid in eids:
+                e = srv.state.eval_by_id(eid)
+                assert e is not None \
+                    and e.Status == EvalStatusComplete
+            stats = srv.fed_source.stats()
+            assert stats["Reused"] > 0, stats
+        finally:
+            srv.shutdown()
+
+
+class TestStaleSnapshotRedelivery:
+    """Gate 2: a deliberately-staled snapshot's plan is rejected and the
+    eval redelivered exactly once."""
+
+    def test_stale_plan_rejected_then_redelivered_once(self):
+        fed = FederationConfig(enabled=True, reject_after_s=2.0)
+        srv = Server(ServerConfig(num_schedulers=0, federation=fed))
+        srv.establish_leadership()
+        try:
+            for node in _build_fleet(4):
+                srv.node_register(node)
+            job = _storm_job("stale-job", count=3)
+            eid, _, _ = srv.job_register(job)
+            # Pin a snapshot that CONTAINS the job but was "born" far
+            # past the staleness bound: the worker will happily build a
+            # plan from it, and the applier must reject that plan.
+            srv.fed_source.pin(srv.state.snapshot(),
+                               born=time.monotonic() - 10.0)
+            w = Worker(srv.raft, srv.eval_broker, srv.plan_queue,
+                       srv.blocked_evals, srv.tindex)
+            w.fed_source = srv.fed_source
+
+            rejected_before = srv.plan_applier.stats["rejected"]
+            assert w.process_one(timeout=0.5)  # delivery #1: rejected
+            assert srv.plan_applier.stats["rejected"] \
+                == rejected_before + 1
+            ev = srv.state.eval_by_id(eid)
+            assert ev is None or ev.Status != EvalStatusComplete
+            assert not srv.state.allocs_by_eval(eid), \
+                "a stale-rejected plan must commit nothing"
+
+            # Heal: the redelivered eval places against a fresh snapshot.
+            srv.fed_source.unpin()
+            assert w.process_one(timeout=5.0)  # delivery #2: places
+            ev = srv.state.eval_by_id(eid)
+            assert ev is not None and ev.Status == EvalStatusComplete
+            allocs = srv.state.allocs_by_eval(eid)
+            assert len(allocs) == 3  # exactly Count — no duplicates
+            assert len({a.Name for a in allocs}) == 3
+            # Exactly once: nothing left to deliver.
+            assert not w.process_one(timeout=0.2)
+        finally:
+            srv.shutdown()
+
+    def test_stale_error_is_typed(self):
+        with pytest.raises(StaleSnapshotError):
+            raise StaleSnapshotError("x")
+
+
+class TestDisabledBitIdentity:
+    """Gate 3: federation=None == pre-federation path, and
+    enabled=False is indistinguishable from None."""
+
+    def test_none_matches_disabled_config(self):
+        none_p, none_o = _run_storm_sync(None)
+        off_p, off_o = _run_storm_sync(FederationConfig(enabled=False))
+        _assert_same_placements(none_p, off_p)
+        assert none_o == off_o
+
+    def test_disabled_internals_disarmed(self):
+        srv = Server(ServerConfig(num_schedulers=0))
+        srv.establish_leadership()
+        try:
+            assert srv.fed_source is None
+            assert srv.fed_health is None
+            for node in _build_fleet(2):
+                srv.node_register(node)
+            eid, _, _ = srv.job_register(_storm_job("plain", count=1))
+            # No release floor, no Region stamp: the broker and the
+            # eval look exactly as they did pre-federation.
+            assert srv.eval_broker.release_floor(eid) is None
+            ev = srv.state.eval_by_id(eid)
+            assert ev is not None and ev.Region == ""
+            assert srv.eval_broker.foreign_parked() == []
+        finally:
+            srv.shutdown()
+
+    def test_enabled_stamps_region_and_floor(self):
+        srv = Server(ServerConfig(num_schedulers=0, region="west",
+                                  federation=FED))
+        srv.establish_leadership()
+        try:
+            for node in _build_fleet(2):
+                srv.node_register(node)
+            job = _storm_job("fed-plain", count=1)
+            job.Region = ""  # mock jobs pre-stamp "global"
+            eid, _, _ = srv.job_register(job)
+            assert job.Region == "west"  # _default_region helper
+            ev = srv.state.eval_by_id(eid)
+            assert ev is not None and ev.Region == "west"
+            floor = srv.eval_broker.release_floor(eid)
+            assert floor is not None and floor >= ev.ModifyIndex
+        finally:
+            srv.shutdown()
+
+
+class TestRegionRouting:
+    """A foreign-region eval parks instead of entering a local ready
+    queue — this region has no nodes for it."""
+
+    def test_foreign_eval_parked_never_dequeued(self):
+        srv = Server(ServerConfig(num_schedulers=0, region="east",
+                                  federation=FED))
+        srv.establish_leadership()
+        try:
+            from nomad_tpu.structs import generate_uuid
+            from nomad_tpu.structs.structs import (
+                EvalStatusPending,
+                JobTypeService,
+            )
+
+            foreign = Evaluation(
+                ID=generate_uuid(), Priority=50, Type=JobTypeService,
+                TriggeredBy="job-register", JobID="west-job",
+                Region="west", Status=EvalStatusPending)
+            srv.eval_broker.enqueue(foreign)
+            assert [e.ID for e in srv.eval_broker.foreign_parked()] \
+                == [foreign.ID]
+            got, _ = srv.eval_broker.dequeue([JobTypeService],
+                                             timeout=0.1)
+            assert got is None
+            assert srv.eval_broker.stats.TotalReady == 0
+        finally:
+            srv.shutdown()

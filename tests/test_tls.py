@@ -6,6 +6,7 @@ when verify_incoming is set; wrong-CA clients are rejected.
 
 import os
 import subprocess
+import time
 
 import pytest
 
@@ -93,9 +94,24 @@ class TestTLSCluster:
         try:
             assert wait_for(lambda: leader_of(nodes) is not None,
                             timeout=30)
-            ldr = leader_of(nodes)
+            # Register through WHICHEVER node currently leads: the first
+            # election of a fresh 3-node cluster can still be flapping
+            # when the barrier above samples a momentary leader, and a
+            # direct apply on a deposed node raises NotLeaderError.
             for _ in range(4):
-                ldr.server.node_register(mock.node())
+                node = mock.node()
+                deadline = time.monotonic() + 30
+                while True:
+                    ldr = leader_of(nodes)
+                    try:
+                        if ldr is not None:
+                            ldr.server.node_register(node)
+                            break
+                    except Exception:
+                        if time.monotonic() > deadline:
+                            raise
+                    time.sleep(0.05)
+            ldr = leader_of(nodes)
             follower = next(n for n in nodes if n is not ldr)
             job = mock.job()
             job.TaskGroups[0].Count = 2
@@ -103,11 +119,16 @@ class TestTLSCluster:
                                              {"Job": to_dict(job)})
             eval_id = resp["EvalID"]
             assert wait_for(
-                lambda: (e := leader_of(nodes).server.state.eval_by_id(
+                lambda: (l := leader_of(nodes)) is not None
+                and (e := l.server.state.eval_by_id(
                     eval_id)) is not None
                 and e.Status == EvalStatusComplete, timeout=60)
-            allocs = list(ldr.server.state.allocs_by_job(job.ID))
-            assert len(allocs) == 2
+            # leader_of can flap to None between samples; the alloc read
+            # rides the same None-safe retry as the eval wait.
+            assert wait_for(
+                lambda: (l := leader_of(nodes)) is not None
+                and len(l.server.state.allocs_by_job(job.ID)) == 2,
+                timeout=30)
         finally:
             for cs in nodes:
                 cs.shutdown()

@@ -146,6 +146,19 @@ FED_QUIET_HIGH = int(os.environ.get("BENCH_FED_QUIET_HIGH", 6))
 FED_PER_JOB = int(os.environ.get("BENCH_FED_PER_JOB", 4))
 FED_REPS = int(os.environ.get("BENCH_FED_REPS", 3))
 RUN_FED = os.environ.get("BENCH_FED", "1") != "0"
+# event_stream (bench_event_stream, ISSUE 18): the SAME service storm
+# served with the cluster event broker ARMED (event_buffer_size=4096 +
+# one live subscriber draining fan-out rows the whole run) vs DISARMED
+# (event_buffer_size=0: no broker object; the apply path pays one
+# attribute check). Interleaved reps, alternating order, max-of-reps.
+# Records per-side evals/s, the publish overhead %, and the armed
+# broker's nomad.events counters (published / dropped / ring depth).
+# Parity-style exit-2 gate: both sides place the full storm every rep,
+# the subscriber really consumed the storm, and nothing was dropped.
+EVENTS_AB_NODES = int(os.environ.get("BENCH_EVENTS_NODES", 2048))
+EVENTS_AB_EVALS = int(os.environ.get("BENCH_EVENTS_EVALS", 40))
+EVENTS_AB_REPS = int(os.environ.get("BENCH_EVENTS_REPS", 3))
+RUN_EVENTS = os.environ.get("BENCH_EVENTS", "1") != "0"
 
 
 def _apply_smoke():
@@ -161,6 +174,7 @@ def _apply_smoke():
     global SVC_AB_NODES, SVC_AB_EVALS, SVC_AB_REPS, RUN_MESH
     global FAILOVER_NODES, FAILOVER_JOBS
     global FED_NODES, FED_JOBS, FED_QUIET_HIGH, FED_REPS
+    global EVENTS_AB_NODES, EVENTS_AB_EVALS, EVENTS_AB_REPS
     N_NODES = min(N_NODES, 512)
     N_PLACEMENTS = min(N_PLACEMENTS, 2000)   # 40 evals @ PER_EVAL=50
     N_REPS = min(N_REPS, 3)
@@ -209,6 +223,13 @@ def _apply_smoke():
     FED_JOBS = min(FED_JOBS, 27)
     FED_QUIET_HIGH = min(FED_QUIET_HIGH, 3)
     FED_REPS = min(FED_REPS, 2)
+    # The event-stream A/B STAYS on at smoke scale: the broker-armed vs
+    # disarmed interleave (plus its zero-drop gate) is the only bench-
+    # side check that publishing + one live subscriber costs the apply
+    # path nothing measurable. A few seconds of budget.
+    EVENTS_AB_NODES = min(EVENTS_AB_NODES, 256)
+    EVENTS_AB_EVALS = min(EVENTS_AB_EVALS, 16)
+    EVENTS_AB_REPS = min(EVENTS_AB_REPS, 2)
     # The 1M mesh A/B is slow-gated OUT of smoke (its subprocess compile
     # alone blows the budget); the mesh path's correctness coverage is
     # tier-1 (equivalence gate + collective audit + chaos schedule).
@@ -1810,6 +1831,119 @@ def bench_service_columnar_ab():
             srv.shutdown()
 
 
+def bench_event_stream():
+    """Event-broker overhead A/B end to end: the SAME storm served with
+    the event stream ARMED (broker on the FSM apply path + ONE live
+    subscriber draining fan-out rows for the whole run — the realistic
+    deployed shape) vs DISARMED (event_buffer_size=0: no broker object;
+    apply pays one attribute check). Both servers live simultaneously,
+    timed reps interleaved with ALTERNATING within-pair order,
+    max-of-reps compared. Records per-side rates + storm tails, the
+    armed broker's counters (published / dropped / ring depth — the
+    nomad.events.* stats keys), and a parity gate: both sides place the
+    full storm every rep, the subscriber consumed real traffic, and the
+    bounded queue never dropped."""
+    import threading
+
+    from nomad_tpu.server import Server, ServerConfig
+
+    nodes = build_nodes(EVENTS_AB_NODES)
+    out = {"nodes": EVENTS_AB_NODES, "evals_per_rep": EVENTS_AB_EVALS}
+    servers = {}
+    stop = threading.Event()
+    consumed = {"frames": 0, "events": 0}
+    drainer = None
+    try:
+        for mode, buf in (("armed", 4096), ("disarmed", 0)):
+            srv = Server(ServerConfig(num_schedulers=N_WORKERS,
+                                      pipelined_scheduling=True,
+                                      scheduler_window=WINDOW,
+                                      event_buffer_size=buf,
+                                      min_heartbeat_ttl=24 * 3600.0,
+                                      heartbeat_grace=24 * 3600.0))
+            srv.establish_leadership()
+            for node in nodes:
+                srv.node_register(node)
+            run = _make_storm_runner(srv)
+            run(3)
+            run(3)
+            srv.tindex.nt.warm_device()
+            run(EVENTS_AB_EVALS)  # full-size warm storm (compiles)
+            servers[mode] = (srv, run)
+        broker = servers["armed"][0].fsm.events
+        sub = broker.subscribe(from_index=0, fanout=True,
+                               queue_size=262_144)
+
+        def drain_live():
+            while not stop.is_set():
+                frame = sub.next(timeout=0.2)
+                if frame is None:
+                    continue
+                consumed["frames"] += 1
+                consumed["events"] += len(frame["Events"])
+
+        drainer = threading.Thread(target=drain_live,
+                                   name="bench-events-sub", daemon=True)
+        drainer.start()
+        _tune_gc()
+        rates = {"armed": [], "disarmed": []}
+        lats = {"armed": [], "disarmed": []}
+        placed = {"armed": [], "disarmed": []}
+        for rep in range(EVENTS_AB_REPS):
+            order = (("armed", "disarmed") if rep % 2 == 0
+                     else ("disarmed", "armed"))
+            for mode in order:
+                srv, run = servers[mode]
+                for w in srv.workers:
+                    if hasattr(w, "quiesce"):
+                        w.quiesce(30.0)
+                t0 = time.perf_counter()
+                eval_ids = run(EVENTS_AB_EVALS, latencies=lats[mode])
+                rates[mode].append(
+                    round(EVENTS_AB_EVALS / (time.perf_counter() - t0), 2))
+                _freeze_heap()
+                placed[mode].append(sum(
+                    1 for eid in eval_ids
+                    for _ in srv.state.allocs_by_eval(eid)))
+        # Let the drainer catch the tail of the last rep before the
+        # drop/consumption accounting freezes.
+        deadline = time.monotonic() + 10
+        while (broker.stats()["Tail"] > sub.last_index
+               and time.monotonic() < deadline):
+            time.sleep(0.05)
+        stop.set()
+        drainer.join(timeout=5)
+        stats = broker.stats()
+        for mode in ("armed", "disarmed"):
+            out[mode] = {"evals_sec": max(rates[mode]),
+                         "rep_rates": rates[mode],
+                         "storm_latency_ms": _pctiles_ms(lats[mode]),
+                         "placed_per_rep": placed[mode]}
+        out["overhead_pct"] = round(
+            (1.0 - max(rates["armed"]) / max(rates["disarmed"]))
+            * 100.0, 2) if rates["disarmed"] else None
+        out["events"] = {"published": stats["Published"],
+                         "dropped": stats["Dropped"],
+                         "ring_depth": stats["Depth"],
+                         "ring_size": stats["Size"],
+                         "subscriber_frames": consumed["frames"],
+                         "subscriber_events": consumed["events"]}
+        want = EVENTS_AB_EVALS * PER_EVAL
+        out["parity_ok"] = bool(
+            all(p == want for mode in placed for p in placed[mode])
+            and stats["Dropped"] == 0
+            and consumed["events"] > 0
+            and servers["disarmed"][0].fsm.events is None)
+        out["expected_allocs"] = want
+        return out
+    finally:
+        stop.set()
+        if drainer is not None:
+            drainer.join(timeout=5)
+        for srv, _ in servers.values():
+            srv.shutdown()
+
+
 def bench_placer(nodes, n_evals, per_eval=PER_EVAL, dcs=None):
     """Placer-only device pipeline: the ceiling (no raft/plan-apply)."""
     from nomad_tpu.scheduler.pipeline import EvalRequest, PipelinedPlacer
@@ -2216,6 +2350,13 @@ def main(argv=None):
     if RUN_SVC_AB:
         detail["service_columnar"] = (svc_ab := bench_service_columnar_ab())
 
+    # event_stream: broker-armed (+1 live subscriber) vs disarmed A/B,
+    # publish overhead % + nomad.events counters, zero-drop/parity
+    # exit-2 gated.
+    ev_stream = None
+    if RUN_EVENTS:
+        detail["event_stream"] = (ev_stream := bench_event_stream())
+
     # The millions-of-users shape: 1M nodes x a wide storm window,
     # keyed kernel 1dev-vs-mesh with latency percentiles (subprocess;
     # slow-gated out of --smoke).
@@ -2305,6 +2446,13 @@ def main(argv=None):
         # the columnar server really committed service segments.
         sys.stderr.write(
             f"SERVICE COLUMNAR AB GATE FAILED: {json.dumps(svc_ab)}\n")
+        sys.exit(2)
+    if ev_stream is not None and not ev_stream["parity_ok"]:
+        # Event-stream parity: armed and disarmed place identically-sized
+        # storms, the live subscriber saw real traffic, and the bounded
+        # queue never dropped. Same fail-after-emit contract.
+        sys.stderr.write(
+            f"EVENT STREAM AB GATE FAILED: {json.dumps(ev_stream)}\n")
         sys.exit(2)
 
 

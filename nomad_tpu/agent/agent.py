@@ -53,6 +53,10 @@ class AgentConfig:
     scheduler_window: int = 32
     pipelined_scheduling: bool = True
     scheduler_mesh: str = ""
+    # Event broker ring size (server{} block): retained applied-index
+    # window behind /v1/event/stream; 0 disables the broker entirely
+    # (README "Event stream").
+    event_buffer_size: int = 4096
     # QoS knobs (server { qos { ... } }), materialized into a QoSConfig
     # at server boot; {} / enabled=false leaves QoS off.
     qos: Dict[str, Any] = field(default_factory=dict)
@@ -280,6 +284,7 @@ class Agent:
             scheduler_window=self.config.scheduler_window,
             pipelined_scheduling=self.config.pipelined_scheduling,
             scheduler_mesh=self.config.scheduler_mesh,
+            event_buffer_size=self.config.event_buffer_size,
             qos=_qos_from_config(self.config.qos),
             federation=_federation_from_config(self.config.federation),
             dev_mode=True,
@@ -302,6 +307,7 @@ class Agent:
             scheduler_window=self.config.scheduler_window,
             pipelined_scheduling=self.config.pipelined_scheduling,
             scheduler_mesh=self.config.scheduler_mesh,
+            event_buffer_size=self.config.event_buffer_size,
             qos=_qos_from_config(self.config.qos),
             federation=_federation_from_config(self.config.federation),
             bootstrap_expect=self.config.bootstrap_expect,

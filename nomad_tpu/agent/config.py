@@ -84,6 +84,10 @@ def config_from_dict(data: dict) -> AgentConfig:
     cfg.pipelined_scheduling = bool(server.get("pipelined_scheduling",
                                                cfg.pipelined_scheduling))
     cfg.scheduler_mesh = server.get("scheduler_mesh", cfg.scheduler_mesh)
+    # Event broker ring size (server { event_buffer_size = 8192 });
+    # 0 disables the broker and /v1/event/stream (README "Event stream").
+    cfg.event_buffer_size = int(server.get("event_buffer_size",
+                                           cfg.event_buffer_size))
     # QoS knobs (server { qos { enabled = true high_floor = 70 ... } });
     # passed through as a plain dict and materialized into a QoSConfig by
     # the agent (README "QoS & SLO serving" documents each knob).

@@ -130,12 +130,118 @@ def _make_handler(agent):
                 return None
             return json.loads(self.rfile.read(length))
 
+        def _write_chunk(self, payload: bytes) -> None:
+            # Manual chunked transfer-encoding: one frame per chunk so
+            # consumers see complete JSON lines as they flush.
+            self.wfile.write(b"%X\r\n" % len(payload) + payload + b"\r\n")
+            self.wfile.flush()
+
+        def _stream_events(self, query) -> None:
+            """GET /v1/event/stream: chunked JSON-lines event frames
+            (README "Event stream"). Each chunk is one frame —
+            ``{"Index": N, "Events": [...]}`` — or a bare ``{}``
+            heartbeat; the stream ends with a ``{"Closed": true,
+            "Reason": ...}`` frame when the broker resets or shuts down.
+            Streams are REGION-LOCAL: the ring is fed by this region's
+            raft log, so a request naming another region is refused
+            rather than forwarded (a forwarded stream could not honor
+            the from_index resume contract across logs)."""
+            from nomad_tpu.events import TOPICS, EventGapError
+
+            if self.command != "GET":
+                self._error(405, "method not allowed")
+                return
+            server = agent.server
+            broker = server.fsm.events if server is not None else None
+            if broker is None:
+                self._error(501, "event streaming requires a server "
+                                 "agent with events enabled "
+                                 "(server.event_buffer_size > 0)")
+                return
+            q_region = query.get("region", [""])[0]
+            if q_region and q_region != agent.region():
+                self._error(400, f"event streams are region-local: this "
+                                 f"agent serves region "
+                                 f"{agent.region()!r}, not {q_region!r}")
+                return
+            topics: set = set()
+            filters: Dict[str, set] = {}
+            for spec in query.get("topic", []):
+                topic, _, key = spec.partition(":")
+                if topic not in TOPICS:
+                    self._error(400, f"unknown topic {topic!r} "
+                                     f"(known: {sorted(TOPICS)})")
+                    return
+                topics.add(topic)
+                if key:
+                    filters.setdefault(topic, set()).add(key)
+            try:
+                from_index = int(query.get("index", ["0"])[0])
+            except ValueError:
+                self._error(400, "index must be an integer")
+                return
+            fanout = ("fanout" in query
+                      and query["fanout"][0] not in ("false", "0"))
+            raw_hb = query.get("heartbeat", [""])[0]
+            try:
+                heartbeat = float(raw_hb) if raw_hb else 10.0
+            except ValueError:
+                self._error(400, f"heartbeat must be seconds, "
+                                 f"got {raw_hb!r}")
+                return
+            if not (0.05 <= heartbeat <= 60.0):  # NaN-rejecting clamp
+                heartbeat = 10.0
+            try:
+                sub = broker.subscribe(topics=topics or None,
+                                       filters=filters,
+                                       from_index=from_index,
+                                       fanout=fanout)
+            except EventGapError as e:
+                # 416: the requested window is gone. JSON body so the
+                # client can re-snapshot and resubscribe from Floor.
+                self._respond({"Error": str(e), "Requested": e.requested,
+                               "Floor": e.floor}, code=416)
+                return
+            # One long-lived response per connection: no keep-alive reuse
+            # after a stream (the consumer reconnects to resume).
+            self.close_connection = True
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Transfer-Encoding", "chunked")
+            self.send_header("X-Nomad-Region", agent.region())
+            self.end_headers()
+            try:
+                while True:
+                    frame = sub.next(timeout=heartbeat)
+                    if frame is None:
+                        closed, reason = sub.status()
+                        if closed:
+                            self._write_chunk(json.dumps(
+                                {"Closed": True,
+                                 "Reason": reason}).encode() + b"\n")
+                            break
+                        self._write_chunk(b"{}\n")  # heartbeat
+                        continue
+                    self._write_chunk(json.dumps(
+                        frame, separators=(",", ":")).encode() + b"\n")
+                self.wfile.write(b"0\r\n\r\n")
+            except (BrokenPipeError, ConnectionResetError, OSError):
+                pass  # consumer went away; unsubscribe below
+            finally:
+                broker.unsubscribe(sub)
+
         def _dispatch(self) -> None:
             parsed = urllib.parse.urlparse(self.path)
             # keep_blank_values: bare flags like `?stale` must survive
             # parsing (parse_qs drops blank-valued params by default).
             query = urllib.parse.parse_qs(parsed.query,
                                           keep_blank_values=True)
+            if parsed.path == "/v1/event/stream":
+                # Streaming writes chunked frames directly to the socket;
+                # it cannot go through route()/_respond (one
+                # Content-Length'd body per response).
+                self._stream_events(query)
+                return
             try:
                 result = route(agent, self.command, parsed.path, query,
                                self._body)
@@ -763,7 +869,32 @@ def route(agent, method: str, path: str, query, get_body):
             if trace_id:
                 return {"Trace": full}, None
             out = _trace.status()
-            out["Traces"] = _trace.traces()
+            entries = _trace.traces()
+            # limit/after pagination over the newest-last summary list.
+            # `after` is a TraceID cursor: resume just past it. A cursor
+            # whose trace was evicted restarts from the oldest retained
+            # entry (the ring is bounded — stale cursors are normal in a
+            # poll loop, not an error).
+            after = query.get("after", [""])[0]
+            if after:
+                for i, entry in enumerate(entries):
+                    if entry["TraceID"] == after:
+                        entries = entries[i + 1:]
+                        break
+            raw_limit = query.get("limit", [""])[0]
+            if raw_limit:
+                try:
+                    limit = int(raw_limit)
+                except ValueError:
+                    raise CodedError(400, f"limit must be an integer, "
+                                          f"got {raw_limit!r}")
+                if limit <= 0:
+                    raise CodedError(400, f"limit must be positive, "
+                                          f"got {limit}")
+                if len(entries) > limit:
+                    entries = entries[:limit]
+                    out["NextAfter"] = entries[-1]["TraceID"]
+            out["Traces"] = entries
             return out, None
         if method == "DELETE":
             _trace.clear()

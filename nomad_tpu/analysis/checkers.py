@@ -22,6 +22,8 @@ Telemetry key discipline (migrated from tests/test_telemetry_lint.py):
   metric_key     — metric key literals follow the nomad.* dotted scheme.
   trace_key      — span name literals follow the subsystem.operation
                    scheme.
+  event_schema   — event topic/type literals exist in the events schema
+                   registry and agree with each other.
 """
 
 from __future__ import annotations
@@ -501,4 +503,66 @@ class TraceKeyChecker(Checker):
                     self.id, ctx.path, node.lineno,
                     f"span name {name_arg.value!r} breaks the "
                     f"subsystem.operation scheme"))
+        return findings
+
+
+# --------------------------------------------------------------- event_schema
+@register
+class EventSchemaChecker(Checker):
+    id = "event_schema"
+    description = ("event topic/type literals must exist in the events "
+                   "schema registry (TOPICS / EVENT_TYPES) and agree "
+                   "with each other")
+
+    def check_file(self, ctx: FileContext) -> Iterable[Finding]:
+        if ctx.rel() == os.path.join("events", "schema.py"):
+            return ()  # the registry itself defines the literals
+        findings: List[Finding] = []
+        from nomad_tpu.events.schema import EVENT_TYPES, TOPICS
+
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Call) \
+                    and _call_name(node) == "new_event":
+                # new_event(topic, etype, ...): both literals must be
+                # registered, and the type must publish on that topic.
+                # Dynamic args (rebroadcast of an existing event) are
+                # exempt — the constructor re-validates at runtime.
+                lits = [a.value if isinstance(a, ast.Constant)
+                        and isinstance(a.value, str) else None
+                        for a in node.args[:2]]
+                topic, etype = (lits + [None, None])[:2]
+                if topic is not None and topic not in TOPICS:
+                    findings.append(Finding(
+                        self.id, ctx.path, node.lineno,
+                        f"event topic {topic!r} is not declared in "
+                        f"events.schema.TOPICS"))
+                elif etype is not None and etype not in EVENT_TYPES:
+                    findings.append(Finding(
+                        self.id, ctx.path, node.lineno,
+                        f"event type {etype!r} is not declared in "
+                        f"events.schema.EVENT_TYPES"))
+                elif topic is not None and etype is not None \
+                        and EVENT_TYPES[etype] != topic:
+                    findings.append(Finding(
+                        self.id, ctx.path, node.lineno,
+                        f"event type {etype!r} publishes on topic "
+                        f"{EVENT_TYPES[etype]!r}, not {topic!r}"))
+            elif isinstance(node, ast.Compare):
+                # `ev["Topic"] == "X"` routing comparisons: the literal
+                # side must name a real topic (a renamed topic would
+                # otherwise make the branch silently dead).
+                sides = [node.left] + list(node.comparators)
+                if not any(
+                        isinstance(s, ast.Subscript)
+                        and isinstance(s.slice, ast.Constant)
+                        and s.slice.value == "Topic" for s in sides):
+                    continue
+                for side in sides:
+                    if isinstance(side, ast.Constant) \
+                            and isinstance(side.value, str) \
+                            and side.value not in TOPICS:
+                        findings.append(Finding(
+                            self.id, ctx.path, node.lineno,
+                            f"comparison against unknown event topic "
+                            f"{side.value!r}"))
         return findings

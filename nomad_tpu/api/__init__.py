@@ -5,8 +5,10 @@ from .client import (  # noqa: F401
     Agent as AgentAPI,
     AllocFS,
     Allocations,
+    BackpressureAPIError,
     Client,
     Evaluations,
+    EventGapAPIError,
     Jobs,
     Nodes,
     QueryOptions,

@@ -32,6 +32,20 @@ class BackpressureAPIError(APIError):
     automatically with RetryPolicy (``backpressure_retries``)."""
 
 
+class EventGapAPIError(APIError):
+    """HTTP 416 from /v1/event/stream: the requested resume index
+    precedes the server's retained event window — events were evicted
+    (or predate a snapshot install) and can NEVER be replayed. Not
+    retryable: the consumer must re-snapshot state via the list APIs and
+    resubscribe from ``floor`` (or 0 for "live from now")."""
+
+    def __init__(self, code: int, message: str,
+                 requested: int = 0, floor: int = 0):
+        super().__init__(code, message)
+        self.requested = requested
+        self.floor = floor
+
+
 @dataclass
 class QueryOptions:
     region: str = ""
@@ -155,6 +169,96 @@ class Client:
                                        ConnectionError),
                              should_retry=transient)
         return policy.call(once)
+
+    def event_stream(self, topics: Optional[List[str]] = None,
+                     from_index: int = 0, fanout: bool = False,
+                     heartbeat: float = 10.0,
+                     yield_heartbeats: bool = False,
+                     reconnect_attempts: Optional[int] = None):
+        """Follow /v1/event/stream: yields event frames
+        ``{"Index": N, "Events": [...]}`` in raft-index order, forever.
+
+        Resume is automatic: the iterator tracks the last delivered
+        index, and a transport drop mid-stream (agent restart, leader
+        kill, broker reset) reconnects with ``index=<last seen>`` under
+        a jittered RetryPolicy — the server replays its retained window
+        after that index, so the consumer observes a gapless,
+        duplicate-free continuation. A resume that falls off the
+        retained window raises :class:`EventGapAPIError` (HTTP 416);
+        that is not retried — the consumer must re-snapshot state.
+
+        ``topics`` entries are ``"Topic"`` or ``"Topic:key"`` selectors;
+        ``fanout=True`` asks the server to expand AllocationBatch events
+        into per-alloc rows; heartbeats (empty frames proving liveness)
+        are swallowed unless ``yield_heartbeats``.
+        """
+        last = int(from_index)
+        attempts = (self.retries if reconnect_attempts is None
+                    else reconnect_attempts)
+
+        def connect():
+            params: List[Tuple[str, str]] = []
+            if self.region:
+                params.append(("region", self.region))
+            for t in (topics or ()):
+                params.append(("topic", t))
+            params.append(("index", str(last)))
+            if fanout:
+                params.append(("fanout", "true"))
+            params.append(("heartbeat", str(heartbeat)))
+            url = (self.address + "/v1/event/stream?"
+                   + urllib.parse.urlencode(params))
+            req = urllib.request.Request(url, method="GET")
+            try:
+                # Read timeout must comfortably exceed the heartbeat
+                # cadence — a healthy-but-quiet stream is not a hang.
+                return urllib.request.urlopen(
+                    req, timeout=max(30.0, heartbeat * 3))
+            except urllib.error.HTTPError as e:
+                body_text = e.read().decode(errors="replace")
+                if e.code == 416:
+                    try:
+                        info = json.loads(body_text)
+                    except ValueError:
+                        info = {}
+                    raise EventGapAPIError(
+                        e.code, body_text,
+                        requested=int(info.get("Requested", last)),
+                        floor=int(info.get("Floor", 0))) from e
+                raise APIError(e.code, body_text) from e
+
+        policy = RetryPolicy(max_attempts=max(1, attempts),
+                             backoff=Backoff(base=0.25, cap=5.0),
+                             retry_on=(urllib.error.URLError,
+                                       ConnectionError))
+        # lint: allow(retry, reconnect loop around RetryPolicy-backed
+        # connects — each successful frame resets the budget by design)
+        while True:
+            resp = policy.call(connect)
+            try:
+                for line in resp:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    frame = json.loads(line)
+                    if frame.get("Closed"):
+                        # Broker reset/shutdown: reconnect and resume
+                        # from the last delivered index; a real gap
+                        # surfaces as EventGapAPIError on reconnect.
+                        break
+                    if "Events" not in frame:
+                        if yield_heartbeats:
+                            yield frame
+                        continue
+                    last = int(frame.get("Index", last))
+                    yield frame
+            except (urllib.error.URLError, ConnectionError, OSError):
+                pass  # transport drop mid-stream: resume from `last`
+            finally:
+                try:
+                    resp.close()
+                except OSError:
+                    pass
 
     def get(self, path: str, q: Optional[QueryOptions] = None):
         return self.request("GET", path, self._params(q))
@@ -354,9 +458,17 @@ class Agent:
         return self.c.get("/v1/agent/debug/sched-stats")[0]
 
     # Evaluation-lifecycle tracing (debug-gated; telemetry/trace.py)
-    def traces(self):
-        """Status + summaries of retained traces."""
-        return self.c.get("/v1/agent/debug/trace")[0]
+    def traces(self, limit: Optional[int] = None, after: str = ""):
+        """Status + summaries of retained traces. ``limit`` caps the
+        page; ``after`` is the TraceID cursor from the previous page's
+        ``NextAfter`` (present only when the listing was truncated)."""
+        params: Dict[str, str] = {}
+        if limit is not None:
+            params["limit"] = str(limit)
+        if after:
+            params["after"] = after
+        return self.c.request("GET", "/v1/agent/debug/trace",
+                              params or None)[0]
 
     def trace(self, trace_id: str, chrome: bool = False):
         """One full trace; ``chrome=True`` returns Chrome trace-event

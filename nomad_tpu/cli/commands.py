@@ -190,6 +190,21 @@ def main(argv) -> int:
     p.add_argument("name", nargs="?",
                    help="show instances of one service")
 
+    p = sub.add_parser("events",
+                       help="follow the cluster event stream")
+    _add_meta(p)
+    p.add_argument("-topic", action="append", default=None,
+                   help="Topic or Topic:key filter (repeatable; "
+                        "default: all topics)")
+    p.add_argument("-index", type=int, default=0,
+                   help="resume after this raft index (default 0: "
+                        "replay the full retained window, then follow)")
+    p.add_argument("-fanout", action="store_true",
+                   help="expand AllocationBatch events into per-alloc "
+                        "AllocPlaced rows")
+    p.add_argument("-json", action="store_true", dest="as_json",
+                   help="one JSON object per event")
+
     p = sub.add_parser("monitor",
                        help="follow an evaluation to completion")
     _add_meta(p)
@@ -990,6 +1005,32 @@ def cmd_system_gc(args) -> int:
     client = _client(args)
     client.system.garbage_collect()
     print("System GC triggered")
+    return 0
+
+
+def cmd_events(args) -> int:
+    """Follow the cluster event stream (reference: command/event.go
+    `nomad event` — a topic-filtered follow of the event stream
+    endpoint). Runs until interrupted; reconnects and resumes from the
+    last seen index automatically (api.Client.event_stream)."""
+    client = _client(args)
+    try:
+        for frame in client.event_stream(topics=args.topic,
+                                         from_index=args.index,
+                                         fanout=args.fanout):
+            if frame.get("Dropped"):
+                print(f"... {frame['Dropped']} frame(s) dropped "
+                      f"(slow consumer)", file=sys.stderr)
+            for ev in frame.get("Events", ()):
+                if args.as_json:
+                    print(json.dumps(ev), flush=True)
+                else:
+                    print(f"{ev.get('Index', 0):>8}  "
+                          f"{ev.get('Topic', ''):<16} "
+                          f"{ev.get('Type', ''):<24} "
+                          f"{ev.get('Key', '')}", flush=True)
+    except KeyboardInterrupt:
+        pass
     return 0
 
 

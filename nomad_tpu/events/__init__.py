@@ -1,0 +1,19 @@
+"""Cluster event stream: raft-index-ordered lifecycle events with
+bounded catch-up and streaming subscriptions (README "Event stream")."""
+
+from .broker import (
+    DEFAULT_QUEUE_SIZE,
+    DEFAULT_RING_SIZE,
+    EventBroker,
+    EventGapError,
+    Subscription,
+    expand_batch,
+)
+from .builders import build_events
+from .schema import EVENT_TYPES, TOPICS, new_event
+
+__all__ = [
+    "DEFAULT_QUEUE_SIZE", "DEFAULT_RING_SIZE", "EventBroker",
+    "EventGapError", "Subscription", "expand_batch", "build_events",
+    "EVENT_TYPES", "TOPICS", "new_event",
+]

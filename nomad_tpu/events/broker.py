@@ -1,0 +1,365 @@
+"""EventBroker: raft-index-ordered lifecycle event fan-out (reference:
+nomad/stream/event_broker.go + event_buffer.go, reshaped for this
+codebase's replicated-FSM feed).
+
+Every replica's FSM publishes one batch per applied raft entry, so every
+server — follower or leader — holds an identical index-ordered ring.
+That symmetry is the failover story: a subscriber that reconnects to the
+NEW leader (or any server in the region) with ``from_index=<last seen>``
+replays the retained window from that server's own ring and continues
+gapless and duplicate-free, because both rings were fed by the same log.
+
+Ordering: the ring is ordered by raft index, full stop. Dev-mode applies
+can reach the FSM out of index order (DevRaft assigns the index under
+its lock but applies outside it), so the broker exposes a two-phase
+``reserve(index)`` / ``publish(index, events)`` sequencer: reservations
+are taken in index order under the DevRaft lock, and a published batch
+is held back until every lower reserved index has published. The
+replicated backend applies strictly in order and never reserves.
+
+Slow consumers: per-subscriber bounded queues, drop-oldest. A full
+subscriber loses its oldest frames — counted under ``nomad.events.
+dropped`` and annotated on the next delivered frame — and NEVER blocks
+the publisher: the apply loop's cost per entry is one lock hold and a
+few deque appends regardless of consumer health.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import deque
+from typing import Any, Dict, Iterable, List, Optional, Tuple
+
+from nomad_tpu.analysis import guarded_by
+from nomad_tpu.resilience import failpoints
+from nomad_tpu.telemetry import metrics, trace
+
+__all__ = ["EventBroker", "EventGapError", "Subscription", "expand_batch"]
+
+DEFAULT_RING_SIZE = 4096
+DEFAULT_QUEUE_SIZE = 1024
+
+
+class EventGapError(Exception):
+    """``from_index`` precedes the retained window: events in
+    ``(requested, floor]`` existed but have been evicted (or predate this
+    server's snapshot install). The consumer must re-snapshot state and
+    resubscribe from the current index."""
+
+    def __init__(self, requested: int, floor: int):
+        super().__init__(
+            f"event stream gap: requested index {requested} precedes the "
+            f"retained window (floor {floor}); re-snapshot and resubscribe")
+        self.requested = requested
+        self.floor = floor
+
+
+def expand_batch(event: Dict[str, Any]) -> List[Dict[str, Any]]:
+    """Opt-in per-alloc fan-out of one ``AllocationBatch`` event, AT READ
+    TIME: derive per-alloc ``AllocPlaced`` summaries from the columnar
+    row/count descriptor the sweep committed. The publish path never
+    materializes these — a 10k-alloc sweep stays one event until a
+    subscriber explicitly asks for rows."""
+    from .schema import new_event
+
+    p = event["Payload"]
+    out: List[Dict[str, Any]] = []
+    node_ids = p["RowNodeIDs"]
+    counts = p["Counts"]
+    pos = 0
+    for node_id, count in zip(node_ids, counts):
+        for _ in range(int(count)):
+            ev = new_event("Alloc", "AllocPlaced", p["AllocIDs"][pos], {
+                "ID": p["AllocIDs"][pos],
+                "Name": p["Names"][pos],
+                "NodeID": node_id,
+                "JobID": p["JobID"],
+                "EvalID": p["EvalID"],
+                "Kind": p["Kind"],
+            })
+            ev["Index"] = event["Index"]
+            ev["Region"] = event.get("Region", "")
+            if "TraceID" in event:
+                ev["TraceID"] = event["TraceID"]
+                ev["SpanID"] = event["SpanID"]
+            out.append(ev)
+            pos += 1
+    return out
+
+
+class Subscription:
+    """One consumer's bounded view of the stream. Frames are
+    ``{"Index": N, "Events": [...]}`` dicts (plus a ``"Dropped"``
+    annotation on the first frame after an overflow). ``next()`` blocks
+    up to ``timeout`` and returns ``None`` on expiry — the HTTP layer
+    turns that into a heartbeat."""
+
+    _concurrency = guarded_by("_cond", "_frames", "_dropped_pending",
+                              "closed", "close_reason")
+
+    def __init__(self, topics: Optional[Iterable[str]] = None,
+                 filters: Optional[Dict[str, Iterable[str]]] = None,
+                 fanout: bool = False,
+                 queue_size: int = DEFAULT_QUEUE_SIZE):
+        self.topics = frozenset(topics) if topics else None
+        self.filters = {t: frozenset(keys)
+                        for t, keys in (filters or {}).items() if keys}
+        self.fanout = bool(fanout)
+        self.queue_size = max(1, int(queue_size))
+        self._cond = threading.Condition()
+        self._frames: deque = deque()
+        self._dropped_pending = 0
+        self.closed = False
+        self.close_reason = ""
+        # Monotone cursor of the last frame handed out; read-only telemetry
+        # for the owner thread (no cross-thread contract).
+        self.last_index = 0
+        self.dropped_total = 0
+
+    # ------------------------------------------------------------ filtering
+    def _match(self, event: Dict[str, Any]) -> bool:
+        topic = event["Topic"]
+        if self.topics is not None and topic not in self.topics:
+            return False
+        keys = self.filters.get(topic)
+        if keys and event["Key"] not in keys:
+            return False
+        return True
+
+    # ------------------------------------------------------ publisher side
+    def push(self, index: int, events: Tuple[Dict[str, Any], ...]) -> None:
+        """Called by the broker with its lock held; takes only this
+        subscription's condition (broker lock -> sub cond, never the
+        reverse). Non-blocking: overflow drops the OLDEST frame."""
+        matched = [ev for ev in events if self._match(ev)]
+        if not matched:
+            return
+        with self._cond:
+            if self.closed:
+                return
+            if len(self._frames) >= self.queue_size:
+                self._frames.popleft()
+                self._dropped_pending += 1
+                self.dropped_total += 1
+                metrics.incr_counter(("nomad", "events", "dropped"))
+            self._frames.append({"Index": index, "Events": matched})
+            self._cond.notify_all()
+
+    # ------------------------------------------------------- consumer side
+    def next(self, timeout: Optional[float] = None) -> Optional[Dict[str, Any]]:
+        """Pop the next frame, blocking up to ``timeout``. Returns None on
+        timeout; returns None immediately (forever) once closed and
+        drained. With ``fanout``, AllocationBatch events expand into
+        per-alloc rows here — at read time, per subscriber."""
+        with self._cond:
+            while not self._frames and not self.closed:
+                if not self._cond.wait(timeout):
+                    return None
+            if not self._frames:
+                return None  # closed and drained
+            frame = self._frames.popleft()
+            if self._dropped_pending:
+                frame = dict(frame)
+                frame["Dropped"] = self._dropped_pending
+                self._dropped_pending = 0
+        self.last_index = frame["Index"]
+        if self.fanout:
+            events: List[Dict[str, Any]] = []
+            for ev in frame["Events"]:
+                if ev["Topic"] == "AllocationBatch":
+                    events.extend(expand_batch(ev))
+                else:
+                    events.append(ev)
+            frame = dict(frame, Events=events)
+        return frame
+
+    def status(self) -> Tuple[bool, str]:
+        """(closed, reason) snapshot for the transport layer — it must
+        distinguish a ``next()`` timeout (send a heartbeat) from a closed
+        stream (tell the consumer why, then end)."""
+        with self._cond:
+            return self.closed, self.close_reason
+
+    def close(self, reason: str = "") -> None:
+        with self._cond:
+            self.closed = True
+            self.close_reason = reason
+            self._cond.notify_all()
+
+
+class EventBroker:
+    """The per-server event ring + subscriber registry. One instance per
+    FSM, attached as ``fsm.events``; ``None`` (events disabled) keeps the
+    apply path's cost at a single attribute check."""
+
+    _concurrency = guarded_by(
+        "_lock", "_ring", "_tail", "_floor", "_reserved", "_staged",
+        "_subs", "_published", "_closed")
+
+    def __init__(self, size: int = DEFAULT_RING_SIZE, region: str = ""):
+        self.size = max(1, int(size))
+        # Region tag stamped onto every event; "" outside federation
+        # (matching the evaluations' home-region contract). Set once at
+        # server boot, before any publish.
+        self.region = region
+        self._lock = threading.Lock()
+        # Retained (index, events-tuple) batches, index-ascending; only
+        # non-empty batches occupy ring slots.
+        self._ring: deque = deque()
+        # Highest index COVERED by the stream (advances on every publish,
+        # empty or not) and highest index NOT retained (advances on ring
+        # eviction / snapshot reset). Gap check: from_index < floor.
+        self._tail = 0
+        self._floor = 0
+        # Dev-mode sequencer state: reserved-but-unpublished indexes plus
+        # batches published out of order, held for their predecessors.
+        self._reserved: set = set()
+        self._staged: Dict[int, Tuple[Dict[str, Any], ...]] = {}
+        self._subs: List[Subscription] = []
+        self._published = 0
+        self._closed = False
+
+    # ------------------------------------------------------------ sequencer
+    def reserve(self, index: int) -> None:
+        """Claim ``index`` for a future publish. Callers invoke this in
+        index order (DevRaft: under its own assignment lock) so the
+        reservation set encodes exactly which lower indexes are still in
+        flight when a publish arrives early."""
+        with self._lock:
+            if not self._closed:
+                self._reserved.add(index)
+
+    def publish(self, index: int,
+                events: Iterable[Dict[str, Any]]) -> None:
+        """Publish one applied entry's events. Never raises into the FSM:
+        the ``events.publish`` failpoint's error/drop modes surface as
+        subscriber-visible loss (coverage still advances — the oracle
+        fold, not a gap error, is what catches it), and delay mode is
+        injected latency on the apply path, by design."""
+        batch = tuple(events)
+        if batch:
+            # Fire OUTSIDE the lock: delay mode must not serialize every
+            # other publisher, and error mode must stay FSM-invisible.
+            try:
+                if failpoints.fire("events.publish") == "drop":
+                    batch = ()
+            except failpoints.FailpointError:
+                batch = ()
+        if batch:
+            sp = trace.current() if trace.is_enabled() else None
+            region = self.region
+            for ev in batch:
+                ev["Index"] = index
+                ev["Region"] = region
+                if sp is not None:
+                    ev["TraceID"] = sp.trace_id
+                    ev["SpanID"] = sp.span_id
+        depth = 0
+        with self._lock:
+            if self._closed or index <= self._tail:
+                return  # shutdown, or a replayed/duplicate entry
+            if index in self._reserved:
+                self._staged[index] = batch
+                # Drain every staged batch whose predecessors have all
+                # published: the lowest outstanding reservation gates.
+                while self._reserved:
+                    lo = min(self._reserved)
+                    if lo not in self._staged:
+                        break
+                    self._reserved.discard(lo)
+                    self._emit_locked(lo, self._staged.pop(lo))
+            else:
+                self._emit_locked(index, batch)
+            depth = len(self._ring)
+        metrics.set_gauge(("nomad", "events", "ring_depth"), depth)
+
+    def _emit_locked(self, index: int,
+                     batch: Tuple[Dict[str, Any], ...]) -> None:
+        self._tail = index
+        if not batch:
+            return
+        self._ring.append((index, batch))
+        while len(self._ring) > self.size:
+            evicted_index, _ = self._ring.popleft()
+            self._floor = evicted_index
+        self._published += len(batch)
+        metrics.incr_counter(("nomad", "events", "published"), len(batch))
+        for sub in self._subs:
+            sub.push(index, batch)
+
+    # --------------------------------------------------------- subscribers
+    def subscribe(self, topics: Optional[Iterable[str]] = None,
+                  filters: Optional[Dict[str, Iterable[str]]] = None,
+                  from_index: int = 0, fanout: bool = False,
+                  queue_size: int = DEFAULT_QUEUE_SIZE) -> Subscription:
+        """Replay the retained window after ``from_index`` (exclusive —
+        pass the last index you saw), then go live. Registration and
+        replay happen under one lock hold, so no event falls between the
+        replayed window and the live feed. Raises :class:`EventGapError`
+        when ``from_index`` precedes the retained window."""
+        sub = Subscription(topics=topics, filters=filters, fanout=fanout,
+                           queue_size=queue_size)
+        with self._lock:
+            if self._closed:
+                raise EventGapError(from_index, self._tail)
+            if from_index < self._floor:
+                raise EventGapError(from_index, self._floor)
+            for index, batch in self._ring:
+                if index > from_index:
+                    sub.push(index, batch)
+            self._subs.append(sub)
+        metrics.set_gauge(("nomad", "events", "subscribers"),
+                          self._sub_count())
+        return sub
+
+    def unsubscribe(self, sub: Subscription) -> None:
+        with self._lock:
+            try:
+                self._subs.remove(sub)
+            except ValueError:
+                return  # already removed (reset/close raced us)
+        sub.close("unsubscribed")
+        metrics.set_gauge(("nomad", "events", "subscribers"),
+                          self._sub_count())
+
+    def _sub_count(self) -> int:
+        with self._lock:
+            return len(self._subs)
+
+    # ------------------------------------------------------------ lifecycle
+    def reset(self, floor: int) -> None:
+        """Snapshot install: this replica's state jumped to ``floor``
+        without applying the intervening entries, so nothing below it is
+        servable. Drop the ring, and close live subscribers — their
+        stream no longer continues from what they saw; they reconnect,
+        hit the gap check, and re-snapshot."""
+        with self._lock:
+            self._ring.clear()
+            self._staged.clear()
+            self._reserved.clear()
+            self._tail = max(self._tail, floor)
+            self._floor = max(self._floor, floor)
+            subs, self._subs = self._subs, []
+        for sub in subs:
+            sub.close("reset: state restored from snapshot")
+        metrics.set_gauge(("nomad", "events", "subscribers"), 0)
+
+    def close(self) -> None:
+        with self._lock:
+            self._closed = True
+            subs, self._subs = self._subs, []
+        for sub in subs:
+            sub.close("broker closed")
+
+    # ------------------------------------------------------------ telemetry
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                "Tail": self._tail,
+                "Floor": self._floor,
+                "Depth": len(self._ring),
+                "Size": self.size,
+                "Subscribers": len(self._subs),
+                "Published": self._published,
+                "Dropped": sum(s.dropped_total for s in self._subs),
+            }

@@ -1,0 +1,78 @@
+"""Declared event schema: topics, event types, and the constructor every
+publish seam goes through (reference: nomad/structs/structs.go Topic*
+constants + the per-type event payloads in nomad/state/events.go).
+
+The schema is DECLARED, not emergent: `nomad-tpu lint` checks every
+string-literal topic/type passed to :func:`new_event` (and every literal
+in `EVENT_TYPES` itself) against this module, the same way metric and
+trace key literals are schema-checked — a typo'd topic is a lint
+finding, not a silently unmatchable subscription. The `nomad.events.*`
+metric keys the broker emits are listed in the README stats-key table
+next to the rest of the telemetry schema.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+__all__ = ["TOPICS", "EVENT_TYPES", "new_event"]
+
+# Topics mirror the state surfaces an event describes (reference:
+# TopicNode/TopicJob/TopicEvaluation/TopicAllocation/TopicService).
+# "AllocationBatch" is this repo's columnar addition: one event per
+# committed sweep batch carrying the row/count descriptor instead of
+# per-alloc fan-out (expansion is opt-in at read time).
+TOPICS = frozenset((
+    "Node",
+    "Job",
+    "Eval",
+    "Alloc",
+    "AllocationBatch",
+    "Service",
+))
+
+# Event type -> owning topic. One entry per lifecycle transition the FSM
+# publishes; the lint checker enforces that new_event() literals agree
+# with this table.
+EVENT_TYPES: Dict[str, str] = {
+    "NodeRegistered": "Node",
+    "NodeDeregistered": "Node",
+    "NodeStatusUpdated": "Node",
+    "NodeDrainUpdated": "Node",
+    "JobRegistered": "Job",
+    "JobDeregistered": "Job",
+    "PeriodicLaunchUpserted": "Job",
+    "PeriodicLaunchDeleted": "Job",
+    "EvalUpdated": "Eval",
+    "EvalDeleted": "Eval",
+    "AllocUpdated": "Alloc",
+    "AllocClientUpdated": "Alloc",
+    "AllocDeleted": "Alloc",
+    # Derived at read time by the opt-in per-alloc fan-out of an
+    # AllocationBatch event — never published by the FSM itself.
+    "AllocPlaced": "Alloc",
+    "AllocationBatchCommitted": "AllocationBatch",
+    "ServiceRegistered": "Service",
+    "ServiceDeregistered": "Service",
+}
+
+
+def new_event(topic: str, etype: str, key: str,
+              payload: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
+    """Construct one event dict. Index/Region/TraceID/SpanID are stamped
+    by the broker at publish (the builders that call this run inside the
+    FSM apply and only know the state transition). Validates against the
+    declared schema so a drifted literal fails the first test that
+    exercises it, not just the lint run."""
+    if topic not in TOPICS:
+        raise ValueError(f"unknown event topic {topic!r}")
+    if EVENT_TYPES.get(etype) != topic:
+        raise ValueError(
+            f"event type {etype!r} is not declared under topic {topic!r}")
+    return {
+        "Topic": topic,
+        "Type": etype,
+        "Key": key,
+        "Index": 0,
+        "Payload": payload if payload is not None else {},
+    }

@@ -54,6 +54,13 @@ KNOWN_SITES: Dict[str, str] = {
     "client.heartbeat": "client: node heartbeat to the leader",
     "client.register": "client: node registration RPC",
     "driver.docker.exec": "docker driver: container launch/exec calls",
+    "events.publish": "server: event-broker publish of one applied raft "
+                      "entry's batch (drop/error=subscriber-visible loss "
+                      "— stream coverage still advances and the "
+                      "equivalence fold must surface the missing events; "
+                      "delay=slow publish on the apply path; NEVER "
+                      "FSM-visible — a consensus-committed entry must "
+                      "apply even when its events are lost)",
     "gossip.probe": "gossip: direct ping of the probe target",
     "gossip.send": "gossip: outbound UDP datagram (drop=lost packet)",
     "plan.apply.commit": "server: plan applier's consensus commit",

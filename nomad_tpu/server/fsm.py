@@ -18,6 +18,7 @@ from typing import Any, Callable, Dict, List, Optional
 
 import numpy as np
 
+from nomad_tpu.events.builders import build_events
 from nomad_tpu.server.timetable import TimeTable
 from nomad_tpu.state.state_store import StateStore, SweepSegment
 from nomad_tpu.telemetry import metrics, trace
@@ -111,6 +112,11 @@ class FSM:
         # a populated index<->time map after failover (reference: fsm.go:147
         # witnesses in Apply; fsm.go:430-551 persists it in the snapshot).
         self.timetable = TimeTable()
+        # Event broker (nomad_tpu/events/): attached by the server when
+        # the event stream is enabled. None keeps the apply path's event
+        # cost at this one attribute check. Fed on EVERY replica, so any
+        # server in the region can serve a gapless resume after failover.
+        self.events = None
         # Leader-side observers (broker, blocked evals, periodic dispatch)
         # registered by the server when it holds leadership.
         self.on_eval_update: Optional[Callable[[Evaluation], None]] = None
@@ -128,10 +134,30 @@ class FSM:
         self.timetable.witness(index, time.time())
         handler = _HANDLERS[msg_type]
         leaf = _MSG_METRIC.get(msg_type, msg_type.name.lower())
+        broker = self.events
+        events = None
         try:
             with trace.span("fsm." + leaf, index=index):
-                return handler(self, index, payload)
+                result = handler(self, index, payload)
+                if broker is not None:
+                    # Build INSIDE the span so publish stamps this
+                    # entry's fsm trace/span ids onto its events.
+                    try:
+                        events = build_events(self, msg_type, payload)
+                    except Exception:
+                        # A builder bug must not fail a consensus-
+                        # committed entry (the handler already applied);
+                        # the entry publishes empty and the loss shows
+                        # up in the equivalence fold.
+                        logger.exception(
+                            "event builder failed at index %d", index)
+            return result
         finally:
+            # Publish in the finally — even a failed handler releases the
+            # broker's index reservation (empty batch), so one poisoned
+            # entry can never wedge every later subscriber.
+            if broker is not None:
+                broker.publish(index, events or ())
             metrics.measure_since(("nomad", "fsm", leaf), start)
 
     # ------------------------------------------------------------- handlers
@@ -476,6 +502,11 @@ class FSM:
         r.commit()
         if timetable:
             self.timetable.deserialize(timetable)
+        if self.events is not None:
+            # Snapshot install: entries below the restored watermark were
+            # never applied here, so the ring cannot serve them. Raise
+            # the gap floor; resuming subscribers below it re-snapshot.
+            self.events.reset(self.state.latest_index())
 
     def restore(self, data: Dict[str, Any]) -> None:
         """(reference: fsm.go:444-551) One code path with the chunked
@@ -526,6 +557,16 @@ class DevRaft:
         with self._lock:
             self._index += 1
             index = self._index
+            # Index assignment happens under the lock but the FSM apply
+            # below runs outside it, so concurrent dev-mode applies can
+            # reach the broker out of index order. Reserving HERE — still
+            # in assignment order — lets the broker hold an early batch
+            # until its predecessors publish, keeping the stream strictly
+            # index-ordered. (The replicated backend applies in order and
+            # never reserves.)
+            broker = self.fsm.events
+            if broker is not None:
+                broker.reserve(index)
         self.fsm.apply(index, msg_type, payload)
         return index
 

@@ -149,6 +149,11 @@ class ServerConfig:
     # FederationConfig(enabled=True, ...) to opt in (README
     # "Federation" documents every knob).
     federation: Optional["FederationConfig"] = None
+    # Cluster event stream (nomad_tpu/events/): ring slots retained for
+    # catch-up, in applied-entry batches. 0 disables the broker entirely
+    # — the FSM apply path then pays one attribute check and placements
+    # are bit-identical to pre-events behavior (README "Event stream").
+    event_buffer_size: int = 4096
     # Replicated deployment (reference: nomad/config.go RaftConfig +
     # BootstrapExpect). node_id doubles as the raft/transport address.
     node_id: str = ""
@@ -178,6 +183,16 @@ class Server:
         monitorLeadership, nomad/leader.go:24-56)."""
         self.config = config or ServerConfig()
         self.fsm = FSM()
+        if self.config.event_buffer_size > 0:
+            from nomad_tpu.events import EventBroker
+
+            # Region-tagged under federation only ("" otherwise — the
+            # same home-region contract evaluations follow, _ev_region).
+            self.fsm.events = EventBroker(
+                size=self.config.event_buffer_size,
+                region=(self.config.region
+                        if federation_enabled(self.config.federation)
+                        else ""))
         self._leadership_lock = threading.Lock()
         if transport is not None:
             from nomad_tpu.raft import RaftBackend
@@ -513,6 +528,11 @@ class Server:
 
     def shutdown(self) -> None:
         self._shutdown.set()
+        # Close the event broker first: streaming HTTP handlers block in
+        # Subscription.next() between heartbeats, and a closed sub wakes
+        # them immediately instead of waiting out the heartbeat interval.
+        if self.fsm.events is not None:
+            self.fsm.events.close()
         # Serialize against in-flight leadership transitions on the raft
         # notify thread: both paths mutate workers/_retired_workers, and an
         # unserialized pair of revoke_leadership runs can drop a worker

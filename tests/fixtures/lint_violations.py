@@ -52,3 +52,10 @@ def bad_metric_key(metrics):
 def bad_span_name(trace):
     with trace.span("NotDotted"):        # trace_key
         pass
+
+
+def bad_event_literals(new_event, ev):
+    new_event("NotATopic", "NodeRegistered", "k")        # event_schema
+    new_event("Node", "NotAType", "k")                   # event_schema
+    new_event("Job", "NodeRegistered", "k")              # event_schema
+    return ev["Topic"] == "Bogus"                        # event_schema

@@ -414,6 +414,71 @@ class TestFaultsEndpoint:
         assert "string" in str(ei.value)
 
 
+class TestTracePagination:
+    """/v1/agent/debug/trace list pagination: limit/after cursor over
+    the newest-last summary list (the ring is bounded, so stale cursors
+    restart from the oldest retained entry instead of erroring)."""
+
+    @pytest.fixture(autouse=True)
+    def _traced(self, dev_agent):
+        agent, api = dev_agent
+        api.agent.configure_trace(enabled=True, sample_ratio=1.0)
+        api.agent.clear_traces()
+        yield
+        api.agent.configure_trace(enabled=False)
+        api.agent.clear_traces()
+
+    def _seed_traces(self, agent, api, n=5):
+        from nomad_tpu import mock
+        from nomad_tpu.structs import to_dict
+
+        for _ in range(n):
+            agent.rpc("Node.Register", {"Node": to_dict(mock.node())})
+        wait_for(lambda: len(api.agent.traces().get("Traces", ())) >= n,
+                 timeout=20, msg="seed traces never retained")
+
+    def test_limit_after_walks_the_full_list(self, dev_agent):
+        agent, api = dev_agent
+        self._seed_traces(agent, api)
+        full = [t["TraceID"] for t in api.agent.traces()["Traces"]]
+        page = api.agent.traces(limit=2)
+        assert [t["TraceID"] for t in page["Traces"]] == full[:2]
+        assert page["NextAfter"] == full[1]
+        # Summary schema holds on a paginated response.
+        for t in page["Traces"]:
+            assert set(t) >= {"TraceID", "Root", "Start", "DurationMs",
+                              "Spans", "Complete", "Error"}
+        # Cursor-walk the whole list: background traffic may APPEND new
+        # traces while we walk, but the captured prefix must come back
+        # exactly once, in order.
+        seen, after = [], ""
+        while True:
+            p = api.agent.traces(limit=2, after=after)
+            seen.extend(t["TraceID"] for t in p["Traces"])
+            after = p.get("NextAfter", "")
+            if not after:
+                break
+        assert seen[:len(full)] == full
+        assert len(seen) == len(set(seen))
+        # An un-truncated page carries no cursor.
+        assert "NextAfter" not in api.agent.traces(limit=10_000)
+
+    def test_stale_cursor_restarts_from_oldest(self, dev_agent):
+        agent, api = dev_agent
+        self._seed_traces(agent, api)
+        full = [t["TraceID"] for t in api.agent.traces()["Traces"]]
+        p = api.agent.traces(limit=2, after="f" * 32)
+        assert [t["TraceID"] for t in p["Traces"]] == full[:2]
+
+    def test_malformed_limit_is_a_400(self, dev_agent):
+        agent, api = dev_agent
+        for bad in ("nope", "0", "-3"):
+            with pytest.raises(APIError) as ei:
+                api.request("GET", "/v1/agent/debug/trace",
+                            {"limit": bad})
+            assert ei.value.code == 400
+
+
 def test_register_surfaces_ignored_driver_config_warnings(dev_agent):
     """Accepted-but-unimplemented docker config keys must come back to
     the SUBMITTER as registration warnings, not vanish into a

@@ -24,7 +24,8 @@ FIXTURE = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                        "fixtures", "lint_violations.py")
 
 EXPECTED_CHECKERS = {"guarded_by", "lock_blocking", "retry", "thread",
-                     "swallow", "failpoint_site", "metric_key", "trace_key"}
+                     "swallow", "failpoint_site", "metric_key", "trace_key",
+                     "event_schema"}
 
 
 def test_framework_hosts_the_expected_checkers():
@@ -131,6 +132,12 @@ def test_fired_failpoint_sites_match_known_sites():
 
 def test_metric_and_trace_key_literals_follow_the_schemes():
     assert run_checks(checker_ids=["metric_key", "trace_key"]) == []
+
+
+def test_event_literals_match_the_schema_registry():
+    """Every new_event() topic/type literal in the tree (builders,
+    broker fan-out) exists in events.schema and agrees topic-to-type."""
+    assert run_checks(checker_ids=["event_schema"]) == []
 
 
 def test_unknown_checker_id_is_an_error():

@@ -999,3 +999,156 @@ class TestLeaderFailoverSchedule:
                     n.shutdown()
                 except Exception:
                     pass
+
+
+class TestEventStreamFailoverSchedule:
+    """ISSUE 18 headline gate: an event-stream subscriber is mid-stream
+    when the leader dies. Every replica's FSM feeds an identical broker
+    (builders are deterministic functions of the committed entry), so
+    the subscriber drains what the dead leader delivered, reconnects to
+    the NEW leader with ``from_index=<last seen frame>``, and must
+    observe a gapless, duplicate-free continuation whose fold matches
+    the surviving store — chaos-gated mid-storm, with the
+    ``events.publish`` seam armed (delay) across the whole timeline so
+    the kill lands while the publish path is actively exercised.
+    """
+
+    N_NODES = 12
+    N_JOBS = 20
+    KILL_AT = 8
+
+    def _boot(self, name, join=None):
+        from nomad_tpu.gossip import GossipConfig
+        from nomad_tpu.raft import RaftConfig
+
+        cs = ClusterServer(ServerConfig(
+            node_id="", num_schedulers=1, bootstrap_expect=3,
+            scheduler_window=8))
+        # No snapshot compaction in this storm: an install-snapshot on a
+        # follower legitimately resets its broker floor (forcing a
+        # subscriber re-list), which is the OTHER contract — this gate
+        # pins the gapless-resume one.
+        cs.connect([], raft_config=RaftConfig(
+            heartbeat_interval=0.02, election_timeout_min=0.08,
+            election_timeout_max=0.16, apply_timeout=5.0,
+            snapshot_threshold=100_000))
+        cs.start()
+        cs.enable_gossip(name, join=join,
+                         gossip_config=GossipConfig.fast())
+        return cs
+
+    def _cluster(self):
+        nodes = [self._boot("e0")]
+        nodes.append(self._boot("e1", join=[_gaddr(nodes[0])]))
+        nodes.append(self._boot("e2", join=[_gaddr(nodes[0])]))
+        return nodes
+
+    def test_subscriber_resumes_on_new_leader_gapless(self):
+        from test_event_equivalence import drain, fold
+
+        publish_fired_before = failpoints.snapshot().get(
+            "events.publish", {}).get("fired", 0)
+        nodes = self._cluster()
+        live = list(nodes)
+        try:
+            assert wait_for(lambda: leader_of(live) is not None,
+                            timeout=30)
+            for _ in range(self.N_NODES):
+                _rpc_retry(live, "Node.Register",
+                           {"Node": to_dict(mock.node())})
+            src = leader_of(live)
+            assert src is not None
+            # Subscribe mid-stream on the CURRENT leader, before the
+            # storm: the replay window (node registrations) plus the
+            # live feed both ride this one subscription.
+            sub = src.server.fsm.events.subscribe(
+                from_index=0, fanout=True, queue_size=65536)
+            jobs = []
+            eval_ids = []
+            with ChaosSchedule(name="event-stream-failover") \
+                    .arm(0.0, "events.publish=delay(0.0005)") as sched:
+                sched.join(2.0)
+                for i in range(self.N_JOBS):
+                    if i == self.KILL_AT:
+                        victim = leader_of(live)
+                        assert victim is src, \
+                            "leadership moved before the kill"
+                        live.remove(victim)
+                        victim.shutdown()
+                        assert wait_for(
+                            lambda: leader_of(live) is not None,
+                            timeout=30, msg="post-kill election")
+                    job = make_job()
+                    jobs.append(job)
+                    resp = _rpc_retry(live, "Job.Register",
+                                      {"Job": to_dict(job)})
+                    eval_ids.append(resp["EvalID"])
+                    time.sleep(0.01)
+
+                def settled():
+                    ldr = leader_of(live)
+                    return ldr is not None and _all_terminal(
+                        ldr.server.state, eval_ids)
+
+                assert wait_for(settled, timeout=120, interval=0.25,
+                                msg="storm terminal through the election")
+
+            # Phase 1: the dead leader's broker closed the subscription
+            # on shutdown, but everything it delivered first is retained
+            # in the queue — drain it and record the splice point.
+            frames1 = drain(sub, idle=0.3, timeout=30)
+            assert frames1, "subscriber saw nothing before the kill"
+            assert sub.status()[0], "victim shutdown left the sub open"
+            last_seen = frames1[-1]["Index"]
+
+            # Phase 2: resume on the NEW leader from the last frame the
+            # old one delivered. Wait for its apply loop to cross the
+            # splice first — a reconnect that races ahead of replication
+            # would re-receive the tail as live frames.
+            ldr = leader_of(live)
+            broker2 = ldr.server.fsm.events
+            assert wait_for(
+                lambda: broker2.stats()["Tail"] >= last_seen,
+                timeout=30, msg="new leader crossed the splice index")
+            assert wait_for(
+                lambda: broker2.stats()["Tail"]
+                >= ldr.server.state.latest_index(),
+                timeout=30, msg="new leader stream caught up to store")
+            sub2 = broker2.subscribe(from_index=last_seen, fanout=True,
+                                     queue_size=65536)
+            frames2 = drain(sub2, idle=0.4, timeout=30)
+            broker2.unsubscribe(sub2)
+
+            # Gapless + duplicate-free splice: frame indexes strictly
+            # increase ACROSS the reconnect (fold asserts this), every
+            # resumed frame is past the splice, and no (Index, Topic,
+            # Type, Key) identity repeats anywhere in the union.
+            assert all(f["Index"] > last_seen for f in frames2)
+            seen = [(f["Index"], e["Topic"], e["Type"], e["Key"])
+                    for f in frames1 + frames2 for e in f["Events"]]
+            assert len(seen) == len(set(seen)), "duplicate events"
+            shadow = fold(frames1 + frames2)
+
+            # Storm totals match the surviving store: the spliced stream
+            # reconstructs membership and placement exactly.
+            state = ldr.server.state
+            assert set(shadow.nodes) == {n.ID for n in state.nodes()}
+            assert set(shadow.jobs) == {j.ID for j in state.jobs()}
+            assert {aid: d["NodeID"]
+                    for aid, d in shadow.allocs.items()} \
+                == {a.ID: a.NodeID for a in state.allocs()}
+            store_evals = {e.ID: e.Status for e in state.evals()}
+            assert shadow.evals == store_evals
+            assert set(eval_ids) <= set(shadow.evals)
+
+            assert_invariants(state, jobs, per_job=PER_JOB,
+                              eval_ids=eval_ids)
+            # The publish seam really fired through the storm.
+            assert failpoints.snapshot().get("events.publish", {}).get(
+                "fired", 0) - publish_fired_before >= 1
+        finally:
+            for n in nodes:
+                try:
+                    n.shutdown()
+                except Exception:
+                    pass

@@ -1,0 +1,397 @@
+"""Event broker + /v1/event/stream + api client + CLI tests.
+
+Broker unit coverage (ring/index semantics, the dev-mode sequencer,
+slow-consumer drop-oldest, fan-out, reset), then black-box endpoint
+coverage against a dev-mode agent (chunked frames, heartbeats, filters,
+resume-from-index, the 416 gap contract), then the CLI rendering layer
+over a canned stream. The failover chaos gate lives in
+test_chaos_schedules.py; the state-equivalence oracle in
+test_event_equivalence.py.
+"""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.agent import Agent, AgentConfig
+from nomad_tpu.api import Client as APIClient, EventGapAPIError
+from nomad_tpu.events import (
+    EventBroker,
+    EventGapError,
+    build_events,
+    new_event,
+)
+from nomad_tpu.resilience import failpoints
+from nomad_tpu.structs import to_dict
+
+from helpers import wait_for  # noqa: E402
+
+
+def ev(topic="Node", etype="NodeStatusUpdated", key="n1", payload=None):
+    return new_event(topic, etype, key, payload or {"ID": key})
+
+
+def batch_event(job_id="j1", alloc_ids=("a1", "a2", "a3"),
+                node_ids=("n1", "n2"), counts=(2, 1)):
+    return new_event("AllocationBatch", "AllocationBatchCommitted", job_id, {
+        "JobID": job_id, "EvalID": "e1", "Kind": "system",
+        "Count": len(alloc_ids), "AllocIDs": list(alloc_ids),
+        "Names": [f"{job_id}.g[{i}]" for i in range(len(alloc_ids))],
+        "RowNodeIDs": list(node_ids), "Counts": list(counts),
+    })
+
+
+@pytest.fixture(autouse=True)
+def _heal_failpoints():
+    failpoints.disarm_all()
+    yield
+    failpoints.disarm_all()
+
+
+class TestBroker:
+    def test_replay_then_live_in_index_order(self):
+        b = EventBroker(size=16)
+        for i in range(1, 4):
+            b.publish(i, [ev(key=f"n{i}")])
+        sub = b.subscribe(from_index=0)
+        b.publish(4, [ev(key="n4")])
+        got = [sub.next(timeout=1) for _ in range(4)]
+        assert [f["Index"] for f in got] == [1, 2, 3, 4]
+        assert got[3]["Events"][0]["Key"] == "n4"
+        assert sub.next(timeout=0.05) is None  # drained, not closed
+
+    def test_resume_from_index_exact(self):
+        """from_index is EXCLUSIVE (pass the last index you saw): the
+        continuation neither duplicates it nor skips its successor."""
+        b = EventBroker(size=16)
+        for i in range(1, 6):
+            b.publish(i, [ev(key=f"n{i}")])
+        sub = b.subscribe(from_index=3)
+        got = [sub.next(timeout=1) for _ in range(2)]
+        assert [f["Index"] for f in got] == [4, 5]
+
+    def test_gap_error_below_floor(self):
+        b = EventBroker(size=2)
+        for i in range(1, 6):
+            b.publish(i, [ev(key=f"n{i}")])
+        with pytest.raises(EventGapError) as exc:
+            b.subscribe(from_index=1)
+        assert exc.value.floor == 3  # ring holds 4,5; 3 was evicted last
+        sub = b.subscribe(from_index=3)  # exactly at the floor is fine
+        assert sub.next(timeout=1)["Index"] == 4
+
+    def test_empty_batches_advance_coverage_without_slots(self):
+        """Entries that publish no events still advance Tail — coverage
+        over the log — but occupy no ring slots and raise no gap."""
+        b = EventBroker(size=2)
+        for i in range(1, 50):
+            b.publish(i, [])
+        assert b.stats()["Tail"] == 49
+        assert b.stats()["Depth"] == 0
+        sub = b.subscribe(from_index=0)  # no gap: nothing was evicted
+        b.publish(50, [ev()])
+        assert sub.next(timeout=1)["Index"] == 50
+
+    def test_out_of_order_publish_held_for_predecessors(self):
+        """The dev-mode sequencer: reservations taken in index order
+        gate emission, so publishes arriving 3,1,2 stream as 1,2,3."""
+        b = EventBroker(size=16)
+        for i in (1, 2, 3):
+            b.reserve(i)
+        sub = b.subscribe(from_index=0)
+        b.publish(3, [ev(key="n3")])
+        assert sub.next(timeout=0.05) is None  # held: 1 and 2 in flight
+        b.publish(1, [ev(key="n1")])
+        assert sub.next(timeout=1)["Index"] == 1
+        b.publish(2, [ev(key="n2")])
+        got = [sub.next(timeout=1) for _ in range(2)]
+        assert [f["Index"] for f in got] == [2, 3]
+
+    def test_slow_consumer_drops_oldest_never_blocks(self):
+        b = EventBroker(size=16)
+        sub = b.subscribe(from_index=0, queue_size=2)
+        for i in range(1, 6):
+            b.publish(i, [ev(key=f"n{i}")])
+        first = sub.next(timeout=1)
+        assert first["Index"] == 4  # 1..3 dropped oldest-first
+        assert first["Dropped"] == 3
+        second = sub.next(timeout=1)
+        assert second["Index"] == 5 and "Dropped" not in second
+        assert sub.dropped_total == 3
+        assert b.stats()["Dropped"] == 3
+
+    def test_topic_and_key_filters(self):
+        b = EventBroker(size=16)
+        sub = b.subscribe(topics=["Job"], filters={"Job": ["j2"]})
+        b.publish(1, [ev()])  # Node: filtered
+        b.publish(2, [new_event("Job", "JobRegistered", "j1", {"ID": "j1"})])
+        b.publish(3, [new_event("Job", "JobRegistered", "j2", {"ID": "j2"})])
+        frame = sub.next(timeout=1)
+        assert frame["Index"] == 3
+        assert frame["Events"][0]["Key"] == "j2"
+
+    def test_fanout_expands_batch_at_read_time(self):
+        b = EventBroker(size=16)
+        plain = b.subscribe(from_index=0)
+        fan = b.subscribe(from_index=0, fanout=True)
+        b.publish(1, [batch_event()])
+        got = plain.next(timeout=1)["Events"]
+        assert len(got) == 1 and got[0]["Type"] == "AllocationBatchCommitted"
+        rows = fan.next(timeout=1)["Events"]
+        assert [e["Type"] for e in rows] == ["AllocPlaced"] * 3
+        # Row/count descriptor decodes to the per-alloc node mapping.
+        assert [(e["Key"], e["Payload"]["NodeID"]) for e in rows] == [
+            ("a1", "n1"), ("a2", "n1"), ("a3", "n2")]
+        assert all(e["Index"] == 1 for e in rows)
+
+    def test_reset_closes_subscribers_and_raises_floor(self):
+        b = EventBroker(size=16)
+        b.publish(1, [ev()])
+        sub = b.subscribe(from_index=0)
+        b.reset(10)
+        closed, reason = sub.status()
+        assert wait_for(lambda: sub.status()[0], timeout=1)
+        assert "snapshot" in sub.status()[1]
+        with pytest.raises(EventGapError):
+            b.subscribe(from_index=5)
+        sub2 = b.subscribe(from_index=10)  # resubscribe at the new floor
+        b.publish(11, [ev(key="n11")])
+        assert sub2.next(timeout=1)["Index"] == 11
+
+    def test_schema_rejects_unknown_literals(self):
+        with pytest.raises(ValueError):
+            new_event("Bogus", "NodeRegistered", "k")
+        with pytest.raises(ValueError):
+            new_event("Node", "BogusType", "k")
+        with pytest.raises(ValueError):
+            new_event("Job", "NodeRegistered", "k")  # topic mismatch
+
+    def test_publish_failpoint_drop_is_coverage_invisible(self):
+        """events.publish drop: the batch is lost to subscribers but
+        coverage still advances — no gap error, no FSM impact; only the
+        equivalence fold can see the hole."""
+        b = EventBroker(size=16)
+        sub = b.subscribe(from_index=0)
+        failpoints.arm_from_spec("events.publish=drop:count=1")
+        b.publish(1, [ev(key="lost")])
+        b.publish(2, [ev(key="kept")])
+        frame = sub.next(timeout=1)
+        assert frame["Index"] == 2
+        assert frame["Events"][0]["Key"] == "kept"
+        stats = b.stats()
+        assert stats["Tail"] == 2 and stats["Published"] == 1
+
+    def test_builders_cover_every_message_type(self):
+        """Every FSM MessageType has a publish hook (or an explicit
+        no-op): an unmapped type would silently hole the stream."""
+        from nomad_tpu.events.builders import _BUILDERS
+        from nomad_tpu.server.fsm import MessageType
+
+        assert set(_BUILDERS) == {int(m) for m in MessageType}
+
+
+# ------------------------------------------------------------- endpoint
+
+@pytest.fixture(scope="module")
+def event_agent(tmp_path_factory):
+    config = AgentConfig.dev()
+    config.http_port = 0
+    config.data_dir = str(tmp_path_factory.mktemp("event-agent"))
+    agent = Agent(config)
+    agent.start()
+    api = APIClient(address=f"http://127.0.0.1:{agent.http.port}")
+    yield agent, api
+    agent.shutdown()
+
+
+def _stream_url(agent, params=""):
+    return (f"http://127.0.0.1:{agent.http.port}/v1/event/stream"
+            + (f"?{params}" if params else ""))
+
+
+class TestEventStreamEndpoint:
+    def test_stream_replays_and_follows(self, event_agent):
+        agent, api = event_agent
+        node = mock.node()
+        agent.rpc("Node.Register", {"Node": to_dict(node)})
+        got = []
+        done = threading.Event()
+
+        def consume():
+            stream = api.event_stream(from_index=0, heartbeat=0.5)
+            for frame in stream:
+                got.append(frame)
+                if any(e["Type"] == "JobRegistered"
+                       for e in frame["Events"]):
+                    break
+            stream.close()
+            done.set()
+
+        t = threading.Thread(target=consume, daemon=True)
+        t.start()
+        job = mock.job()
+        agent.rpc("Job.Register", {"Job": to_dict(job)})
+        assert done.wait(15), "stream never delivered the registration"
+        indexes = [f["Index"] for f in got]
+        assert indexes == sorted(set(indexes)), "frames out of order"
+        types = [e["Type"] for f in got for e in f["Events"]]
+        assert "NodeRegistered" in types and "JobRegistered" in types
+
+    def test_topic_filter_and_resume(self, event_agent):
+        agent, api = event_agent
+        job = mock.job()
+        agent.rpc("Job.Register", {"Job": to_dict(job)})
+        stream = api.event_stream(topics=["Job"], from_index=0,
+                                  heartbeat=0.5)
+        frame = next(stream)
+        stream.close()
+        assert all(e["Topic"] == "Job" for e in frame["Events"])
+        # Resume strictly after what we saw: no duplicates.
+        resumed = api.event_stream(topics=["Job"],
+                                   from_index=frame["Index"],
+                                   heartbeat=0.5)
+        job2 = mock.job()
+        agent.rpc("Job.Register", {"Job": to_dict(job2)})
+        frame2 = next(resumed)
+        resumed.close()
+        assert frame2["Index"] > frame["Index"]
+
+    def test_topic_key_filter(self, event_agent):
+        agent, api = event_agent
+        j1, j2 = mock.job(), mock.job()
+        stream = api.event_stream(topics=[f"Job:{j2.ID}"], from_index=0,
+                                  heartbeat=0.5)
+        agent.rpc("Job.Register", {"Job": to_dict(j1)})
+        agent.rpc("Job.Register", {"Job": to_dict(j2)})
+        frame = next(stream)
+        stream.close()
+        assert [e["Key"] for e in frame["Events"]] == [j2.ID]
+
+    def test_heartbeats_prove_liveness(self, event_agent):
+        agent, api = event_agent
+        broker = agent.server.fsm.events
+        tail = broker.stats()["Tail"]
+        resp = urllib.request.urlopen(
+            _stream_url(agent, f"index={tail}&heartbeat=0.2"), timeout=5)
+        try:
+            # Background scheduler traffic may interleave real frames;
+            # a heartbeat must still arrive within a few cadences.
+            deadline = time.monotonic() + 3
+            while time.monotonic() < deadline:
+                line = resp.readline().strip()
+                if line and json.loads(line) == {}:
+                    break
+            else:
+                pytest.fail("no heartbeat frame within 3s")
+        finally:
+            resp.close()
+
+    def test_region_tag_and_header(self, event_agent):
+        agent, api = event_agent
+        resp = urllib.request.urlopen(
+            _stream_url(agent, "index=0&heartbeat=0.2"), timeout=5)
+        try:
+            assert resp.headers["X-Nomad-Region"] == "global"
+        finally:
+            resp.close()
+
+    @pytest.mark.parametrize("params,code", [
+        ("topic=Bogus", 400),
+        ("index=nope", 400),
+        ("heartbeat=nope", 400),
+        ("region=elsewhere", 400),
+    ])
+    def test_bad_params(self, event_agent, params, code):
+        agent, _ = event_agent
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            urllib.request.urlopen(_stream_url(agent, params), timeout=5)
+        assert exc.value.code == code
+
+    def test_method_not_allowed(self, event_agent):
+        agent, _ = event_agent
+        req = urllib.request.Request(_stream_url(agent), data=b"{}",
+                                     method="PUT")
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            urllib.request.urlopen(req, timeout=5)
+        assert exc.value.code == 405
+
+    def test_gap_resume_is_416_with_floor(self, event_agent):
+        """LAST in this class: resets the module agent's broker floor.
+        A resume below the retained window is a typed, non-retryable
+        416 carrying the floor to resubscribe from."""
+        agent, api = event_agent
+        broker = agent.server.fsm.events
+        # Reset AT the current tail (the snapshot-install shape: state
+        # jumped to the applied index) — a floor above the raft index
+        # would discard every later publish as a replay.
+        floor = broker.stats()["Tail"]
+        assert floor > 1
+        broker.reset(floor)
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            urllib.request.urlopen(_stream_url(agent, "index=1"),
+                                   timeout=5)
+        assert exc.value.code == 416
+        body = json.loads(exc.value.read())
+        assert body["Floor"] == floor
+        with pytest.raises(EventGapAPIError) as api_exc:
+            next(api.event_stream(from_index=1, reconnect_attempts=1))
+        assert api_exc.value.floor == floor
+        # The stream recovers at the new floor.
+        stream = api.event_stream(from_index=floor, heartbeat=0.5)
+        agent.rpc("Job.Register", {"Job": to_dict(mock.job())})
+        assert next(stream)["Index"] > floor
+        stream.close()
+
+
+# ------------------------------------------------------------------ CLI
+
+class TestEventsCLI:
+    FRAMES = [
+        {"Index": 7, "Events": [
+            {"Topic": "Job", "Type": "JobRegistered", "Key": "web",
+             "Index": 7, "Payload": {"ID": "web"}}]},
+        {"Index": 9, "Dropped": 2, "Events": [
+            {"Topic": "Alloc", "Type": "AllocPlaced", "Key": "a1",
+             "Index": 9, "Payload": {"ID": "a1", "NodeID": "n1"}}]},
+    ]
+
+    def _run(self, argv, monkeypatch, capsys):
+        from nomad_tpu.cli import commands
+
+        def fake_stream(self, topics=None, from_index=0, fanout=False,
+                        **kwargs):
+            fake_stream.called_with = {"topics": topics,
+                                       "from_index": from_index,
+                                       "fanout": fanout}
+            return iter(TestEventsCLI.FRAMES)
+
+        monkeypatch.setattr(APIClient, "event_stream", fake_stream)
+        rc = commands.main(argv)
+        out, err = capsys.readouterr()
+        return rc, out, err, fake_stream.called_with
+
+    def test_events_json_output(self, monkeypatch, capsys):
+        rc, out, err, called = self._run(
+            ["events", "-json", "-topic", "Job", "-index", "5"],
+            monkeypatch, capsys)
+        assert rc == 0
+        lines = [json.loads(line) for line in out.splitlines()]
+        assert [e["Type"] for e in lines] == ["JobRegistered",
+                                              "AllocPlaced"]
+        assert called == {"topics": ["Job"], "from_index": 5,
+                          "fanout": False}
+        assert "2 frame(s) dropped" in err
+
+    def test_events_table_output(self, monkeypatch, capsys):
+        rc, out, _, called = self._run(["events", "-fanout"],
+                                       monkeypatch, capsys)
+        assert rc == 0
+        assert called["fanout"] is True
+        lines = out.splitlines()
+        assert "JobRegistered" in lines[0] and "web" in lines[0]
+        assert lines[1].lstrip().startswith("9")

@@ -160,6 +160,17 @@ EVENTS_AB_EVALS = int(os.environ.get("BENCH_EVENTS_EVALS", 40))
 EVENTS_AB_REPS = int(os.environ.get("BENCH_EVENTS_REPS", 3))
 RUN_EVENTS = os.environ.get("BENCH_EVENTS", "1") != "0"
 
+# Replica-digest A/B (bench_digest): the apply-path hash-chain fold
+# (digest_interval=64, the deployed default) vs disarmed
+# (digest_interval=0: no digest object; apply pays one attribute
+# check). Parity-style exit-2 gate: both sides place the full storm
+# every rep, the armed chain really folded every commit, and it never
+# flagged a divergence against itself.
+DIGEST_AB_NODES = int(os.environ.get("BENCH_DIGEST_NODES", 2048))
+DIGEST_AB_EVALS = int(os.environ.get("BENCH_DIGEST_EVALS", 40))
+DIGEST_AB_REPS = int(os.environ.get("BENCH_DIGEST_REPS", 3))
+RUN_DIGEST = os.environ.get("BENCH_DIGEST", "1") != "0"
+
 
 def _apply_smoke():
     """--smoke: tiny CPU-safe shapes, <60s end to end. Same code path as
@@ -175,6 +186,7 @@ def _apply_smoke():
     global FAILOVER_NODES, FAILOVER_JOBS
     global FED_NODES, FED_JOBS, FED_QUIET_HIGH, FED_REPS
     global EVENTS_AB_NODES, EVENTS_AB_EVALS, EVENTS_AB_REPS
+    global DIGEST_AB_NODES, DIGEST_AB_EVALS, DIGEST_AB_REPS
     N_NODES = min(N_NODES, 512)
     N_PLACEMENTS = min(N_PLACEMENTS, 2000)   # 40 evals @ PER_EVAL=50
     N_REPS = min(N_REPS, 3)
@@ -230,6 +242,13 @@ def _apply_smoke():
     EVENTS_AB_NODES = min(EVENTS_AB_NODES, 256)
     EVENTS_AB_EVALS = min(EVENTS_AB_EVALS, 16)
     EVENTS_AB_REPS = min(EVENTS_AB_REPS, 2)
+    # The replica-digest A/B STAYS on at smoke scale: the fold is ON the
+    # apply path for every deployment (digest_interval defaults to 64),
+    # so its overhead and its parity gate must surface in every smoke
+    # JSON. A few seconds of budget.
+    DIGEST_AB_NODES = min(DIGEST_AB_NODES, 256)
+    DIGEST_AB_EVALS = min(DIGEST_AB_EVALS, 16)
+    DIGEST_AB_REPS = min(DIGEST_AB_REPS, 2)
     # The 1M mesh A/B is slow-gated OUT of smoke (its subprocess compile
     # alone blows the budget); the mesh path's correctness coverage is
     # tier-1 (equivalence gate + collective audit + chaos schedule).
@@ -1944,6 +1963,92 @@ def bench_event_stream():
             srv.shutdown()
 
 
+def bench_digest():
+    """Replica-digest overhead A/B end to end: the SAME storm served
+    with the state hash chain ARMED (digest_interval=64, the deployed
+    default — every committed entry folds its post-apply readback into
+    the blake2b chain, checkpoints on interval buckets) vs DISARMED
+    (digest_interval=0: no digest object; apply pays one attribute
+    check). Both servers live simultaneously, timed reps interleaved
+    with ALTERNATING within-pair order, max-of-reps compared. Records
+    per-side rates + storm tails, the armed chain's counters (folds /
+    checkpoints / sync mode — the nomad.fsm.digest.* stats keys), and a
+    parity gate: both sides place the full storm every rep, the armed
+    chain folded every commit, and it never diverged."""
+    from nomad_tpu.server import Server, ServerConfig
+
+    nodes = build_nodes(DIGEST_AB_NODES)
+    out = {"nodes": DIGEST_AB_NODES, "evals_per_rep": DIGEST_AB_EVALS}
+    servers = {}
+    try:
+        for mode, interval in (("armed", 64), ("disarmed", 0)):
+            srv = Server(ServerConfig(num_schedulers=N_WORKERS,
+                                      pipelined_scheduling=True,
+                                      scheduler_window=WINDOW,
+                                      digest_interval=interval,
+                                      min_heartbeat_ttl=24 * 3600.0,
+                                      heartbeat_grace=24 * 3600.0))
+            srv.establish_leadership()
+            for node in nodes:
+                srv.node_register(node)
+            run = _make_storm_runner(srv)
+            run(3)
+            run(3)
+            srv.tindex.nt.warm_device()
+            run(DIGEST_AB_EVALS)  # full-size warm storm (compiles)
+            servers[mode] = (srv, run)
+        _tune_gc()
+        rates = {"armed": [], "disarmed": []}
+        lats = {"armed": [], "disarmed": []}
+        placed = {"armed": [], "disarmed": []}
+        for rep in range(DIGEST_AB_REPS):
+            order = (("armed", "disarmed") if rep % 2 == 0
+                     else ("disarmed", "armed"))
+            for mode in order:
+                srv, run = servers[mode]
+                for w in srv.workers:
+                    if hasattr(w, "quiesce"):
+                        w.quiesce(30.0)
+                t0 = time.perf_counter()
+                eval_ids = run(DIGEST_AB_EVALS, latencies=lats[mode])
+                rates[mode].append(
+                    round(DIGEST_AB_EVALS / (time.perf_counter() - t0), 2))
+                _freeze_heap()
+                placed[mode].append(sum(
+                    1 for eid in eval_ids
+                    for _ in srv.state.allocs_by_eval(eid)))
+        for mode in ("armed", "disarmed"):
+            out[mode] = {"evals_sec": max(rates[mode]),
+                         "rep_rates": rates[mode],
+                         "storm_latency_ms": _pctiles_ms(lats[mode]),
+                         "placed_per_rep": placed[mode]}
+        out["overhead_pct"] = round(
+            (1.0 - max(rates["armed"]) / max(rates["disarmed"]))
+            * 100.0, 2) if rates["disarmed"] else None
+        stats = servers["armed"][0].fsm.digest.stats()
+        out["digest"] = {"folds": stats["Folds"],
+                         "chain_index": stats["LastIndex"],
+                         "checkpoints": len(stats["Checkpoints"]),
+                         "synced": stats["Synced"],
+                         "diverged": stats["Diverged"]}
+        want = DIGEST_AB_EVALS * PER_EVAL
+        # Folds can trail LastIndex: a handler that RAISES skips its
+        # fold by contract (every replica skips the same entry), so the
+        # gate checks the chain advanced and stayed healthy, not an
+        # exact count.
+        out["parity_ok"] = bool(
+            all(p == want for mode in placed for p in placed[mode])
+            and stats["Folds"] > 0
+            and stats["LastIndex"] >= stats["Folds"]
+            and stats["Synced"] and stats["Diverged"] == 0
+            and servers["disarmed"][0].fsm.digest is None)
+        out["expected_allocs"] = want
+        return out
+    finally:
+        for srv, _ in servers.values():
+            srv.shutdown()
+
+
 def bench_placer(nodes, n_evals, per_eval=PER_EVAL, dcs=None):
     """Placer-only device pipeline: the ceiling (no raft/plan-apply)."""
     from nomad_tpu.scheduler.pipeline import EvalRequest, PipelinedPlacer
@@ -2357,6 +2462,12 @@ def main(argv=None):
     if RUN_EVENTS:
         detail["event_stream"] = (ev_stream := bench_event_stream())
 
+    # digest: replica hash-chain armed (interval 64) vs disarmed A/B,
+    # fold overhead % + nomad.fsm.digest counters, parity exit-2 gated.
+    digest_ab = None
+    if RUN_DIGEST:
+        detail["digest"] = (digest_ab := bench_digest())
+
     # The millions-of-users shape: 1M nodes x a wide storm window,
     # keyed kernel 1dev-vs-mesh with latency percentiles (subprocess;
     # slow-gated out of --smoke).
@@ -2453,6 +2564,14 @@ def main(argv=None):
         # queue never dropped. Same fail-after-emit contract.
         sys.stderr.write(
             f"EVENT STREAM AB GATE FAILED: {json.dumps(ev_stream)}\n")
+        sys.exit(2)
+    if digest_ab is not None and not digest_ab["parity_ok"]:
+        # Replica-digest parity: armed and disarmed place identically-
+        # sized storms, the chain folded every committed entry, and the
+        # armed replica never saw itself diverge. Same fail-after-emit
+        # contract.
+        sys.stderr.write(
+            f"DIGEST AB GATE FAILED: {json.dumps(digest_ab)}\n")
         sys.exit(2)
 
 

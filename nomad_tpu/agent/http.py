@@ -980,9 +980,14 @@ def route(agent, method: str, path: str, query, get_body):
                 "ForeignParked": srv.eval_broker.foreign_count(),
                 "Regions": srv.fed_health.snapshot(),
             }
+        # Replica-digest block: this replica's chain position, verified
+        # watermark, sync mode, and fold/exchange/divergence counters
+        # (README "Replica determinism"). None when digests are disabled.
+        digest = getattr(getattr(srv, "fsm", None), "digest", None)
+        digest_out = digest.stats() if digest is not None else None
         return {"Workers": workers, "ByWorker": by_worker,
                 "Totals": totals, "QoS": qos_out, "Store": store_out,
-                "Federation": fed_out}, None
+                "Federation": fed_out, "Digest": digest_out}, None
 
     if path == "/v1/agent/metrics":
         # In-memory telemetry snapshot (reference shape: go-metrics

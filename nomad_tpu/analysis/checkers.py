@@ -24,6 +24,15 @@ Telemetry key discipline (migrated from tests/test_telemetry_lint.py):
                    scheme.
   event_schema   — event topic/type literals exist in the events schema
                    registry and agree with each other.
+
+Replica determinism:
+
+  apply_pure     — call-graph closure from the FSM apply handlers,
+                   StateStore mutators, Restore, and the event builders
+                   must not reach the nondeterminism taxonomy (wall
+                   clock, randomness, process identity, unordered set
+                   iteration, thread spawns, I/O); declared local-only
+                   sites carry `# lint: allow(apply_pure, <reason>)`.
 """
 
 from __future__ import annotations
@@ -565,4 +574,36 @@ class EventSchemaChecker(Checker):
                             self.id, ctx.path, node.lineno,
                             f"comparison against unknown event topic "
                             f"{side.value!r}"))
+        return findings
+
+
+# ----------------------------------------------------------------- apply_pure
+@register
+class ApplyPurityChecker(Checker):
+    id = "apply_pure"
+    description = ("nondeterministic call (wall clock, randomness, "
+                   "process identity, unordered set iteration, threads, "
+                   "I/O) reachable from the replicated apply path")
+
+    def __init__(self) -> None:
+        from .callgraph import CallGraph
+
+        self._graph = CallGraph()
+
+    def check_file(self, ctx: FileContext) -> Iterable[Finding]:
+        # Whole-graph analysis: files accumulate here, findings land in
+        # finalize once reachability is known.
+        self._graph.add_file(ctx)
+        return ()
+
+    def finalize(self, full_tree: bool) -> Iterable[Finding]:
+        findings: List[Finding] = []
+        for imp in self._graph.impurities():
+            hops = " -> ".join(imp.chain)
+            findings.append(Finding(
+                self.id, imp.path, imp.lineno,
+                f"{imp.category}: {imp.label} reachable from the apply "
+                f"path via {hops} — replicas diverge; make it a "
+                f"function of the entry, or mark the site local-only "
+                f"with `# lint: allow(apply_pure, <reason>)`"))
         return findings

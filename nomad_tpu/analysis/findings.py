@@ -13,7 +13,7 @@ from __future__ import annotations
 
 import re
 from dataclasses import dataclass, field
-from typing import Dict, Set
+from typing import Dict, List, Set, Tuple
 
 _ALLOW_RE = re.compile(
     r"#\s*lint:\s*allow\(\s*([a-z0-9_-]+)\s*,\s*([^)]+?)\s*\)")
@@ -49,6 +49,19 @@ def parse_suppressions(source: str) -> Dict[int, Set[str]]:
         for m in _ALLOW_RE.finditer(text):
             allows.setdefault(lineno, set()).add(m.group(1))
     return allows
+
+
+def parse_suppression_details(source: str
+                              ) -> List[Tuple[int, str, str]]:
+    """Every allow() in `source` as (line, checker id, reason) — the
+    purity-boundary audit behind `nomad-tpu lint -suppressions`."""
+    out: List[Tuple[int, str, str]] = []
+    for lineno, text in enumerate(source.splitlines(), start=1):
+        if "lint:" not in text:
+            continue
+        for m in _ALLOW_RE.finditer(text):
+            out.append((lineno, m.group(1), m.group(2)))
+    return out
 
 
 def is_suppressed(allows: Dict[int, Set[str]], checker: str,

@@ -228,6 +228,10 @@ def main(argv) -> int:
                    help="run only this checker id (repeatable)")
     p.add_argument("-show-suppressed", action="store_true",
                    help="include suppressed findings in the output")
+    p.add_argument("-suppressions", action="store_true",
+                   help="audit mode: list every active "
+                        "`# lint: allow(...)` with its checker and "
+                        "reason instead of running the checkers")
 
     args = parser.parse_args(argv)
     if args.command is None:
@@ -866,6 +870,19 @@ def cmd_sched_stats(args) -> int:
         print(f"Columnar store: {store.get('Segments', 0)} segments / "
               f"{store.get('LiveRows', 0)} live rows / "
               f"{store.get('PromotedRows', 0)} promoted; batches: {kinds}")
+    digest = out.get("Digest")
+    if digest:
+        # Replica-determinism health: where this replica's chain stands,
+        # how far it has been verified against the leader, and whether
+        # it ever diverged (README "Replica determinism").
+        mode = ("synced" if digest.get("Synced")
+                else f"UNSYNCED ({digest.get('UnsyncedReason')})")
+        print(f"Replica digest: {mode}, chain @{digest.get('LastIndex', 0)}"
+              f" (verified @{digest.get('VerifiedIndex', 0)}, "
+              f"interval {digest.get('Interval')})")
+        print(f"  folds={digest.get('Folds', 0)}  "
+              f"exchanged={digest.get('Exchanged', 0)}  "
+              f"diverged={digest.get('Diverged', 0)}")
     workers = out.get("Workers") or []
     if not workers:
         print("No scheduling workers running (agent is not the leader?)")
@@ -1078,6 +1095,8 @@ def cmd_lint(args) -> int:
     clean tree, 1 when any unsuppressed finding survives."""
     from nomad_tpu.analysis import all_checkers, run_checks
 
+    if args.suppressions:
+        return _lint_suppressions(args)
     try:
         findings = run_checks(paths=args.paths or None,
                               checker_ids=args.checker,
@@ -1100,3 +1119,47 @@ def cmd_lint(args) -> int:
               + (f" ({len(findings) - len(live)} suppressed)"
                  if len(findings) != len(live) else ""))
     return 1 if live else 0
+
+
+def _lint_suppressions(args) -> int:
+    """`nomad-tpu lint -suppressions`: the purity-boundary audit. Every
+    active `# lint: allow(<checker>, <reason>)` in the tree, with its
+    location and reason — the reviewable ledger of intentional
+    exceptions. Always exits 0: suppressions are declarations, not
+    findings."""
+    import os as _os
+
+    from nomad_tpu.analysis.findings import parse_suppression_details
+    from nomad_tpu.analysis.framework import PKG_ROOT, iter_py_files
+
+    files: list = []
+    for p in (args.paths or [PKG_ROOT]):
+        p = _os.path.abspath(p)
+        if _os.path.isdir(p):
+            files.extend(iter_py_files(p))
+        else:
+            files.append(p)
+
+    rows = []
+    for path in files:
+        try:
+            with open(path, encoding="utf-8") as f:
+                source = f.read()
+        except OSError:
+            continue
+        for lineno, checker, reason in parse_suppression_details(source):
+            if args.checker and checker not in args.checker:
+                continue
+            rows.append({"File": _os.path.relpath(path, _os.getcwd()),
+                         "Line": lineno, "Checker": checker,
+                         "Reason": reason})
+    rows.sort(key=lambda r: (r["File"], r["Line"]))
+    if args.as_json:
+        print(json.dumps({"suppressions": rows, "total": len(rows)},
+                         indent=2))
+    else:
+        for r in rows:
+            print(f"{r['File']}:{r['Line']}: "
+                  f"allow({r['Checker']}) — {r['Reason']}")
+        print(f"{len(rows)} suppression(s)")
+    return 0

@@ -42,6 +42,12 @@ class RaftBackend:
             # atomic cutover (README "Failover & streaming snapshots").
             snapshot_stream_fn=self._fsm_snapshot_stream,
             restore_stream_fn=self._fsm_restore_stream,
+            # Replica-digest exchange: checkpoint piggyback on
+            # AppendEntries, follower verification, and the divergence
+            # quarantine's FSM wipe. All no-ops while fsm.digest is None.
+            digest_checkpoint_fn=self._digest_checkpoint,
+            digest_verify_fn=self._digest_verify,
+            digest_quarantine_fn=self._digest_quarantine,
             config=config,
             on_leader_change=on_leader_change,
             electable=electable,
@@ -76,6 +82,31 @@ class RaftBackend:
         lazy so the atomic-cutover guarantee covers decode faults too."""
         self.fsm.restore_chunks(
             msgpack.unpackb(c, raw=False) for c in raw_chunks)
+
+    # ---------------------------------------------------------- digest glue
+    def _digest_checkpoint(self):
+        digest = getattr(self.fsm, "digest", None)
+        return None if digest is None else digest.checkpoint()
+
+    def _digest_verify(self, index: int, expected_hex: str) -> bool:
+        digest = getattr(self.fsm, "digest", None)
+        if digest is None:
+            return True
+        from nomad_tpu.analysis.replica_digest import ReplicaDivergenceError
+        try:
+            digest.verify(index, expected_hex)
+            return True
+        except ReplicaDivergenceError:
+            return False
+
+    def _digest_quarantine(self) -> None:
+        """Divergence recovery: atomic cutover to an EMPTY store (the
+        corrupt state must not survive in any read surface) and a digest
+        chain back at genesis — the leader's catch-up re-derives both."""
+        self.fsm.restore({})
+        digest = getattr(self.fsm, "digest", None)
+        if digest is not None:
+            digest.reset()
 
     # ----------------------------------------------------------- apply seam
     def apply(self, msg_type, payload: Dict[str, Any]) -> int:

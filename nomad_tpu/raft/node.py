@@ -84,7 +84,12 @@ class RaftNode:
                  on_leader_change: Optional[Callable[[bool], None]] = None,
                  electable: bool = True,
                  snapshot_stream_fn: Optional[Callable[[], Any]] = None,
-                 restore_stream_fn: Optional[Callable[[Any], None]] = None):
+                 restore_stream_fn: Optional[Callable[[Any], None]] = None,
+                 digest_checkpoint_fn: Optional[
+                     Callable[[], Optional[Tuple[int, str]]]] = None,
+                 digest_verify_fn: Optional[
+                     Callable[[int, str], bool]] = None,
+                 digest_quarantine_fn: Optional[Callable[[], None]] = None):
         self.id = node_id
         self.config = config or RaftConfig()
         self.log = log_store
@@ -103,6 +108,14 @@ class RaftNode:
         self.snapshot_stream_fn = snapshot_stream_fn
         # restore_stream_fn takes an iterable of raw chunk blobs (bytes).
         self.restore_stream_fn = restore_stream_fn
+        # Replica-digest exchange (analysis/replica_digest.py): the leader
+        # piggybacks its newest digest checkpoint on AppendEntries; a
+        # follower whose chain disagrees at the same applied index is
+        # quarantined to snapshot-reinstall recovery. All three hooks are
+        # optional — absent, replication is byte-identical to before.
+        self.digest_checkpoint_fn = digest_checkpoint_fn
+        self.digest_verify_fn = digest_verify_fn
+        self.digest_quarantine_fn = digest_quarantine_fn
         self.on_leader_change = on_leader_change
 
         self._lock = threading.RLock()
@@ -621,6 +634,13 @@ class RaftNode:
             "Entries": [(e.Index, e.Term, e.Type, e.Data) for e in entries],
             "LeaderCommit": commit,
         }
+        if self.digest_checkpoint_fn is not None:
+            # Piggyback the newest digest checkpoint (outside _lock — the
+            # digest has its own lock). Followers that have folded the
+            # same index compare; everyone else ignores it.
+            cp = self.digest_checkpoint_fn()
+            if cp is not None:
+                payload["VerifyIndex"], payload["VerifyDigest"] = cp
         if failpoints.fire("raft.append_entries") == "drop":
             raise TransportError(
                 f"append_entries to {peer} dropped (failpoint)")
@@ -859,6 +879,23 @@ class RaftNode:
             return {"Term": self._term, "Granted": granted}
 
     def _on_append_entries(self, req: Dict[str, Any]) -> Dict[str, Any]:
+        resp = self._append_entries_locked(req)
+        if (resp.get("Success") and self.digest_verify_fn is not None
+                and "VerifyIndex" in req):
+            # Verify OUTSIDE self._lock: the digest takes its own lock,
+            # and a divergence quarantine needs the full
+            # _snap_mutex -> _fsm_lock -> _lock order — taking either
+            # while holding _lock would invert the apply loop's order.
+            ok = self.digest_verify_fn(int(req["VerifyIndex"]),
+                                       req["VerifyDigest"])
+            if not ok:
+                self._quarantine_divergence(int(req["VerifyIndex"]))
+                with self._lock:
+                    return {"Term": self._term, "Success": False,
+                            "LastIndex": 0, "Diverged": True}
+        return resp
+
+    def _append_entries_locked(self, req: Dict[str, Any]) -> Dict[str, Any]:
         with self._lock:
             if req["Term"] < self._term:
                 return {"Term": self._term, "Success": False,
@@ -897,6 +934,40 @@ class RaftNode:
                 self._apply_cond.notify_all()
             return {"Term": self._term, "Success": True,
                     "LastIndex": self.last_index}
+
+    def _quarantine_divergence(self, index: int) -> None:
+        """This replica's FSM digest disagrees with the leader's at
+        `index`: its state is no longer a function of the log, so nothing
+        derived from it can be trusted. Recovery = become a blank
+        follower: wipe the local log and snapshot bookkeeping, reset the
+        FSM to empty (atomic restore({}) cutover), and reset the digest
+        chain to genesis. The leader's back-probe then either replays the
+        full log (chain re-derives canonically from genesis) or streams
+        an InstallSnapshot (chain reseeds from the snapshot's value) —
+        both converge on verified state within one catch-up round."""
+        LOG.error("%s: replica state digest DIVERGED at index %d; "
+                  "quarantining to snapshot-reinstall recovery",
+                  self.id, index)
+        # Same order as every snapshot-install path:
+        # _snap_mutex -> _fsm_lock -> _lock.
+        with self._snap_mutex, self._fsm_lock:
+            with self._lock:
+                if self._shutdown:
+                    return
+                self.log.delete_range(self.log.first_index(),
+                                      self.log.last_index())
+                self._install_staging.clear()
+                self._snap_index = 0
+                self._snap_term = 0
+                self._commit_index = 0
+                self._last_applied = 0
+                self._applied_since_snap = 0
+                quarantine = self.digest_quarantine_fn
+            # FSM wipe outside _lock (it takes the store's own locks)
+            # but still under _fsm_lock, serialized against the apply
+            # loop and any in-flight install.
+            if quarantine is not None:
+                quarantine()
 
     def _on_install_snapshot(self, req: Dict[str, Any]) -> Dict[str, Any]:
         with self._lock:

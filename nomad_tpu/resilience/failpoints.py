@@ -61,6 +61,18 @@ KNOWN_SITES: Dict[str, str] = {
                       "delay=slow publish on the apply path; NEVER "
                       "FSM-visible — a consensus-committed entry must "
                       "apply even when its events are lost)",
+    "fsm.digest.mutate": "server: post-handler seam of the replica "
+                         "state-digest fold (drop=silent IN-PLACE store "
+                         "corruption of the row the entry just wrote, "
+                         "bypassing indexes, on NON-LEADER replicas only "
+                         "— the corrupted replica folds the corrupt "
+                         "readback while the leader folds the clean one, "
+                         "and the next checkpoint exchange must flag "
+                         "divergence and quarantine it to snapshot-"
+                         "reinstall; error=injected fold failure — "
+                         "contained: the entry stays applied and the "
+                         "digest goes unsynced instead of alarming; "
+                         "delay=slow fold on the apply path)",
     "gossip.probe": "gossip: direct ping of the probe target",
     "gossip.send": "gossip: outbound UDP datagram (drop=lost packet)",
     "plan.apply.commit": "server: plan applier's consensus commit",

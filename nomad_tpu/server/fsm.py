@@ -18,7 +18,9 @@ from typing import Any, Callable, Dict, List, Optional
 
 import numpy as np
 
+from nomad_tpu.analysis.replica_digest import chaos_corrupt, effect_of
 from nomad_tpu.events.builders import build_events
+from nomad_tpu.resilience import failpoints
 from nomad_tpu.server.timetable import TimeTable
 from nomad_tpu.state.state_store import StateStore, SweepSegment
 from nomad_tpu.telemetry import metrics, trace
@@ -117,6 +119,10 @@ class FSM:
         # cost at this one attribute check. Fed on EVERY replica, so any
         # server in the region can serve a gapless resume after failover.
         self.events = None
+        # Replica state digest (analysis/replica_digest.py): attached by
+        # the server when digest verification is enabled. None keeps the
+        # apply path's digest cost at this one attribute check.
+        self.digest = None
         # Leader-side observers (broker, blocked evals, periodic dispatch)
         # registered by the server when it holds leadership.
         self.on_eval_update: Optional[Callable[[Evaluation], None]] = None
@@ -130,7 +136,13 @@ class FSM:
         under nomad.fsm.<op> as in fsm.go:147 MeasureSince, and — inside
         an active trace — spanned as fsm.<op>, child-only so background
         applies never mint traces)"""
+        # lint: allow(apply_pure, local metrics timer; never enters state)
         start = time.monotonic()
+        # The witness is REPLICA-LOCAL wall time by design (reference:
+        # fsm.go:147): each replica records when IT applied the index, for
+        # operator time->index queries. It never feeds replicated tables
+        # or events; snapshots ship it only as a hint map.
+        # lint: allow(apply_pure, replica-local index->time witness map)
         self.timetable.witness(index, time.time())
         handler = _HANDLERS[msg_type]
         leaf = _MSG_METRIC.get(msg_type, msg_type.name.lower())
@@ -151,6 +163,12 @@ class FSM:
                         # up in the equivalence fold.
                         logger.exception(
                             "event builder failed at index %d", index)
+            # Fold only SUCCESSFUL applies into the digest chain (a
+            # handler exception skips this via the raise): every replica
+            # applies the same entries, so every replica folds the same
+            # sequence.
+            if self.digest is not None:
+                self._digest_fold(index, msg_type, payload)
             return result
         finally:
             # Publish in the finally — even a failed handler releases the
@@ -159,6 +177,36 @@ class FSM:
             if broker is not None:
                 broker.publish(index, events or ())
             metrics.measure_since(("nomad", "fsm", leaf), start)
+
+    def _digest_fold(self, index: int, msg_type: MessageType,
+                     payload: Dict[str, Any]) -> None:
+        """Fold this entry's post-apply effect into the replica digest
+        chain. Any failure here is CONTAINED: the entry is consensus-
+        committed and already applied, so a broken fold must never fail
+        it — the digest marks itself unsynced (verification pauses until
+        the next snapshot reseed) instead."""
+        digest = self.digest
+        try:
+            if (self.on_eval_update is None
+                    and failpoints.fire("fsm.digest.mutate") == "drop"):
+                # Silent store corruption, injected BEFORE the effect
+                # readback: this replica folds the corrupt value while
+                # healthy replicas fold the clean one — the exact
+                # divergence the checkpoint exchange exists to catch.
+                # NON-leader replicas only (leader-side observers are the
+                # leadership tell): the leader's chain is the reference
+                # the quarantined follower reinstalls from, so corrupting
+                # it would make the corruption authoritative — and the
+                # guard comes FIRST so a count-bounded arm is consumed
+                # by a replica that will actually corrupt, never burned
+                # by a leader-side skip.
+                chaos_corrupt(self.state, index, int(msg_type), payload)
+            digest.fold(index, int(msg_type),
+                        effect_of(self.state, index, int(msg_type),
+                                  payload))
+        except Exception:
+            logger.exception("digest fold failed at index %d", index)
+            digest.mark_unsynced(f"fold failed at index {index}")
 
     # ------------------------------------------------------------- handlers
     def _apply_node_register(self, index: int, req: Dict[str, Any]):
@@ -403,6 +451,10 @@ class FSM:
                         for t in ("nodes", "jobs", "evals", "allocs",
                                   "periodic_launch", "services")},
             "timetable": self.timetable.serialize(),
+            # Chain value at the snapshot watermark: a replica restoring
+            # this snapshot reseeds and keeps the chain canonical.
+            "digest": (self.digest.snapshot_state()
+                       if self.digest is not None else None),
         }
 
     def snapshot_chunks(self, chunk_items: int = SNAPSHOT_CHUNK_ITEMS):
@@ -417,6 +469,10 @@ class FSM:
         single chunk scales with sweep size."""
         snap = self.state.snapshot()
         timetable = self.timetable.serialize()
+        # Pinned eagerly with the MVCC snapshot: the caller holds the
+        # apply lock here, so the chain value matches the watermark.
+        digest_state = (self.digest.snapshot_state()
+                        if self.digest is not None else None)
 
         def batched(kind, items):
             for i in range(0, len(items), chunk_items):
@@ -429,6 +485,7 @@ class FSM:
                             for t in ("nodes", "jobs", "evals", "allocs",
                                       "periodic_launch", "services")},
                 "timetable": timetable,
+                "digest": digest_state,
             }
             yield from batched("nodes", [to_dict(n) for n in snap.nodes()])
             yield from batched("jobs", [to_dict(j) for j in snap.jobs()])
@@ -475,6 +532,7 @@ class FSM:
         timetable — bit-identical to its pre-restore state."""
         r = self.state.restore()
         timetable = None
+        digest_state = None
         loaders = {
             "nodes": (Node, r.node_restore),
             "jobs": (Job, r.job_restore),
@@ -489,6 +547,7 @@ class FSM:
                 for t, idx in (chunk.get("indexes") or {}).items():
                     r.index_restore(t, idx)
                 timetable = chunk.get("timetable")
+                digest_state = chunk.get("digest")
             elif kind == "columnar_allocs":
                 for seg in chunk.get("items", ()):
                     r.columnar_restore(seg)
@@ -502,6 +561,18 @@ class FSM:
         r.commit()
         if timetable:
             self.timetable.deserialize(timetable)
+        if self.digest is not None:
+            if digest_state:
+                # Adopt the snapshot's chain value — folding resumes at
+                # the watermark and the chain stays canonical.
+                self.digest.reseed(digest_state["index"],
+                                   digest_state["digest"])
+            else:
+                # Snapshot predates digests (or is an empty quarantine
+                # wipe): fold but never verify until the next reseed —
+                # an unverifiable chain must not raise false alarms.
+                self.digest.mark_unsynced("restored snapshot without "
+                                          "a digest chain value")
         if self.events is not None:
             # Snapshot install: entries below the restored watermark were
             # never applied here, so the ring cannot serve them. Raise
@@ -514,7 +585,8 @@ class FSM:
         one-table chunks."""
         def gen():
             yield {"kind": "header", "indexes": data.get("indexes", {}),
-                   "timetable": data.get("timetable")}
+                   "timetable": data.get("timetable"),
+                   "digest": data.get("digest")}
             for kind in ("nodes", "jobs", "evals", "allocs",
                          "columnar_allocs", "periodic_launches", "services"):
                 items = list(data.get(kind, ()))

@@ -154,6 +154,13 @@ class ServerConfig:
     # — the FSM apply path then pays one attribute check and placements
     # are bit-identical to pre-events behavior (README "Event stream").
     event_buffer_size: int = 4096
+    # Cross-replica state-digest verification (analysis/replica_digest.py):
+    # every apply folds its effect into a rolling chain; every this-many
+    # applies the chain value becomes a checkpoint the leader piggybacks
+    # on AppendEntries for followers to verify (README "Replica
+    # determinism"). 0 disables — the apply path then pays one attribute
+    # check and replication carries no digest fields.
+    digest_interval: int = 64
     # Replicated deployment (reference: nomad/config.go RaftConfig +
     # BootstrapExpect). node_id doubles as the raft/transport address.
     node_id: str = ""
@@ -193,6 +200,14 @@ class Server:
                 region=(self.config.region
                         if federation_enabled(self.config.federation)
                         else ""))
+        if self.config.digest_interval > 0:
+            from nomad_tpu.analysis.replica_digest import ReplicaDigest
+
+            # Folds on EVERY replica (dev mode included — sched-stats
+            # shows the chain); the checkpoint exchange only happens
+            # under the replicated backend.
+            self.fsm.digest = ReplicaDigest(
+                interval=self.config.digest_interval)
         self._leadership_lock = threading.Lock()
         if transport is not None:
             from nomad_tpu.raft import RaftBackend

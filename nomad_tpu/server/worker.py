@@ -472,6 +472,18 @@ class Worker:
                 else:
                     self._snapshot = self.raft.fsm.state.snapshot()
                     self._fed_born = None
+                    if (min_index is not None
+                            and self._snapshot.latest_index() < min_index):
+                        # The store regressed between the raft-sync
+                        # barrier and the snapshot — a replica-digest
+                        # quarantine wipes the local store for
+                        # snapshot-reinstall. Scheduling from the wiped
+                        # view would complete the eval against an empty
+                        # world; nack and let redelivery find a replica
+                        # that has caught back up.
+                        raise TimeoutError(
+                            f"snapshot at {self._snapshot.latest_index()} "
+                            f"regressed below release floor {min_index}")
                 if ev.Type == "_core":
                     if self.core_scheduler is not None:
                         self.core_scheduler.process(ev)

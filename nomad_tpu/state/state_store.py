@@ -510,6 +510,9 @@ class StateStore(_ReadAPI):
     # ----------------------------------------------------------------- writes
     def _commit(self, index: int, tables: Iterable[str], watch_items: Items,
                 scoped: Optional[Dict[str, Set[str]]] = None) -> None:
+        # Dedup order is immaterial: every table gets the SAME index and
+        # watch items land in a set — no replicated value depends on it.
+        # lint: allow(apply_pure, order-independent index assignment)
         for t in set(tables):
             self._table_index[t] = index
             watch_items.add(Item(table=t))
@@ -531,6 +534,7 @@ class StateStore(_ReadAPI):
         tensor index) as one scatter-add. No per-alloc work happens here —
         per-row secondary indexes merge lazily on first read, and real
         Allocation objects stamp lazily on first touch."""
+        # lint: allow(apply_pure, local metrics timer; never enters state)
         t0 = time.monotonic()
         with self._lock:
             self._col_segments.append(seg)

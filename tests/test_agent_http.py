@@ -353,6 +353,13 @@ def test_debug_sched_stats_exports_worker_schema(dev_agent):
     for key in ("Segments", "LiveRows", "PromotedRows", "Batches"):
         assert key in store, f"Store key {key} missing from endpoint"
     assert isinstance(store["Batches"], dict)
+    # Replica-digest block: chain position / verification watermark /
+    # sync mode / flow counters (README "Replica determinism").
+    digest = out["Digest"]
+    for key in ("Interval", "LastIndex", "Chain", "Synced", "Folds",
+                "Exchanged", "Diverged", "VerifiedIndex"):
+        assert key in digest, f"Digest key {key} missing from endpoint"
+    assert digest["Diverged"] == 0
 
 
 def test_debug_profile_rejects_malformed_seconds(dev_agent):

@@ -1,0 +1,200 @@
+"""Call-graph engine tests (analysis/callgraph.py): resolution of bare /
+self / method-dispatch calls, transitive reachability from apply roots,
+the nondeterminism taxonomy, boundary exclusion, the visibility
+restriction on method-name fallback, and suppression plumbing through
+run_checks. Synthetic files live outside the package tree, where
+apply/_apply_*/restore*-named functions are roots by the external rule.
+"""
+
+import os
+import textwrap
+
+from nomad_tpu.analysis.callgraph import build_graph
+from nomad_tpu.analysis.framework import PKG_ROOT, load_file, run_checks
+
+
+def _write(tmp_path, name, source):
+    p = tmp_path / name
+    p.write_text(textwrap.dedent(source))
+    return str(p)
+
+
+def _impurities(tmp_path, *sources):
+    paths = [_write(tmp_path, f"m{i}.py", src)
+             for i, src in enumerate(sources)]
+    ctxs = [load_file(p) for p in paths]
+    assert all(ctxs)
+    return build_graph(ctxs).impurities()
+
+
+# ------------------------------------------------------------ reachability
+def test_direct_impurity_in_apply_root_flags(tmp_path):
+    imps = _impurities(tmp_path, """
+        import time
+
+        def apply(entry):
+            return time.time()
+    """)
+    assert len(imps) == 1
+    imp = imps[0]
+    assert imp.category == "wall_clock"
+    assert imp.label == "time.time()"
+    assert imp.chain == ("apply",)
+
+
+def test_two_hop_transitive_chain(tmp_path):
+    imps = _impurities(tmp_path, """
+        import random
+
+        def _stamp():
+            return random.random()
+
+        def _decorate(entry):
+            entry["n"] = _stamp()
+
+        def apply(entry):
+            _decorate(entry)
+    """)
+    assert len(imps) == 1
+    assert imps[0].category == "randomness"
+    assert imps[0].chain == ("apply", "_decorate", "_stamp")
+
+
+def test_self_method_dispatch_resolves(tmp_path):
+    imps = _impurities(tmp_path, """
+        import uuid
+
+        class FSM:
+            def _fresh_id(self):
+                return uuid.uuid4()
+
+            def apply(self, entry):
+                return self._fresh_id()
+    """)
+    assert len(imps) == 1
+    assert imps[0].label == "uuid.uuid4()"
+    assert imps[0].chain == ("FSM.apply", "FSM._fresh_id")
+
+
+def test_unreachable_impurity_is_not_flagged(tmp_path):
+    imps = _impurities(tmp_path, """
+        import time
+
+        def observability_tick():
+            return time.time()
+
+        def apply(entry):
+            return entry
+    """)
+    assert imps == []
+
+
+def test_unordered_set_iteration_flags(tmp_path):
+    imps = _impurities(tmp_path, """
+        def apply(entry):
+            out = []
+            for k in set(entry):
+                out.append(k)
+            return out
+    """)
+    assert [i.category for i in imps] == ["unordered"]
+
+
+def test_identity_and_io_leaves(tmp_path):
+    imps = _impurities(tmp_path, """
+        def apply(entry):
+            h = hash(entry["ID"])
+            with open("/tmp/x") as f:
+                return h, f.read()
+    """)
+    assert {i.category for i in imps} == {"identity", "io"}
+
+
+# ----------------------------------------------------- visibility / deny
+def test_method_fallback_restricted_to_visible_files(tmp_path):
+    # m0's apply calls obj.frobnicate() but never imports m1 (and CANNOT:
+    # synthetic files are outside the nomad_tpu namespace), so the
+    # name-match must not edge into m1's impure method.
+    imps = _impurities(tmp_path, """
+        def apply(entry, obj):
+            obj.frobnicate(entry)
+    """, """
+        import time
+
+        class Widget:
+            def frobnicate(self, entry):
+                entry["t"] = time.time()
+    """)
+    assert imps == []
+    # Same shapes in ONE file: the class is visible, the edge resolves.
+    same = tmp_path / "same"
+    same.mkdir()
+    imps = _impurities(same, """
+        import time
+
+        class Widget:
+            def frobnicate(self, entry):
+                entry["t"] = time.time()
+
+        def apply(entry, obj):
+            obj.frobnicate(entry)
+    """)
+    assert len(imps) == 1
+    assert imps[0].chain == ("apply", "Widget.frobnicate")
+
+
+def test_denylisted_container_methods_never_edge(tmp_path):
+    imps = _impurities(tmp_path, """
+        import time
+
+        class Registry:
+            def append(self, entry):
+                entry["t"] = time.time()
+
+        def apply(entry, items):
+            items.append(entry)
+    """)
+    assert imps == []
+
+
+# ----------------------------------------------------------- boundaries
+def test_observer_seams_are_traversal_boundaries():
+    # Real package files: functions in telemetry/ and the failpoint
+    # registry index as boundaries, and rooting a traversal AT one
+    # yields nothing — its internals never join the apply closure.
+    fp = load_file(os.path.join(PKG_ROOT, "resilience", "failpoints.py"))
+    tm = load_file(os.path.join(PKG_ROOT, "telemetry", "metrics.py"))
+    assert fp is not None and tm is not None
+    graph = build_graph([fp, tm])
+    infos = list(graph.functions())
+    assert infos and all(i.boundary for i in infos)
+    roots = [i.key for i in infos]
+    assert graph.impurities(roots=roots) == []
+
+
+# ------------------------------------------------------------ suppression
+def test_allow_comment_suppresses_via_run_checks(tmp_path):
+    p = _write(tmp_path, "sup.py", """
+        import time
+
+        def apply(entry):
+            entry["t"] = time.time()  # lint: allow(apply_pure, local)
+    """)
+    assert run_checks(paths=[p], checker_ids=["apply_pure"]) == []
+    flagged = run_checks(paths=[p], checker_ids=["apply_pure"],
+                         include_suppressed=True)
+    assert len(flagged) == 1 and flagged[0].suppressed
+
+
+def test_unsuppressed_surfaces_through_run_checks(tmp_path):
+    p = _write(tmp_path, "raw.py", """
+        import time
+
+        def apply(entry):
+            entry["t"] = time.time()
+    """)
+    found = run_checks(paths=[p], checker_ids=["apply_pure"])
+    assert len(found) == 1
+    f = found[0]
+    assert f.checker == "apply_pure"
+    assert "wall_clock" in f.message and "apply" in f.message

@@ -25,7 +25,7 @@ FIXTURE = os.path.join(os.path.dirname(os.path.abspath(__file__)),
 
 EXPECTED_CHECKERS = {"guarded_by", "lock_blocking", "retry", "thread",
                      "swallow", "failpoint_site", "metric_key", "trace_key",
-                     "event_schema"}
+                     "event_schema", "apply_pure"}
 
 
 def test_framework_hosts_the_expected_checkers():
@@ -171,6 +171,32 @@ def test_cli_lint_json_output(capsys):
 def test_cli_lint_unknown_checker_exits_two(capsys):
     assert cli_main(["lint", "-checker", "bogus"]) == 2
     assert "known checkers" in capsys.readouterr().err
+
+
+def test_cli_lint_suppressions_audit(capsys):
+    """`lint -suppressions` is the purity-boundary ledger: every active
+    allow() with file:line, checker id, and reason; always exit 0."""
+    import json
+
+    assert cli_main(["lint", "-suppressions"]) == 0
+    out = capsys.readouterr().out
+    # The apply-path allows annotated for the purity checker are listed
+    # with their reasons (the auditable part).
+    assert "allow(apply_pure)" in out
+    assert "suppression(s)" in out
+
+    assert cli_main(["lint", "-suppressions", "-json"]) == 0
+    payload = json.loads(capsys.readouterr().out)
+    assert payload["total"] == len(payload["suppressions"]) > 0
+    sample = payload["suppressions"][0]
+    assert {"File", "Line", "Checker", "Reason"} <= set(sample)
+    assert all(r["Reason"] for r in payload["suppressions"])
+
+    # -checker narrows the audit the same way it narrows a lint run.
+    assert cli_main(["lint", "-suppressions", "-checker",
+                     "apply_pure"]) == 0
+    out = capsys.readouterr().out
+    assert "allow(apply_pure)" in out and "allow(swallow)" not in out
 
 
 def test_per_file_cache_serves_repeat_runs():

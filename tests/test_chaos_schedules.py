@@ -1152,3 +1152,183 @@ class TestEventStreamFailoverSchedule:
                     n.shutdown()
                 except Exception:
                     pass
+
+
+class TestDigestDivergenceSchedule:
+    """ISSUE 19 chaos gate: silent store corruption lands on follower
+    replicas mid-storm (`fsm.digest.mutate=drop` — the seam corrupts the
+    just-written row in place, bypassing indexes, on non-leader replicas
+    only). The cross-replica digest exchange must DETECT it (the
+    corrupted follower's verify raises against the leader's piggybacked
+    checkpoint), quarantine the follower to snapshot-reinstall recovery,
+    and reconverge the whole cluster onto the leader's verified state —
+    with zero divergence alarms before the fault and none after the
+    heal, and the leader's invariants intact throughout."""
+
+    N_NODES = 12
+    N_JOBS = 16
+    CORRUPT_AT = 6
+
+    def _boot(self, name, join=None):
+        from nomad_tpu.gossip import GossipConfig
+        from nomad_tpu.raft import RaftConfig
+
+        cs = ClusterServer(ServerConfig(
+            node_id="", num_schedulers=1, bootstrap_expect=3,
+            scheduler_window=8, digest_interval=16))
+        # Small snapshot threshold: the quarantined follower's recovery
+        # path is a chunked InstallSnapshot (whose header reseeds its
+        # digest chain), not a full log replay.
+        cs.connect([], raft_config=RaftConfig(
+            heartbeat_interval=0.02, election_timeout_min=0.08,
+            election_timeout_max=0.16, apply_timeout=5.0,
+            snapshot_threshold=30, trailing_logs=32))
+        cs.start()
+        cs.enable_gossip(name, join=join,
+                         gossip_config=GossipConfig.fast())
+        return cs
+
+    def _cluster(self):
+        nodes = [self._boot("d0")]
+        nodes.append(self._boot("d1", join=[_gaddr(nodes[0])]))
+        nodes.append(self._boot("d2", join=[_gaddr(nodes[0])]))
+        return nodes
+
+    def test_corrupted_follower_detected_and_reinstalled(self):
+        mutate_fired_before = failpoints.snapshot().get(
+            "fsm.digest.mutate", {}).get("fired", 0)
+        nodes = self._cluster()
+
+        def diverged_total():
+            return sum(cs.server.fsm.digest.stats()["Diverged"]
+                       for cs in nodes)
+
+        try:
+            assert wait_for(lambda: leader_of(nodes) is not None,
+                            timeout=30)
+            for _ in range(self.N_NODES):
+                _rpc_retry(nodes, "Node.Register",
+                           {"Node": to_dict(mock.node())})
+            # Zero false positives on the clean warm-up applies.
+            assert diverged_total() == 0
+
+            jobs = []
+            eval_ids = []
+            for i in range(self.N_JOBS):
+                if i == self.CORRUPT_AT:
+                    # Corrupt every follower apply until detection: the
+                    # seam skips leaders, so the reference state — and
+                    # the recovery snapshot — stays clean.
+                    failpoints.arm_from_spec("fsm.digest.mutate=drop")
+                job = make_job()
+                jobs.append(job)
+                resp = _rpc_retry(nodes, "Job.Register",
+                                  {"Job": to_dict(job)})
+                eval_ids.append(resp["EvalID"])
+                time.sleep(0.01)
+
+            # Detection: the checkpoint exchange flags the corruption
+            # within one interval of piggybacked AppendEntries.
+            assert wait_for(lambda: diverged_total() >= 1, timeout=30,
+                            interval=0.05,
+                            msg="injected divergence never detected")
+            failpoints.disarm("fsm.digest.mutate")
+            assert failpoints.snapshot().get(
+                "fsm.digest.mutate", {}).get("fired", 0) \
+                - mutate_fired_before >= 1
+
+            ldr = leader_of(nodes)
+            assert ldr is not None
+            assert wait_for(
+                lambda: _all_terminal(ldr.server.state, eval_ids),
+                timeout=120, interval=0.25,
+                msg="storm terminal through the quarantine")
+            # Heal phase: fresh entries so catch-up has new indexes to
+            # verify against, and a NEW NODE — the capacity change
+            # re-enqueues any eval a follower worker parked as blocked
+            # while its store was still corrupt (infeasible chaos-marked
+            # nodes), so placement liveness recovers scheduler-side.
+            _rpc_retry(nodes, "Node.Register",
+                       {"Node": to_dict(mock.node())})
+            heal = [make_job() for _ in range(3)]
+            for job in heal:
+                resp = _rpc_retry(nodes, "Job.Register",
+                                  {"Job": to_dict(job)})
+                eval_ids.append(resp["EvalID"])
+            assert wait_for(
+                lambda: (lead := leader_of(nodes)) is not None
+                and _all_terminal(lead.server.state, eval_ids),
+                timeout=60, interval=0.25, msg="heal evals terminal")
+
+            def short_jobs():
+                lead = leader_of(nodes)
+                if lead is None:
+                    return jobs + heal
+                live: dict = {}
+                for a in lead.server.state.allocs():
+                    if a.DesiredStatus == "run":
+                        live[a.JobID] = live.get(a.JobID, 0) + 1
+                return [j for j in jobs + heal
+                        if live.get(j.ID, 0) < PER_JOB]
+
+            # A follower worker that scheduled from a corrupt (or
+            # quarantine-wiped) snapshot can complete an eval WITHOUT
+            # its placements — the digest detects the corruption, it
+            # does not resurrect evals the corruption already ate. The
+            # operator remedy is re-evaluation (`nomad job eval`):
+            # re-register any shorted job and let the clean post-heal
+            # cluster place the missing allocs.
+            for _ in range(4):
+                missing = short_jobs()
+                if not missing:
+                    break
+                retry_ids = []
+                for job in missing:
+                    resp = _rpc_retry(nodes, "Job.Register",
+                                      {"Job": to_dict(job)})
+                    retry_ids.append(resp["EvalID"])
+                eval_ids.extend(retry_ids)
+                wait_for(
+                    lambda: (lead := leader_of(nodes)) is not None
+                    and _all_terminal(lead.server.state, retry_ids),
+                    timeout=30, interval=0.25)
+            assert not short_jobs(), \
+                "jobs still unplaced after post-heal re-evaluation"
+
+            def converged():
+                lead = leader_of(nodes)
+                if lead is None:
+                    return False
+                state = lead.server.state
+                want_nodes = {(n.ID, n.Status) for n in state.nodes()}
+                want_evals = {(e.ID, e.Status) for e in state.evals()}
+                for cs in nodes:
+                    s = cs.server.state
+                    if {(n.ID, n.Status) for n in s.nodes()} != want_nodes:
+                        return False
+                    if {(e.ID, e.Status) for e in s.evals()} != want_evals:
+                        return False
+                return True
+
+            assert wait_for(converged, timeout=60, interval=0.25,
+                            msg="replicas reconverged after quarantine")
+
+            # Clean recovery: the corruption marker survives NOWHERE,
+            # every replica's digest is back in verified mode, and the
+            # leader's storm invariants held through the whole episode.
+            for cs in nodes:
+                s = cs.server.state
+                assert all(e.Status != "chaos-diverged" for e in s.evals())
+                assert all(n.Status != "chaos-diverged" for n in s.nodes())
+                assert cs.server.fsm.digest.stats()["Synced"]
+            ldr = leader_of(nodes)
+            assert ldr.server.fsm.digest.stats()["Diverged"] == 0, \
+                "the leader must never see itself as diverged"
+            assert_invariants(ldr.server.state, jobs + heal,
+                              per_job=PER_JOB, eval_ids=eval_ids)
+        finally:
+            for n in nodes:
+                try:
+                    n.shutdown()
+                except Exception:
+                    pass

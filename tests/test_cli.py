@@ -223,11 +223,16 @@ class TestClusterCommands:
         for key in ("t_dispatch_ms", "t_collect_ms", "t_drain_fetch_ms"):
             assert key in out
 
+        # Replica-digest health rides the same surface.
+        assert "Replica digest:" in out
+        assert "diverged=0" in out
+
         rc, out, _ = run_cli(capsys, "sched-stats", "-address", address,
                              "-json")
         assert rc == 0
         payload = json.loads(out)
         assert payload["Workers"][0]["Stats"]["windows"] >= 0
+        assert payload["Digest"]["Diverged"] == 0
 
     def test_trace_enable_list_show_export_disable(self, capsys, address,
                                                    dev_agent, tmp_path):
